@@ -1,0 +1,56 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Ground-up redesign of the capability surface of PaddlePaddle (reference
+snapshot at /root/reference, see SURVEY.md) for TPU: eager tensors + tape
+autograd over XLA dispatch, a declarative op registry emitting pure-JAX ops,
+GSPMD-sharded distributed training over device meshes, Pallas fused kernels,
+and trace-compile-and-cache execution for the performance path.
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu.flags import get_flags, set_flags  # noqa: F401
+from paddle_tpu.framework.tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa: F401
+from paddle_tpu.framework import dtype as _dtype_mod
+from paddle_tpu.framework.dtype import (  # noqa: F401
+    bfloat16, bool_ as bool_dtype, complex128, complex64, dtype, float16,
+    float32, float64, int16, int32, int64, int8, uint8,
+)
+from paddle_tpu.framework.device import (  # noqa: F401
+    CPUPlace, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device, synchronize,
+)
+from paddle_tpu.framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from paddle_tpu.autograd.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+
+# op surface: paddle_tpu.matmul(...), paddle_tpu.add(...), ...
+from paddle_tpu.ops import *  # noqa: F401,F403
+from paddle_tpu import ops  # noqa: F401
+
+from paddle_tpu import autograd  # noqa: F401
+from paddle_tpu import nn  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu.framework.io import save, load  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import jit  # noqa: F401
+
+# grad API at top level (paddle.grad)
+from paddle_tpu.autograd.tape import grad  # noqa: F401
+
+
+def _lazy(name):
+    import importlib
+    return importlib.import_module(f"paddle_tpu.{name}")
+
+
+def __getattr__(name):
+    # heavier subsystems load lazily: distributed, profiler, vision, incubate
+    if name in ("distributed", "profiler", "vision", "incubate", "models",
+                "static", "hapi", "device", "distribution", "sparse",
+                "quantization"):
+        mod = _lazy(name)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

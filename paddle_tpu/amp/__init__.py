@@ -1,0 +1,15 @@
+"""AMP — mixed precision (python/paddle/amp analog).
+
+TPU redesign (SURVEY §7.1): bf16 is the native training dtype; ``auto_cast``
+inserts casts at op dispatch using white/black lists exactly like the
+reference's eager AMP state (python/paddle/amp/auto_cast.py:860,
+paddle/fluid/eager/amp_auto_cast.h), and ``GradScaler`` exists for fp16
+parity (no-op for bf16 — no loss scaling needed).
+"""
+
+from paddle_tpu.amp.auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, is_auto_cast_enabled, amp_state,
+    white_list, black_list, decorate,
+)
+from paddle_tpu.amp.grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from paddle_tpu.amp import debugging  # noqa: F401

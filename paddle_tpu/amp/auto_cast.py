@@ -1,0 +1,101 @@
+"""auto_cast context: per-op cast insertion at dispatch time."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.dtype import convert_dtype
+
+# ops that benefit from low precision (MXU ops) — reference white list analog
+WHITE_LIST: Set[str] = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "sdpa_ref", "flash_attention",
+}
+# numerically sensitive ops kept in f32
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "layer_norm", "rms_norm",
+    "batch_norm_train", "batch_norm_infer", "mean", "sum", "logsumexp",
+    "cosine_similarity", "norm",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white: Set[str] = set()
+        self.custom_black: Set[str] = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_dtype_for_op(op_name: str):
+    """Called by ops.registry.apply_op: returns target dtype or None."""
+    if not _state.enabled:
+        return None
+    if op_name in _state.custom_black or (op_name in BLACK_LIST and op_name not in _state.custom_white):
+        return jnp.float32
+    if op_name in WHITE_LIST or op_name in _state.custom_white:
+        return _state.dtype
+    if _state.level == "O2":
+        return _state.dtype
+    return None
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype (amp.decorate analog).
+    Optimizer master weights are automatic (f32 moments/master in Adam)."""
+    d = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models
+    return models, optimizers

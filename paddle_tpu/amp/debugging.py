@@ -1,0 +1,59 @@
+"""AMP debugging tools (python/paddle/amp/debugging.py analog):
+check_numerics + tensor stat collection."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from paddle_tpu.flags import flags, set_flags
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["enable_operator_stats_collection", "check_numerics", "TensorCheckerConfig",
+           "enable_tensor_checker", "disable_tensor_checker", "collect_operator_stats"]
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=None):
+    v = tensor.value if isinstance(tensor, Tensor) else tensor
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    if n_nan or n_inf:
+        raise FloatingPointError(
+            f"check_numerics: op={op_type} var={var_name}: {n_nan} NaN, {n_inf} Inf")
+    return n_nan, n_inf
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None):
+        self.enable = enable
+
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    set_flags({"check_nan_inf": bool(config.enable)})
+
+
+def disable_tensor_checker() -> None:
+    set_flags({"check_nan_inf": False})
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    from paddle_tpu.ops import registry
+    stats = {}
+    orig = registry.apply_op
+
+    def wrapper(opdef, args, kwargs):
+        stats[opdef.name] = stats.get(opdef.name, 0) + 1
+        return orig(opdef, args, kwargs)
+
+    registry.apply_op = wrapper
+    try:
+        yield stats
+    finally:
+        registry.apply_op = orig
+
+
+enable_operator_stats_collection = collect_operator_stats

@@ -1,0 +1,8 @@
+"""User-facing autograd API (python/paddle/autograd analog)."""
+
+from paddle_tpu.autograd.tape import (  # noqa: F401
+    backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+    GradNode,
+)
+from paddle_tpu.autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
+from paddle_tpu.autograd.functional import jacobian, hessian, jvp, vjp  # noqa: F401

@@ -1,0 +1,71 @@
+"""Functional higher-order AD (python/paddle/autograd/autograd.py analog:
+jacobian/hessian; incubate jvp). Implemented directly on JAX transforms —
+higher-order AD composes for free, unlike the reference's separate "prim"
+decomposition machinery (paddle/fluid/prim/)."""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp"]
+
+
+def _fn_on_values(func):
+    def wrapped(*values):
+        tensors = [Tensor(v, stop_gradient=False) for v in values]
+        out = func(*tensors)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return wrapped
+
+
+def _values(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(x._value if isinstance(x, Tensor) else x for x in xs)
+    return (xs._value if isinstance(xs, Tensor) else xs,)
+
+
+def jacobian(func, xs, create_graph: bool = False):
+    vals = _values(xs)
+    jac = jax.jacrev(_fn_on_values(func), argnums=tuple(range(len(vals))))(*vals)
+    if not isinstance(xs, (tuple, list)):
+        jac = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(jac)
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, create_graph: bool = False):
+    vals = _values(xs)
+    hes = jax.hessian(_fn_on_values(func), argnums=tuple(range(len(vals))))(*vals)
+    if not isinstance(xs, (tuple, list)):
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return Tensor(h)
+    return tuple(tuple(Tensor(h) for h in row) for row in hes)
+
+
+def jvp(func, xs, v=None):
+    vals = _values(xs)
+    tangents = _values(v) if v is not None else tuple(
+        jax.numpy.ones_like(x) for x in vals)
+    out, tangent_out = jax.jvp(_fn_on_values(func), vals, tangents)
+    wrap = lambda o: tuple(Tensor(x) for x in o) if isinstance(o, tuple) else Tensor(o)
+    return wrap(out), wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    vals = _values(xs)
+    out, vjp_fn = jax.vjp(_fn_on_values(func), *vals)
+    if v is None:
+        import jax.numpy as jnp
+        v_vals = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        v_vals = _values(v)
+        if not isinstance(out, tuple):
+            v_vals = v_vals[0]
+    grads = vjp_fn(v_vals)
+    wrap = lambda o: tuple(Tensor(x) for x in o) if isinstance(o, tuple) else Tensor(o)
+    return wrap(out), wrap(grads if len(grads) > 1 else grads[0])

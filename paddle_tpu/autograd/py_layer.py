@@ -1,0 +1,94 @@
+"""PyLayer — user-defined forward/backward pairs on the eager tape.
+
+Analog of the reference's ``paddle.autograd.PyLayer``
+(python/paddle/autograd/py_layer.py + C++ side paddle/fluid/eager/pylayer/).
+The backward runs arbitrary Python (may itself call ops), so a PyLayer node's
+"vjp" is the user function rather than a jax.vjp closure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import tape
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: Tuple[Tensor, ...] = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors) -> None:
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs: List[Tensor] = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = tape.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with tape.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+        tensor_outs = [o for o in outs if isinstance(o, Tensor)]
+
+        if not needs_grad:
+            for o in tensor_outs:
+                o.stop_gradient = True
+            return outputs
+
+        out_avals = [(o.shape, o.dtype) for o in tensor_outs]
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+            with tape.no_grad():
+                in_grads = cls.backward(ctx, *ct_tensors)
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+            vals = []
+            gi = iter(in_grads)
+            for t in tensor_inputs:
+                g = next(gi, None)
+                if g is None:
+                    vals.append(jnp.zeros(t.shape, t.dtype))
+                else:
+                    vals.append(g._value if isinstance(g, Tensor) else g)
+            return tuple(vals)
+
+        node = tape.GradNode(f"PyLayer<{cls.__name__}>", vjp_fn, tensor_inputs,
+                             len(tensor_outs), out_avals)
+        idx = 0
+        for o in outs:
+            if isinstance(o, Tensor):
+                o._grad_node = node
+                o._out_index = idx
+                o.stop_gradient = False
+                idx += 1
+        return outputs

@@ -1,0 +1,254 @@
+"""Define-by-run autograd tape.
+
+TPU-native redesign of the reference's eager autograd engine
+(paddle/fluid/eager/: ``AutogradMeta`` autograd_meta.h:61, ``GradNodeBase``
+grad_node_info.h:197, ``egr::Backward`` backward.cc:439, topological queue
+``RunBackward`` backward.cc:105, ``GradTensorHolder`` accumulation).
+
+Instead of per-op hand-written C++ grad nodes, each recorded op captures a
+``jax.vjp`` of its (pure, jax-traceable) forward. Backward is a host-side
+topological walk over these nodes; every vjp call is itself an XLA-dispatched
+computation, so gradients run on TPU like any forward op. Saved residuals live
+inside the vjp closure (TensorWrapper analog, tensor_wrapper.h:39).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode", "is_grad_enabled", "no_grad", "enable_grad", "set_grad_enabled",
+    "backward", "grad",
+]
+
+
+class _GradMode(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_mode = _GradMode()
+
+
+def is_grad_enabled() -> bool:
+    return _mode.enabled
+
+
+@contextlib.contextmanager
+def set_grad_enabled(enabled: bool):
+    prev = _mode.enabled
+    _mode.enabled = bool(enabled)
+    try:
+        yield
+    finally:
+        _mode.enabled = prev
+
+
+class no_grad(contextlib.ContextDecorator):
+    """``paddle.no_grad`` analog — context manager *and* decorator."""
+
+    def __enter__(self):
+        self._prev = _mode.enabled
+        _mode.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _mode.enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _mode.enabled
+        _mode.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _mode.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn(cotangents_for_outputs) -> cotangents_for_inputs`` where inputs
+    are the flat list of differentiable input tensors recorded in ``inputs``.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int,
+                 out_avals: Sequence[Tuple[tuple, Any]]):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # list[Tensor]
+        self.n_outputs = n_outputs
+        self.out_avals = list(out_avals)  # [(shape, dtype)] per output
+
+    def __repr__(self):
+        return f"GradNode<{self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs}>"
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def _topo_from(roots: Sequence[GradNode]) -> Dict[GradNode, int]:
+    """BFS dependency counting (backward.cc:24-65 ``getInDegreeMap`` analog).
+
+    Returns map node -> number of downstream nodes that feed cotangents into it.
+    """
+    indeg: Dict[GradNode, int] = {}
+    seen = set(id(n) for n in roots)
+    for n in roots:
+        indeg.setdefault(n, 0)
+    queue = deque(roots)
+    while queue:
+        node = queue.popleft()
+        for t in node.inputs:
+            nxt = t._grad_node
+            if nxt is None:
+                continue
+            indeg[nxt] = indeg.get(nxt, 0) + 1
+            if id(nxt) not in seen:
+                seen.add(id(nxt))
+                queue.append(nxt)
+    return indeg
+
+
+def _run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]],
+    retain_graph: bool,
+    accumulate_into_grad: bool,
+    wanted: Optional[Dict[int, Any]] = None,
+) -> Dict[int, Any]:
+    """Core topological backward walk (RunBackward analog, backward.cc:105).
+
+    Returns {id(tensor): cotangent} for leaves (and for `wanted` tensors).
+    """
+    from paddle_tpu.framework.tensor import Tensor  # local import, avoids cycle
+
+    roots: List[GradNode] = []
+    buffers: Dict[GradNode, List[Any]] = {}  # GradTensorHolder analog
+    results: Dict[int, Any] = {}
+
+    grad_tensors = grad_tensors or [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise ValueError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t.shape, t.dtype)
+        elif isinstance(g, Tensor):
+            g = g.value
+        node = t._grad_node
+        if node is None:
+            # root is a leaf tensor
+            if not t.stop_gradient:
+                results[id(t)] = _accumulate(results.get(id(t)), g)
+            continue
+        if node not in buffers:
+            roots.append(node)  # dedupe: two outputs of one op share a node
+        buf = buffers.setdefault(node, [None] * node.n_outputs)
+        buf[t._out_index] = _accumulate(buf[t._out_index], g)
+
+    indeg = _topo_from(roots)
+    ready = deque(n for n in indeg if indeg[n] == 0 and n in buffers)
+
+    while ready:
+        node = ready.popleft()
+        buf = buffers.pop(node, None)
+        if buf is not None:
+            # fill missing output cotangents with zeros
+            cotangents = tuple(
+                jnp.zeros(shape, dtype) if g is None else g
+                for g, (shape, dtype) in zip(buf, node.out_avals)
+            )
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"grad node {node.name} was already released; pass "
+                    "retain_graph=True to backward() to allow a second backward pass")
+            in_grads = node.vjp_fn(cotangents if node.n_outputs > 1 else cotangents[0])
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+            if not retain_graph:
+                node.vjp_fn = None  # free residuals eagerly
+            for t, g in zip(node.inputs, in_grads):
+                if g is None or getattr(g, "dtype", None) == jax.dtypes.float0:
+                    continue  # non-differentiable (integer/bool) input
+                nxt = t._grad_node
+                if nxt is None:
+                    if not t.stop_gradient:
+                        results[id(t)] = _accumulate(results.get(id(t)), g)
+                        if accumulate_into_grad:
+                            t._accumulate_grad(g)
+                    elif wanted is not None and id(t) in wanted:
+                        results[id(t)] = _accumulate(results.get(id(t)), g)
+                else:
+                    nbuf = buffers.setdefault(nxt, [None] * nxt.n_outputs)
+                    nbuf[t._out_index] = _accumulate(nbuf[t._out_index], g)
+                    if wanted is not None and id(t) in wanted:
+                        results[id(t)] = _accumulate(results.get(id(t)), g)
+        # always release dependency counts, even when this node received no
+        # cotangents (e.g. all contributions were float0) — upstream nodes may
+        # still hold real gradients from other paths
+        for t in node.inputs:
+            nxt = t._grad_node
+            if nxt is None:
+                continue
+            indeg[nxt] -= 1
+            if indeg[nxt] == 0:
+                ready.append(nxt)
+    return results
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """``loss.backward()`` entry (tensor_patch_methods.py:250 analog)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    _run_backward(tensors, grad_tensors, retain_graph, accumulate_into_grad=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph: Optional[bool] = None,
+         create_graph: bool = False, allow_unused: bool = False):
+    """``paddle.grad`` analog (GeneralGrad, paddle/fluid/eager/general_grad.h).
+
+    Computes gradients of `outputs` w.r.t. `inputs` without touching `.grad`.
+    """
+    from paddle_tpu.framework.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported; use "
+            "paddle_tpu.incubate.autograd (jax.grad composition) for higher-order AD")
+    single = not isinstance(inputs, (list, tuple))
+    if single:
+        inputs = [inputs]
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if retain_graph is None:
+        retain_graph = False
+    wanted = {id(t): t for t in inputs}
+    results = _run_backward(outputs, grad_outputs, retain_graph,
+                            accumulate_into_grad=False, wanted=wanted)
+    out = []
+    for t in inputs:
+        g = results.get(id(t))
+        if g is None and not allow_unused:
+            raise ValueError(
+                "one of the inputs receives no gradient; pass allow_unused=True "
+                "to return None for it")
+        out.append(None if g is None else Tensor(g, stop_gradient=True))
+    return out[0] if single else out

@@ -1,0 +1,127 @@
+"""Global flag registry — env-overridable, introspectable runtime switches.
+
+TPU-native analog of the reference's gflags-compatible flag registry
+(paddle/common/flags.h:373 ``PHI_DEFINE_EXPORTED_*``, paddle/common/flags.cc —
+147 exported flags, surfaced to Python via ``get_flags``/``set_flags``).
+
+Flags are declared at import time with a default, a type, and a docstring.
+``FLAGS_<name>`` environment variables override the default at first read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flags"]
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def _parse_bool(s: str) -> bool:
+    v = s.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise ValueError(f"cannot parse boolean flag value {s!r}")
+
+
+class _Flag:
+    __slots__ = ("name", "default", "type", "help", "_value", "_read_env")
+
+    def __init__(self, name: str, default: Any, type_: Callable, help_: str):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.help = help_
+        self._value = default
+        self._read_env = False
+
+    def get(self) -> Any:
+        if not self._read_env:
+            env = os.environ.get("FLAGS_" + self.name)
+            if env is not None:
+                if self.type is bool:
+                    self._value = _parse_bool(env)
+                else:
+                    self._value = self.type(env)
+            self._read_env = True
+        return self._value
+
+    def set(self, value: Any) -> None:
+        if self.type is bool and isinstance(value, str):
+            value = _parse_bool(value)
+        else:
+            value = self.type(value)
+        self._value = value
+        self._read_env = True
+
+
+class _FlagRegistry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help_: str, type_: Optional[Callable] = None):
+        if type_ is None:
+            type_ = type(default)
+        with self._lock:
+            if name in self._flags:
+                raise KeyError(f"flag {name!r} already defined")
+            self._flags[name] = _Flag(name, default, type_, help_)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._flags[name].get()
+        except KeyError:
+            raise AttributeError(f"undefined flag {name!r}")
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].get()
+
+    def set(self, name: str, value: Any) -> None:
+        self._flags[name].set(value)
+
+    def names(self):
+        return sorted(self._flags)
+
+    def describe(self, name: str) -> str:
+        f = self._flags[name]
+        return f"{f.name} (default={f.default!r}): {f.help}"
+
+
+flags = _FlagRegistry()
+
+
+def define_flag(name: str, default: Any, help_: str = "", type_: Optional[Callable] = None) -> None:
+    flags.define(name, default, help_, type_)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: flags.get(n) for n in names}
+
+
+def set_flags(d: Dict[str, Any]) -> None:
+    for k, v in d.items():
+        flags.set(k, v)
+
+
+# ---------------------------------------------------------------------------
+# Core flag inventory (analog of paddle/common/flags.cc switchboard).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "scan every op output for NaN/Inf and raise")
+define_flag("deterministic", False, "prefer deterministic kernels / reductions")
+define_flag("eager_jit_ops", True, "cache-and-jit each eager op call (vs. raw dispatch)")
+define_flag("benchmark", False, "print per-step timing")
+define_flag("log_level", 0, "verbosity level for framework logging (VLOG analog)")
+define_flag("use_fused_attention", True, "use Pallas flash attention when available")
+define_flag("default_dtype", "float32", "default floating point dtype")
+define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
+define_flag("profiler_dir", "", "directory for profiler trace output")
+define_flag("comm_timeout_s", 1800.0, "collective watchdog timeout seconds")
+define_flag("enable_auto_parallel_align_mode", False, "deterministic data order for parallel-strategy alignment checks")

@@ -1,0 +1,1 @@
+from paddle_tpu.framework import dtype, device, random  # noqa: F401

@@ -1,0 +1,117 @@
+"""Place / device model.
+
+Analog of the reference's ``phi::Place`` + ``AllocationType`` enum
+(paddle/phi/common/place.h:30) and ``DeviceManager``
+(paddle/phi/backends/device_manager.h:134). On TPU the device axis collapses
+to {cpu, tpu}: XLA owns streams/contexts, so a Place here is (kind, index)
+used for `paddle.set_device` parity and for pinning host staging buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "set_device", "get_device",
+    "device_count", "current_place", "is_compiled_with_tpu", "synchronize",
+    "local_devices", "default_backend",
+]
+
+
+class Place:
+    """A (kind, index) device identifier. kind in {"cpu", "tpu", "gpu"}."""
+
+    __slots__ = ("kind", "index")
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.index) == (other.kind, other.index)
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    def is_cpu_place(self):
+        return self.kind == "cpu"
+
+    def is_tpu_place(self):
+        return self.kind == "tpu"
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == _JAX_PLATFORM.get(self.kind, self.kind)]
+        if not devs:
+            devs = jax.devices()
+        return devs[self.index % len(devs)]
+
+
+_JAX_PLATFORM = {"tpu": "tpu", "cpu": "cpu", "gpu": "gpu"}
+
+
+def CPUPlace(index: int = 0) -> Place:
+    return Place("cpu", index)
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+@functools.lru_cache(maxsize=None)
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+_current_place: Optional[Place] = None
+
+
+def set_device(device: str) -> Place:
+    """``paddle.set_device``-style: "tpu", "tpu:0", "cpu"."""
+    global _current_place
+    if ":" in device:
+        kind, idx = device.split(":", 1)
+        place = Place(kind, int(idx))
+    else:
+        place = Place(device, 0)
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.kind}:{p.index}"
+
+
+def current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = Place(default_backend(), 0)
+    return _current_place
+
+
+def device_count(kind: Optional[str] = None) -> int:
+    kind = kind or current_place().kind
+    return len([d for d in jax.devices() if d.platform == _JAX_PLATFORM.get(kind, kind)]) or len(jax.devices())
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def synchronize() -> None:
+    """Block until all queued device work completes (cudaDeviceSynchronize analog).
+
+    XLA dispatch is async; this drains it by blocking on a trivial transfer.
+    """
+    (jax.device_put(0) + 0).block_until_ready()

@@ -1,0 +1,80 @@
+"""Data types for paddle_tpu tensors.
+
+Analog of the reference's ``phi::DataType`` (paddle/phi/common/data_type.h) —
+collapsed onto JAX/XLA dtypes. TPU-native note: bfloat16 is a first-class
+training dtype (MXU-native); float64 exists for numerics tests only.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype", "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64", "uint8", "bool_",
+    "complex64", "complex128", "convert_dtype", "is_floating_point_dtype",
+    "is_integer_dtype", "finfo", "iinfo",
+]
+
+# Canonical dtype objects are jnp dtypes (numpy dtype instances).
+float16 = jnp.dtype("float16")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype("float32")
+float64 = jnp.dtype("float64")
+int8 = jnp.dtype("int8")
+int16 = jnp.dtype("int16")
+int32 = jnp.dtype("int32")
+int64 = jnp.dtype("int64")
+uint8 = jnp.dtype("uint8")
+bool_ = jnp.dtype("bool")
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+
+dtype = np.dtype  # the type of a dtype object
+
+_STR_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64, "uint8": uint8,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+
+def convert_dtype(d):
+    """Normalize any dtype spec (str, np dtype, python type) to a jnp dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        try:
+            return _STR_ALIASES[d]
+        except KeyError:
+            raise ValueError(f"unknown dtype {d!r}")
+    if d is float:
+        return float32
+    if d is int:
+        return int64
+    if d is bool:
+        return bool_
+    return jnp.dtype(d)
+
+
+def is_floating_point_dtype(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer_dtype(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))

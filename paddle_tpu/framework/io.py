@@ -1,0 +1,77 @@
+"""paddle.save / paddle.load analog (python/paddle/framework/io.py:725,:967).
+
+Pickle-based nested state_dict serialization with Tensor -> numpy conversion;
+directories are created on demand; >4GB handled by pickle protocol 4.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Marker wrapper so load() can re-wrap arrays as Tensors."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _to_saveable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        a = np.asarray(obj.value)
+        return _TensorPayload(a)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj: Any) -> Any:
+    if isinstance(obj, _TensorPayload):
+        return Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saved(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    os.replace(tmp, path)  # atomic _safe_save analog (io_utils.py)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        def unwrap(o):
+            if isinstance(o, _TensorPayload):
+                return o.array
+            if isinstance(o, dict):
+                return {k: unwrap(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return type(o)(unwrap(v) for v in o)
+            return o
+        return unwrap(obj)
+    return _from_saved(obj)

@@ -1,0 +1,250 @@
+"""The eager Tensor.
+
+Analog of the reference's ``phi::DenseTensor`` (paddle/phi/core/dense_tensor.h:37)
++ pybind eager Tensor object (paddle/fluid/pybind/eager.cc) + ``AutogradMeta``
+(paddle/fluid/eager/autograd_meta.h:61) — collapsed into one Python class that
+wraps a ``jax.Array`` (or a tracer, when executing under ``jit``/``to_static``).
+
+XLA owns device memory and layout; what this class owns is autograd metadata
+(stop_gradient / grad / grad node edge), naming, and the paddle-style method
+surface (patched on by ``paddle_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import dtype as dtypes
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
+        "name", "persistable", "_placements", "_process_mesh", "__weakref__",
+    )
+
+    # make numpy prefer our __r*__ ops over elementwise np ops
+    __array_priority__ = 100
+
+    def __init__(self, value: Any, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif isinstance(value, (np.ndarray, np.generic, int, float, bool, list, tuple)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._placements = None
+        self._process_mesh = None
+
+    # -- raw value access ---------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        from paddle_tpu.framework.device import Place
+        devs = getattr(self._value, "devices", None)
+        if devs:
+            d = next(iter(devs())) if callable(devs) else next(iter(devs))
+            return Place(d.platform, d.id)
+        from paddle_tpu.framework.device import current_place
+        return current_place()
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, d) -> "Tensor":
+        from paddle_tpu import ops
+        return ops.cast(self, d)
+
+    def cast(self, d) -> "Tensor":
+        return self.astype(d)
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g)
+        self._grad = g
+
+    def _accumulate_grad(self, g_value) -> None:
+        """Leaf gradient accumulation (GradNodeAccumulation analog,
+        paddle/fluid/eager/accumulation/accumulation_node.h)."""
+        if self._grad is None:
+            self._grad = Tensor(g_value, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._value + g_value, stop_gradient=True)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        from paddle_tpu.autograd import tape
+        tape.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self) -> None:
+        self._grad = None
+
+    def clear_gradient(self) -> None:  # paddle alias
+        self._grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from paddle_tpu import ops
+        return ops.assign(self)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def register_hook(self, hook):
+        raise NotImplementedError("per-tensor grad hooks land with the hook pass")
+
+    # -- mutation (optimizer fast path; breaks no autograd history) ---------
+    def _set_value(self, new_value) -> None:
+        if isinstance(new_value, Tensor):
+            new_value = new_value._value
+        self._value = new_value
+
+    def copy_(self, other) -> "Tensor":
+        self._set_value(other)
+        return self
+
+    def set_value(self, other) -> None:
+        self._set_value(jnp.asarray(other) if not isinstance(other, (Tensor,)) else other)
+
+    def block_until_ready(self) -> "Tensor":
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+    # -- dist metadata (DistTensor analog, set by distributed.shard_tensor) --
+    @property
+    def placements(self):
+        return self._placements
+
+    @property
+    def process_mesh(self):
+        return self._process_mesh
+
+    @property
+    def is_dist(self) -> bool:
+        return self._placements is not None
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.asarray(self._value)
+            return (f"Tensor(shape={list(self.shape)}, dtype={self.dtype.name}"
+                    f"{grad_info},\n       {data})")
+        except Exception:
+            return f"Tensor(shape={list(self.shape)}, dtype={self.dtype.name}{grad_info}, traced)"
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic / indexing methods are patched on by paddle_tpu.ops.methods
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (stop_gradient=False, persistable)."""
+
+    __slots__ = ("trainable", "optimize_attr")
+
+    def __init__(self, value, name: Optional[str] = None, trainable: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` analog."""
+    if isinstance(data, Tensor):
+        v = data._value
+    else:
+        v = data
+    d = dtypes.convert_dtype(dtype)
+    if isinstance(v, (int, float, bool, list, tuple, np.ndarray, np.generic)):
+        arr = np.asarray(v)
+        if d is None and arr.dtype == np.float64:
+            d = dtypes.convert_dtype(_default_float())
+        v = jnp.asarray(arr, dtype=d)
+    elif d is not None and jnp.dtype(v.dtype) != d:
+        v = v.astype(d)
+    if place is not None:
+        v = jax.device_put(v, place.jax_device)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _default_float():
+    from paddle_tpu.flags import flags
+    return flags.default_dtype

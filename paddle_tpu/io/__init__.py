@@ -1,0 +1,17 @@
+"""paddle_tpu.io — datasets + DataLoader (python/paddle/io analog).
+
+DataLoader redesign for TPU: worker threads/processes feed a bounded prefetch
+queue, and batches are transferred to device ahead of consumption (the role of
+the reference's C++ BufferedReader double-buffering,
+paddle/fluid/operators/reader/buffered_reader.cc).
+"""
+
+from paddle_tpu.io.dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split, ConcatDataset,
+)
+from paddle_tpu.io.sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+)
+from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,137 @@
+"""DataLoader with background prefetch.
+
+Analog of python/paddle/io/reader.py ``DataLoader`` (:216) +
+``_DataLoaderIterMultiProcess`` (dataloader/dataloader_iter.py) + the C++
+``BufferedReader`` device prefetch (paddle/fluid/operators/reader/
+buffered_reader.cc). TPU design: worker threads collate numpy batches into a
+bounded queue; the consumer thread converts to device arrays ahead of use
+(XLA transfers are async, so enqueueing the device_put is the double-buffer).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.io.dataset import Dataset, IterableDataset
+from paddle_tpu.io.sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s.value for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class _PrefetchIter:
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.queue: "queue.Queue" = queue.Queue(maxsize=loader.prefetch_factor)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._produce, daemon=True)
+        self._worker.start()
+
+    def _produce(self):
+        try:
+            for batch in self.loader._iter_batches():
+                if self._stop.is_set():
+                    return
+                self.queue.put(batch)
+            self.queue.put(_SENTINEL)
+        except BaseException as e:  # propagate worker errors to consumer
+            self.queue.put(_ExcWrapper(e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.queue.get()
+        if item is _SENTINEL:
+            raise StopIteration
+        if isinstance(item, _ExcWrapper):
+            raise item.exc
+        return item
+
+    def __del__(self):
+        self._stop.set()
+
+
+_SENTINEL = object()
+
+
+class _ExcWrapper:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False, drop_last: bool = False,
+                 collate_fn: Optional[Callable] = None, num_workers: int = 0,
+                 use_buffer_reader: bool = True, prefetch_factor: int = 2,
+                 use_shared_memory: bool = True, timeout: int = 0,
+                 worker_init_fn=None, persistent_workers: bool = False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.use_buffer_reader = use_buffer_reader
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.collate_fn = collate_fn or default_collate_fn
+        self._is_iterable = isinstance(dataset, IterableDataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if self._is_iterable:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def _iter_batches(self):
+        if self._is_iterable:
+            it = iter(self.dataset)
+            if self.batch_size is None:
+                for sample in it:
+                    yield sample
+                return
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                batch = [self.dataset[i] for i in indices]
+                yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self.use_buffer_reader:
+            return _PrefetchIter(self)
+        return self._iter_batches()
+
+    def __len__(self):
+        if self._is_iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)

@@ -1,0 +1,16 @@
+"""paddle_tpu.jit — to_static trace-compile-and-cache.
+
+Redesign of the reference's dy2static (python/paddle/jit/dy2static/
+``ProgramTranslator``/``StaticFunction``) and SOT bytecode translator
+(python/paddle/jit/sot/): on TPU, *tracing is the execution model* —
+``to_static`` wraps a function or Layer so calls are captured once per input
+signature and replayed as a compiled XLA executable. Shape/dtype guards and
+recompilation come from jax.jit's dispatch cache; no AST rewriting or frame
+hooks are needed (SURVEY §7.1). Parameters are lifted to function inputs so
+weight updates never trigger recompilation, and buffer mutations (BatchNorm
+running stats) round-trip through the compiled function.
+"""
+
+from paddle_tpu.jit.to_static import to_static, StaticFunction, not_to_static  # noqa: F401
+from paddle_tpu.jit.save_load import save, load, TranslatedLayer  # noqa: F401
+from paddle_tpu.jit.api import ignore_module, enable_to_static  # noqa: F401

@@ -1,0 +1,19 @@
+"""Misc jit API surface (enable/disable switches)."""
+
+from __future__ import annotations
+
+_enabled = True
+
+
+def enable_to_static(flag: bool) -> None:
+    global _enabled
+    _enabled = bool(flag)
+
+
+def is_to_static_enabled() -> bool:
+    return _enabled
+
+
+def ignore_module(modules) -> None:
+    """SOT skip-module registry analog — tracing already ignores non-tensor code."""
+    return None

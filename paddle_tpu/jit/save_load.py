@@ -1,0 +1,111 @@
+"""jit.save / jit.load — deploy-format export.
+
+Analog of ``paddle.jit.save/load`` (python/paddle/jit/api.py,
+translated_layer.py) + the C++ ``jit::Layer`` loader (paddle/fluid/jit/).
+TPU-native format: the traced function is serialized as a portable StableHLO
+artifact via ``jax.export`` (the ProgramDesc+params directory analog), plus a
+weights file. ``load`` returns a ``TranslatedLayer`` that replays the
+executable — the AnalysisPredictor-style inference entry.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import io as fio
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["save", "load", "TranslatedLayer", "InputSpec"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec analog."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_struct(self):
+        from paddle_tpu.framework.dtype import convert_dtype
+        shape = tuple(1 if (s is None or s < 0) else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **config) -> None:
+    """Export `layer` to {path}.pdmodel (StableHLO) + {path}.pdiparams (weights)."""
+    from paddle_tpu.jit.to_static import StaticFunction
+
+    if isinstance(layer, StaticFunction):
+        inner = layer._layer
+        if inner is None:
+            raise ValueError("jit.save of a bare function requires a Layer")
+        layer = inner
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer or to_static-wrapped Layer")
+    if input_spec is None:
+        raise ValueError("input_spec is required (shapes define the exported program)")
+
+    layer.eval()
+    state = dict(layer.state_dict())
+    names = sorted(state.keys())
+    values = [state[n].value for n in names]
+
+    def pure(params, *inputs):
+        from paddle_tpu.nn.utils import functional_call
+        st = dict(zip(names, params))
+        out, _ = functional_call(layer, st, tuple(Tensor(i) for i in inputs))
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    specs = [s.to_struct() if isinstance(s, InputSpec) else
+             jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(s.dtype)) for s in input_spec]
+    param_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in values]
+
+    exported = jax.export.export(jax.jit(pure))(param_specs, *specs)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    fio.save({n: state[n] for n in names}, path + ".pdiparams")
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"param_names": names}, f)
+
+
+class TranslatedLayer(Layer):
+    """Replays an exported program (translated_layer.py analog)."""
+
+    def __init__(self, exported, params, param_names):
+        super().__init__()
+        self._exported = exported
+        self._param_values = [params[n].value for n in param_names]
+        for n in param_names:
+            from paddle_tpu.framework.tensor import Parameter
+            self.add_parameter(n.replace(".", "__"), Parameter(params[n].value))
+        self._param_names = param_names
+
+    def forward(self, *inputs):
+        vals = [i.value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        out = self._exported.call(self._param_values, *vals)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def load(path: str) -> TranslatedLayer:
+    with open(path + ".pdmodel", "rb") as f:
+        blob = f.read()
+    exported = jax.export.deserialize(bytearray(blob))
+    params = fio.load(path + ".pdiparams")
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, params, meta["param_names"])

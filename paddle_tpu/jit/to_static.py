@@ -1,0 +1,166 @@
+"""to_static implementation."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import tape
+from paddle_tpu.framework import random as rnd
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.ops.registry import OpDef, apply_op
+
+__all__ = ["to_static", "StaticFunction", "not_to_static"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+class StaticFunction:
+    """Callable wrapping a fn/Layer with capture-compile-cache semantics.
+
+    Redesign of dy2static's ``StaticFunction``/``partial_program`` (python/
+    paddle/jit/dy2static/program_translator.py): instead of AST transforms +
+    a traced ProgramDesc run through the ``run_program`` op, the function is
+    jax-traced into one compiled executable. Parameters/buffers are lifted to
+    inputs (no weight constants baked in); the executable is recorded as a
+    single op on the autograd tape so ``backward()`` differentiates through
+    it; buffer mutations (BatchNorm stats) are returned and written back.
+    Shape/dtype guards + recompilation come from jax.jit's dispatch cache
+    (the SOT guard machinery analog, python/paddle/jit/sot/).
+    """
+
+    def __init__(self, function: Callable, input_spec=None, build_strategy=None,
+                 full_graph: bool = True, backend=None):
+        self._layer: Optional[Layer] = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        else:
+            self._fn = function
+            if hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+                self._layer = function.__self__
+        self._input_spec = input_spec
+        try:
+            functools.update_wrapper(self, self._fn)
+        except Exception:
+            pass
+        self._cache: Dict[Any, Tuple[OpDef, dict]] = {}
+
+    def _make_impl(self, static_kwargs: tuple, training: bool, n_state: int,
+                   state_names: Tuple[str, ...], cell: dict):
+        layer = self._layer
+        fn = self._fn
+
+        def impl(*flat_args, key):
+            state_vals = flat_args[:n_state]
+            arg_vals = flat_args[n_state:]
+            kwargs = dict(static_kwargs)
+            rnd.push_trace_key(key)
+            try:
+                with tape.no_grad():
+                    if layer is not None:
+                        from paddle_tpu.nn.utils import functional_call
+                        state = dict(zip(state_names, state_vals))
+                        prev_mode = layer.training
+                        (layer.train() if training else layer.eval())
+                        try:
+                            out, new_buffers = functional_call(
+                                layer, state,
+                                tuple(Tensor(a) for a in arg_vals), kwargs)
+                        finally:
+                            (layer.train() if prev_mode else layer.eval())
+                    else:
+                        out = fn(*[Tensor(a) for a in arg_vals], **kwargs)
+                        new_buffers = {}
+                    out_vals = jax.tree_util.tree_map(_unwrap, out,
+                                                      is_leaf=_is_tensor_leaf)
+                    leaves, treedef = jax.tree_util.tree_flatten(out_vals)
+                    buf_names = [n for n in state_names if n in new_buffers]
+                    cell["treedef"] = treedef
+                    cell["n_out"] = len(leaves)
+                    cell["buf_names"] = buf_names
+                    return tuple(leaves) + tuple(new_buffers[n] for n in buf_names)
+            finally:
+                rnd.pop_trace_key()
+
+        return impl
+
+    def __call__(self, *args, **kwargs):
+        static_kwargs = tuple(sorted(kwargs.items()))
+        training = self._layer.training if self._layer is not None else False
+
+        if self._layer is not None:
+            state = dict(self._layer.state_dict())
+            for name, b in self._layer.named_buffers():
+                state.setdefault(name, b)
+            state_names = tuple(state.keys())
+            state_tensors = [state[n] for n in state_names]
+        else:
+            state_names = ()
+            state_tensors = []
+
+        cache_key = (static_kwargs, training, state_names)
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            cell: dict = {}
+            impl = self._make_impl(static_kwargs, training, len(state_tensors),
+                                   state_names, cell)
+            jitted = jax.jit(impl, static_argnames=())
+            opdef = OpDef(f"to_static<{getattr(self._fn, '__name__', 'fn')}>",
+                          jitted, n_outputs=-1)
+            entry = (opdef, cell)
+            self._cache[cache_key] = entry
+        opdef, cell = entry
+
+        key = rnd.split_key()
+        tensor_args = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                       for a in args]
+
+        outs = apply_op(opdef, tuple(state_tensors + tensor_args), {"key": key})
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        n_out = cell["n_out"]
+        out_leaves = list(outs[:n_out])
+        buf_outs = outs[n_out:]
+        if self._layer is not None and buf_outs:
+            buffers = dict(self._layer.named_buffers())
+            for name, v in zip(cell["buf_names"], buf_outs):
+                buffers[name]._set_value(v._value)
+        return jax.tree_util.tree_unflatten(cell["treedef"], out_leaves)
+
+    @property
+    def code(self) -> str:
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except Exception:
+            return "<source unavailable>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph: bool = True, **kwargs):
+    """``paddle.jit.to_static`` analog (decorator or direct call)."""
+
+    def decorate(fn):
+        return StaticFunction(fn, input_spec=input_spec,
+                              build_strategy=build_strategy,
+                              full_graph=full_graph, backend=backend)
+
+    if function is None:
+        return decorate
+    return decorate(function)
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

@@ -1,0 +1,15 @@
+"""paddle_tpu.nn — layers, functional ops, initializers (python/paddle/nn analog)."""
+
+from paddle_tpu.nn.layer_base import Layer  # noqa: F401
+from paddle_tpu.nn.layers import *  # noqa: F401,F403
+from paddle_tpu.nn.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+from paddle_tpu.nn.rnn import GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell  # noqa: F401
+from paddle_tpu.nn.clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn import utils  # noqa: F401

@@ -1,0 +1,78 @@
+"""Gradient clipping (python/paddle/nn/clip.py analog: ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Operates on (param, grad) pairs like the
+reference; the distributed HybridParallelClipGrad wraps ClipGradByGlobalNorm
+with cross-mesh-axis norm reduction (fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:41).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g.value if isinstance(g, Tensor) else g
+            out.append((p, Tensor(jnp.clip(gv, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g.value if isinstance(g, Tensor) else g
+            norm = jnp.sqrt(jnp.sum(jnp.square(gv.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((gv * scale).astype(gv.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm_sq(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads]
+        return jnp.sum(jnp.stack(sq)) if sq else jnp.zeros((), jnp.float32)
+
+    def __call__(self, params_grads):
+        grads = [(g.value if isinstance(g, Tensor) else g)
+                 for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        global_norm = jnp.sqrt(self._global_norm_sq(grads))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g.value if isinstance(g, Tensor) else g
+            out.append((p, Tensor((gv * scale).astype(gv.dtype))))
+        return out

@@ -1,0 +1,188 @@
+"""Weight initializers (python/paddle/nn/initializer/ analog).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from the
+global generator (so `paddle_tpu.seed` makes init reproducible).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import random as rnd
+from paddle_tpu.framework.dtype import convert_dtype
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return gains.get(nonlinearity, 1.0)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    # conv weights are (out_c, in_c, *k); linear weights are (in, out) in paddle
+    if len(shape) > 2:
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    else:
+        fan_in, fan_out = shape[0], shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        k = rnd.split_key()
+        return jax.random.normal(k, tuple(shape), convert_dtype(dtype)) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0, b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype="float32"):
+        k = rnd.split_key()
+        r = jax.random.truncated_normal(k, self.a, self.b, tuple(shape), convert_dtype(dtype))
+        return r * self.std + self.mean
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        k = rnd.split_key()
+        return jax.random.uniform(k, tuple(shape), convert_dtype(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = rnd.split_key()
+        return jax.random.normal(k, tuple(shape), convert_dtype(dtype)) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = rnd.split_key()
+        return jax.random.uniform(k, tuple(shape), convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        k = rnd.split_key()
+        return jax.random.normal(k, tuple(shape), convert_dtype(dtype)) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        k = rnd.split_key()
+        return jax.random.uniform(k, tuple(shape), convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        v = jnp.asarray(np.asarray(self.value), convert_dtype(dtype))
+        assert tuple(v.shape) == tuple(shape), f"Assign shape {v.shape} != {shape}"
+        return v
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        k = rnd.split_key()
+        return self._rect(k, shape, dtype)
+
+    def _rect(self, k, shape, dtype):
+        rows = int(shape[0])
+        cols = int(np.prod(shape[1:]))
+        n = max(rows, cols)
+        a = jax.random.normal(k, (n, n), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        w = np.zeros(shape, dtype=np.float32)
+        out_c, in_c = shape[0], shape[1]
+        mins = min(out_c // self.groups, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                idx = (g * (out_c // self.groups) + i, i) + tuple(centers)
+                w[idx] = 1.0
+        return jnp.asarray(w, convert_dtype(dtype))

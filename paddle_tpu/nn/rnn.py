@@ -1,0 +1,304 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py analog).
+
+Recurrences compile as ``lax.scan`` — XLA unrolls onto TPU without the cuDNN
+RNN kernels the reference wraps (paddle/fluid/operators cudnn_lstm).
+Weight layout follows paddle: weight_ih (4h/3h/h, input), weight_hh (…, h).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN"]
+
+
+@register_op("rnn_scan_simple")
+def _simple_rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt):
+        h_new = act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T,B,I)
+    h_last, ys = lax.scan(step, h0, xs)
+    return jnp.swapaxes(ys, 0, 1), h_last
+
+
+@register_op("rnn_scan_lstm", n_outputs=3)
+def _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
+    hidden = h0.shape[-1]
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    (h_last, c_last), ys = lax.scan(step, (h0, c0), xs)
+    return jnp.swapaxes(ys, 0, 1), h_last, c_last
+
+
+@register_op("rnn_scan_gru", n_outputs=2)
+def _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh):
+    def step(h, xt):
+        gi = xt @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    h_last, ys = lax.scan(step, h0, xs)
+    return jnp.swapaxes(ys, 0, 1), h_last
+
+
+class _RNNBase(Layer):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        assert direction in ("forward", "bidirect", "bidirectional")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.activation = activation
+        self.dropout = dropout
+        num_dirs = 2 if self.bidirectional else 1
+        self.num_directions = num_dirs
+        std = 1.0 / math.sqrt(hidden_size)
+        g = self.GATES
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter("weight_ih" + sfx, self.create_parameter(
+                    (g * hidden_size, in_sz), default_initializer=init.Uniform(-std, std)))
+                self.add_parameter("weight_hh" + sfx, self.create_parameter(
+                    (g * hidden_size, hidden_size), default_initializer=init.Uniform(-std, std)))
+                self.add_parameter("bias_ih" + sfx, self.create_parameter(
+                    (g * hidden_size,), default_initializer=init.Uniform(-std, std)))
+                self.add_parameter("bias_hh" + sfx, self.create_parameter(
+                    (g * hidden_size,), default_initializer=init.Uniform(-std, std)))
+
+    def _dir_params(self, layer, reverse):
+        sfx = f"_l{layer}" + ("_reverse" if reverse else "")
+        return (self._parameters["weight_ih" + sfx], self._parameters["weight_hh" + sfx],
+                self._parameters["bias_ih" + sfx], self._parameters["bias_hh" + sfx])
+
+    def _run_dir(self, x, layer, reverse, init_state):
+        raise NotImplementedError
+
+    def forward(self, inputs, initial_states=None):
+        x = inputs
+        if self.time_major:
+            from paddle_tpu.ops.manipulation import transpose
+            x = transpose(x, [1, 0, 2])
+        states = self._prepare_states(x, initial_states)
+        out = x
+        finals = []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.num_directions):
+                xi = out if d == 0 else out
+                if d == 1:
+                    from paddle_tpu.ops.manipulation import flip
+                    xi = flip(out, [1])
+                y, fin = self._run_dir(xi, layer, d == 1, states[layer * self.num_directions + d])
+                if d == 1:
+                    from paddle_tpu.ops.manipulation import flip
+                    y = flip(y, [1])
+                outs.append(y)
+                finals.append(fin)
+            if len(outs) == 2:
+                from paddle_tpu.ops.manipulation import concat
+                out = concat(outs, axis=-1)
+            else:
+                out = outs[0]
+        if self.time_major:
+            from paddle_tpu.ops.manipulation import transpose
+            out = transpose(out, [1, 0, 2])
+        return out, self._pack_finals(finals)
+
+
+class SimpleRNN(_RNNBase):
+    GATES = 1
+
+    def _prepare_states(self, x, initial_states):
+        from paddle_tpu.ops.creation import zeros
+        b = x.shape[0]
+        n = self.num_layers * self.num_directions
+        if initial_states is None:
+            return [zeros((b, self.hidden_size), x.dtype) for _ in range(n)]
+        from paddle_tpu.ops.manipulation import unbind
+        return list(unbind(initial_states, 0))
+
+    def _run_dir(self, x, layer, reverse, h0):
+        w_ih, w_hh, b_ih, b_hh = self._dir_params(layer, reverse)
+        y, h = _simple_rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh,
+                                activation=self.activation)
+        return y, h
+
+    def _pack_finals(self, finals):
+        from paddle_tpu.ops.manipulation import stack
+        return stack(finals, axis=0)
+
+
+class LSTM(_RNNBase):
+    GATES = 4
+
+    def _prepare_states(self, x, initial_states):
+        from paddle_tpu.ops.creation import zeros
+        b = x.shape[0]
+        n = self.num_layers * self.num_directions
+        if initial_states is None:
+            return [(zeros((b, self.hidden_size), x.dtype),
+                     zeros((b, self.hidden_size), x.dtype)) for _ in range(n)]
+        h, c = initial_states
+        from paddle_tpu.ops.manipulation import unbind
+        hs, cs = list(unbind(h, 0)), list(unbind(c, 0))
+        return list(zip(hs, cs))
+
+    def _run_dir(self, x, layer, reverse, state):
+        h0, c0 = state
+        w_ih, w_hh, b_ih, b_hh = self._dir_params(layer, reverse)
+        y, h, c = _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh)
+        return y, (h, c)
+
+    def _pack_finals(self, finals):
+        from paddle_tpu.ops.manipulation import stack
+        hs = stack([f[0] for f in finals], axis=0)
+        cs = stack([f[1] for f in finals], axis=0)
+        return (hs, cs)
+
+
+class GRU(_RNNBase):
+    GATES = 3
+
+    _prepare_states = SimpleRNN._prepare_states
+    _pack_finals = SimpleRNN._pack_finals
+
+    def _run_dir(self, x, layer, reverse, h0):
+        w_ih, w_hh, b_ih, b_hh = self._dir_params(layer, reverse)
+        y, h = _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh)
+        return y, h
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh"):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               default_initializer=init.Uniform(-std, std))
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               default_initializer=init.Uniform(-std, std))
+        self.bias_ih = self.create_parameter((hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu.ops.creation import zeros
+        if states is None:
+            states = zeros((inputs.shape[0], self.hidden_size), inputs.dtype)
+        y, h = _simple_rnn_scan(inputs.unsqueeze(1), states, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh,
+                                activation=self.activation)
+        return h, h
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               default_initializer=init.Uniform(-std, std))
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               default_initializer=init.Uniform(-std, std))
+        self.bias_ih = self.create_parameter((4 * hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu.ops.creation import zeros
+        if states is None:
+            z = zeros((inputs.shape[0], self.hidden_size), inputs.dtype)
+            states = (z, z)
+        h0, c0 = states
+        y, h, c = _lstm_scan(inputs.unsqueeze(1), h0, c0, self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               default_initializer=init.Uniform(-std, std))
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               default_initializer=init.Uniform(-std, std))
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu.ops.creation import zeros
+        if states is None:
+            states = zeros((inputs.shape[0], self.hidden_size), inputs.dtype)
+        y, h = _gru_scan(inputs.unsqueeze(1), states, self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a layer scanning over time (paddle.nn.RNN analog)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        x = inputs
+        if self.time_major:
+            x = x.transpose([1, 0, 2])
+        if self.is_reverse:
+            x = x.flip([1])
+        outs = []
+        state = initial_states
+        for t in range(x.shape[1]):
+            y, state = self.cell(x[:, t], state)
+            outs.append(y)
+        from paddle_tpu.ops.manipulation import stack
+        out = stack(outs, axis=1)
+        if self.is_reverse:
+            out = out.flip([1])
+        if self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, state

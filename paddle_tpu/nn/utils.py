@@ -1,0 +1,58 @@
+"""nn.utils (python/paddle/nn/utils analog): parameter vectorization, spectral
+norm helper stubs, and the functional_call bridge used by jit/to_static."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "functional_call"]
+
+
+def parameters_to_vector(parameters) -> Tensor:
+    vals = [jnp.ravel(p.value) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec: Tensor, parameters) -> None:
+    offset = 0
+    v = vec.value
+    for p in parameters:
+        n = p.size
+        p._set_value(jnp.reshape(v[offset:offset + n], p.shape))
+        offset += n
+
+
+def functional_call(layer: Layer, params_and_buffers: Dict[str, jnp.ndarray],
+                    args: tuple, kwargs: dict = None):
+    """Run `layer` with parameter/buffer values substituted (pure-function view).
+
+    The bridge that lets compiled training steps treat an nn.Layer as a pure
+    fn(params, inputs) -> (outputs, new_buffers): temporarily swaps each
+    parameter/buffer `_value` for the provided (possibly traced) value, runs
+    forward, then restores. Buffer mutations during the call (e.g. BatchNorm
+    running stats) are captured and returned.
+    """
+    kwargs = kwargs or {}
+    state = dict(layer.state_dict())
+    # include non-persistable buffers too
+    for name, b in layer.named_buffers():
+        state.setdefault(name, b)
+    originals: List[Tuple[Tensor, object]] = []
+    try:
+        for name, t in state.items():
+            if name in params_and_buffers:
+                originals.append((t, t._value))
+                t._value = params_and_buffers[name]
+        out = layer(*[Tensor(a, stop_gradient=True) if not isinstance(a, Tensor) else a
+                      for a in args], **kwargs)
+        new_buffers = {name: b._value for name, b in layer.named_buffers()
+                       if name in params_and_buffers}
+        return out, new_buffers
+    finally:
+        for t, v in originals:
+            t._value = v

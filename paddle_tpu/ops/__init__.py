@@ -1,0 +1,19 @@
+"""paddle_tpu.ops — the declarative op layer (SURVEY §7.2 M1).
+
+One op table serves eager dispatch, autograd recording, and jit tracing.
+Submodules mirror the reference's python/paddle/tensor/* domain split.
+"""
+
+from paddle_tpu.ops.registry import OPS, apply_op, get_op, register_op  # noqa: F401
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.reduction import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.comparison import *  # noqa: F401,F403
+from paddle_tpu.ops.linalg import *  # noqa: F401,F403
+from paddle_tpu.ops.creation import *  # noqa: F401,F403
+
+from paddle_tpu.ops import methods as _methods
+
+_methods.monkey_patch_tensor()
+
+from paddle_tpu.ops import math, reduction, manipulation, comparison, linalg, creation  # noqa: F401,E402

@@ -1,0 +1,67 @@
+"""Comparison + logical ops (python/paddle/tensor/logic.py analog)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "isclose", "allclose", "equal_all", "is_empty",
+]
+
+
+def _cmp(name, fn):
+    @register_op(name, differentiable=False)
+    def _op(x, y):
+        return fn(x, y)
+    globals()[name] = _op
+    return _op
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+_cmp("bitwise_and", jnp.bitwise_and)
+_cmp("bitwise_or", jnp.bitwise_or)
+_cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+@register_op("logical_not", differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@register_op("bitwise_not", differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@register_op("isclose", differentiable=False)
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("allclose", differentiable=False)
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("equal_all", differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def is_empty(x):
+    v = x.value if isinstance(x, Tensor) else x
+    return Tensor(jnp.asarray(v.size == 0))

@@ -1,0 +1,196 @@
+"""Tensor creation ops (python/paddle/tensor/creation.py + random.py analogs).
+
+Random ops draw subkeys from the global splittable Generator
+(paddle_tpu/framework/random.py), so `paddle_tpu.seed(n)` reproduces eager
+runs; jitted model code threads keys explicitly instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.flags import flags
+from paddle_tpu.framework import random as rnd
+from paddle_tpu.framework.dtype import convert_dtype
+from paddle_tpu.framework.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "rand", "randn", "randint", "randperm", "uniform",
+    "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
+    "tril_indices", "triu_indices", "clone", "numel", "diagflat",
+]
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = convert_dtype(default or flags.default_dtype)
+    return d
+
+
+def _wrap(v):
+    return Tensor(v, stop_gradient=True)
+
+
+def zeros(shape, dtype=None):
+    return _wrap(jnp.zeros(tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return _wrap(jnp.ones(tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return _wrap(jnp.full(tuple(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    v = x.value if isinstance(x, Tensor) else x
+    return _wrap(jnp.zeros_like(v, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    v = x.value if isinstance(x, Tensor) else x
+    return _wrap(jnp.ones_like(v, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    v = x.value if isinstance(x, Tensor) else x
+    return _wrap(jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or flags.default_dtype
+            break
+    else:
+        dtype = dtype or "int64"
+    return _wrap(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return _wrap(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return _wrap(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return _wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def meshgrid(*args):
+    vals = [a.value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    if len(vals) == 1 and isinstance(args[0], (list, tuple)):
+        vals = [a.value if isinstance(a, Tensor) else jnp.asarray(a) for a in args[0]]
+    return tuple(_wrap(v) for v in jnp.meshgrid(*vals, indexing="ij"))
+
+
+def diagflat(x, offset=0):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return _wrap(jnp.diagflat(v, k=offset))
+
+
+def clone(x):
+    return Tensor(x.value, stop_gradient=x.stop_gradient)
+
+
+def numel(x):
+    return _wrap(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+# ---- random ---------------------------------------------------------------
+
+def _key():
+    return rnd.split_key()
+
+
+def rand(shape, dtype=None):
+    return _wrap(jax.random.uniform(_key(), tuple(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None):
+    return _wrap(jax.random.normal(_key(), tuple(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return _wrap(jax.random.randint(_key(), tuple(shape), low, high,
+                                    dtype=convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64"):
+    return _wrap(jax.random.permutation(_key(), n).astype(convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    return _wrap(jax.random.uniform(_key(), tuple(shape), _dt(dtype),
+                                    minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.value if isinstance(mean, Tensor) else mean
+        s = std.value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return _wrap(jax.random.normal(_key(), shp) * s + m)
+    shape = shape or (1,)
+    return _wrap(jax.random.normal(_key(), tuple(shape)) * std + mean)
+
+
+def bernoulli(x):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return _wrap(jax.random.bernoulli(_key(), v).astype(v.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement or num_samples == 1:
+        out = jax.random.categorical(_key(), logits, axis=-1,
+                                     shape=(*v.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return _wrap(out.astype(jnp.int64))
+
+
+def poisson(x):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return _wrap(jax.random.poisson(_key(), v).astype(v.dtype))
+
+
+def tril_indices(row, col, offset=0):
+    r, c = np.tril_indices(row, offset, col)
+    return _wrap(jnp.asarray(np.stack([r, c]), dtype=jnp.int64))
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return _wrap(jnp.asarray(np.stack([r, c]), dtype=jnp.int64))

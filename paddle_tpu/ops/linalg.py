@@ -1,0 +1,221 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py + phi matmul/blas analogs).
+
+matmul is THE op on TPU: it feeds the MXU. All matmuls go through one impl so
+dtype policy (bf16 inputs / f32 accumulation via preferred_element_type) is
+applied uniformly — the analog of the reference's blas wrapper funcs
+(paddle/phi/kernels/funcs/blas/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "t", "einsum", "norm", "dist",
+    "cholesky", "qr", "svd", "inv", "pinv", "solve", "triangular_solve",
+    "cholesky_solve", "lu", "matrix_power", "matrix_rank", "det", "slogdet",
+    "eig", "eigh", "eigvals", "eigvalsh", "lstsq", "cond", "cov", "corrcoef",
+    "cross", "histogram", "bincount", "multi_dot",
+]
+
+
+@register_op("matmul", ref="paddle/phi/ops/yaml/ops.yaml:matmul; kernel paddle/phi/kernels/impl/matmul_kernel_impl.h")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    # f32 accumulation on MXU for low-precision inputs
+    pet = None
+    if jnp.dtype(x.dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        pet = jnp.float32
+    out = jnp.matmul(x, y, preferred_element_type=pet)
+    return out.astype(x.dtype) if pet is not None else out
+
+
+@register_op("mm")
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register_op("t")
+def t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+@register_op("einsum")
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@register_op("norm")
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@register_op("dist")
+def dist(x, y, p=2):
+    return jnp.linalg.norm(jnp.ravel(x - y), ord=p)
+
+
+@register_op("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@register_op("qr", n_outputs=2)
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@register_op("svd", n_outputs=3)
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
+@register_op("inv")
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register_op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@register_op("lu", n_outputs=3, differentiable=False)
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1, jnp.zeros((), jnp.int32)
+
+
+@register_op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("matrix_rank", differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdet", n_outputs=2)
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+@register_op("eig", n_outputs=2, differentiable=False)
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+@register_op("eigh", n_outputs=2)
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@register_op("eigvals", differentiable=False)
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op("lstsq", n_outputs=4, differentiable=False)
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("cond", differentiable=False)
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register_op("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_op("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op("histogram", differentiable=False)
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        range_ = None
+    else:
+        range_ = (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=range_)
+    return hist
+
+
+@register_op("bincount", differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@register_op("multi_dot")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))

@@ -1,0 +1,431 @@
+"""Shape/layout manipulation ops.
+
+Analog of python/paddle/tensor/manipulation.py + phi view/stride kernels
+(paddle/phi/kernels/stride/). On TPU these are mostly free at compile time —
+XLA folds reshapes/transposes into surrounding fusions; there is no separate
+"view kernel" generation to maintain.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.registry import register_op
+
+__all__ = [
+    "reshape", "transpose", "concat", "stack", "split", "chunk", "squeeze",
+    "unsqueeze", "flatten", "tile", "expand", "broadcast_to", "expand_as",
+    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "index_add", "index_put", "slice", "strided_slice", "flip", "roll", "cast",
+    "assign", "take_along_axis", "put_along_axis", "unbind", "topk", "sort",
+    "argsort", "searchsorted", "masked_select", "masked_fill", "where",
+    "nonzero", "unique", "repeat_interleave", "unstack", "moveaxis",
+    "swapaxes", "as_complex", "as_real", "diagonal", "diag", "diag_embed",
+    "tril", "triu", "rot90", "one_hot", "pad", "crop", "tensordot",
+]
+
+
+@register_op("reshape", ref="paddle/phi/ops/yaml/ops.yaml:reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+
+@register_op("transpose", ref="paddle/phi/ops/yaml/ops.yaml:transpose")
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=tuple(perm) if perm is not None else None)
+
+
+@register_op("concat", ref="paddle/phi/ops/yaml/ops.yaml:concat")
+def concat(xs, axis=0):
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+@register_op("stack")
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+@register_op("split", n_outputs=-1)
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list, possibly with one -1
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        i = sections.index(-1)
+        sections[i] = total - (sum(s for s in sections if s != -1))
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@register_op("chunk", n_outputs=-1)
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+@register_op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted(axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@register_op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+@register_op("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+@register_op("expand")
+def expand(x, shape):
+    shape = list(shape)
+    # paddle: -1 means keep original dim
+    x_shape = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    out_shape = tuple(x_shape[i] if s == -1 else int(s) for i, s in enumerate(shape))
+    return jnp.broadcast_to(jnp.reshape(x, x_shape), out_shape)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("gather")
+def gather(x, index, axis=0):
+    idx = index
+    if idx.ndim == 0:
+        idx = jnp.reshape(idx, (1,))
+    return jnp.take(x, idx, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    depth = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(depth))
+    return x[idx]
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: destination rows are zeroed, then accumulated
+    return x.at[index].set(jnp.zeros_like(updates)).at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    depth = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(depth))
+    return x.at[idx].add(updates)
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("index_add")
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].add(jnp.moveaxis(value, axis, 0))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@register_op("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+import builtins as _builtins
+builtins_slice = _builtins.slice
+
+
+@register_op("slice")
+def slice(x, axes, starts, ends):
+    sl = [builtins_slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        sl[a] = builtins_slice(s, e)
+    return x[tuple(sl)]
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    sl = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        sl[a] = builtins_slice(s, e, st)
+    return x[tuple(sl)]
+
+
+@register_op("flip")
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register_op("cast", ref="paddle/phi/ops/yaml/ops.yaml:cast")
+def cast(x, dtype):
+    from paddle_tpu.framework.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype))
+
+
+@register_op("assign")
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_op("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@register_op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    if reduce in ("add", "mul", "multiply"):
+        # scatter with accumulate along one axis via explicit index grid
+        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+               for d, s in enumerate(indices.shape)]
+        idx = [jnp.broadcast_to(g, indices.shape) for g in idx]
+        idx[axis] = indices
+        vals = jnp.broadcast_to(values, indices.shape)
+        if reduce == "add":
+            return x.at[tuple(idx)].add(vals)
+        return x.at[tuple(idx)].multiply(vals)
+    raise NotImplementedError(f"put_along_axis reduce={reduce}")
+
+
+@register_op("unbind", n_outputs=-1)
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+@register_op("unstack", n_outputs=-1)
+def unstack(x, axis=0):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+@register_op("topk", n_outputs=2)
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        xt = jnp.moveaxis(x, axis, -1)
+        vals, idx = topk.op.impl(xt, k, -1, largest, sorted)
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    if largest:
+        vals, idx = lax.top_k(x, k)
+    else:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("sort")
+def sort(x, axis=-1, descending=False):
+    r = jnp.sort(x, axis=axis)
+    return jnp.flip(r, axis=axis) if descending else r
+
+
+@register_op("argsort", differentiable=False)
+def argsort(x, axis=-1, descending=False):
+    r = jnp.argsort(x, axis=axis)
+    if descending:
+        r = jnp.flip(r, axis=axis)
+    return r.astype(jnp.int64)
+
+
+@register_op("searchsorted", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    r = jnp.searchsorted(sorted_sequence, values, side=side)
+    return r.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("masked_select", differentiable=False)
+def masked_select(x, mask):
+    # dynamic output shape: eager-only (host round trip); inside jit use where()
+    import numpy as np
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@register_op("where")
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.stack(jnp.nonzero(condition), axis=1)
+    return jnp.where(condition, x, y)
+
+
+@register_op("nonzero", differentiable=False)
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    nz = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in nz)
+    return jnp.stack([jnp.asarray(i) for i in nz], axis=1) if nz else jnp.zeros((0, x.ndim), jnp.int64)
+
+
+@register_op("unique", differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@register_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes")
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@register_op("as_complex")
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diag")
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=offset)
+
+
+@register_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def emb(v):
+        return jnp.diag(v, k=offset)
+    out = jnp.apply_along_axis(emb, -1, x) if x.ndim > 1 else jnp.diag(x, k=offset)
+    return out
+
+
+@register_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(x, num_classes):
+    import jax
+    return jax.nn.one_hot(x, num_classes)
+
+
+@register_op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle style: pad applies to last len(pad)//2 dims (reversed pairs),
+        # or spatial dims per data_format for 4D/5D
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * x.ndim
+        if x.ndim in (4, 5) and data_format in ("NCHW", "NCDHW"):
+            dims = list(range(2, 2 + n_spatial))
+        elif x.ndim in (4, 5):
+            dims = list(range(1, 1 + n_spatial))
+        else:
+            dims = list(range(x.ndim - n_spatial, x.ndim))
+        for i, d in enumerate(reversed(dims)):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+@register_op("crop")
+def crop(x, shape, offsets=None):
+    if offsets is None:
+        offsets = [0] * x.ndim
+    sl = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[sl]
+
+
+@register_op("tensordot")
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)

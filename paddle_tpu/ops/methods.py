@@ -1,0 +1,224 @@
+"""Patch the paddle-style method surface onto Tensor.
+
+Analog of the reference's monkey-patching of math methods onto the eager
+Tensor (python/paddle/base/dygraph/tensor_patch_methods.py, math_op_patch).
+Indexing (__getitem__/__setitem__) goes through jnp/.at so it is traceable
+and differentiable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops import (comparison, linalg, manipulation, math as _math,
+                            reduction)
+from paddle_tpu.ops.registry import register_op
+
+
+def _coerce(other):
+    if isinstance(other, Tensor):
+        return other
+    return other  # scalars handled by jnp broadcasting inside impls
+
+
+@register_op("getitem")
+def _getitem_op(x, idx_tensors, idx_template):
+    # rebuild the index tuple, substituting tensor values back in
+    it = iter(idx_tensors)
+    idx = tuple(next(it) if e is _IDX_SLOT else e for e in idx_template)
+    if len(idx) == 1:
+        idx = idx[0]
+    return x[idx]
+
+
+@register_op("setitem")
+def _setitem_op(x, value, idx_tensors, idx_template):
+    it = iter(idx_tensors)
+    idx = tuple(next(it) if e is _IDX_SLOT else e for e in idx_template)
+    if len(idx) == 1:
+        idx = idx[0]
+    slot_shape = jnp.shape(x[idx] if not isinstance(idx, tuple) else x[idx])
+    v = value
+    if hasattr(v, "shape") and tuple(v.shape) != slot_shape:
+        # numpy assignment semantics: size-1 dims may collapse ((1,) -> ())
+        if int(np.prod(v.shape)) == int(np.prod(slot_shape)):
+            v = jnp.reshape(v, slot_shape)
+        else:
+            v = jnp.broadcast_to(v, slot_shape)
+    return x.at[idx].set(v)
+
+
+_IDX_SLOT = object()
+
+
+def _split_index(item):
+    """Split an index expression into (template, tensor list) so tensor indices
+    participate in dispatch (and bool-mask indices stay on device)."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    template, tensors = [], []
+    for e in item:
+        if isinstance(e, Tensor):
+            template.append(_IDX_SLOT)
+            tensors.append(e)
+        else:
+            template.append(e)
+    return template, tensors
+
+
+def _getitem(self, item):
+    template, tensors = _split_index(item)
+    return _getitem_op(self, tensors, template)
+
+
+def _tape_alias(t: Tensor) -> Tensor:
+    """Snapshot of a tensor's (value, grad edge) for in-place rebinding.
+
+    In-place ops record the op against this alias, then rebind the original
+    tensor to the op's output — otherwise the mutated tensor would appear as
+    its own grad-node input (a self-loop the backward walk can never
+    schedule). The inplace-version-counter analog of the reference
+    (paddle/fluid/eager/tensor_wrapper.h inplace checks).
+    """
+    a = Tensor(t._value, stop_gradient=t.stop_gradient)
+    a._grad_node = t._grad_node
+    a._out_index = t._out_index
+    return a
+
+
+def _setitem(self, item, value):
+    template, tensors = _split_index(item)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value))
+    out = _setitem_op(_tape_alias(self), value, tensors, template)
+    # paddle semantics: in-place; preserve autograd by rebinding value+node
+    self._value = out._value
+    self._grad_node = out._grad_node
+    self._out_index = out._out_index
+    self.stop_gradient = out.stop_gradient and self.stop_gradient
+    return self
+
+
+_BINOPS = {
+    "__add__": _math.add, "__radd__": lambda a, b: _math.add(b if isinstance(b, Tensor) else Tensor(jnp.asarray(b)), a),
+    "__sub__": _math.subtract,
+    "__rsub__": lambda a, b: _math.subtract(b if isinstance(b, Tensor) else Tensor(jnp.asarray(b)), a),
+    "__mul__": _math.multiply,
+    "__rmul__": lambda a, b: _math.multiply(b if isinstance(b, Tensor) else Tensor(jnp.asarray(b)), a),
+    "__truediv__": _math.divide,
+    "__rtruediv__": lambda a, b: _math.divide(b if isinstance(b, Tensor) else Tensor(jnp.asarray(b)), a),
+    "__floordiv__": _math.floor_divide,
+    "__mod__": _math.mod,
+    "__pow__": _math.pow,
+    "__rpow__": lambda a, b: _math.pow(b if isinstance(b, Tensor) else Tensor(jnp.asarray(b)), a),
+    "__matmul__": linalg.matmul,
+    "__eq__": comparison.equal, "__ne__": comparison.not_equal,
+    "__lt__": comparison.less_than, "__le__": comparison.less_equal,
+    "__gt__": comparison.greater_than, "__ge__": comparison.greater_equal,
+    "__and__": comparison.logical_and, "__or__": comparison.logical_or,
+    "__xor__": comparison.logical_xor,
+}
+
+_METHODS = {
+    # math
+    "add": _math.add, "subtract": _math.subtract, "multiply": _math.multiply,
+    "divide": _math.divide, "pow": _math.pow, "abs": _math.abs,
+    "exp": _math.exp, "log": _math.log, "sqrt": _math.sqrt,
+    "rsqrt": _math.rsqrt, "square": _math.square, "tanh": _math.tanh,
+    "sigmoid": _math.sigmoid, "sin": _math.sin, "cos": _math.cos,
+    "clip": _math.clip, "scale": _math.scale, "floor": _math.floor,
+    "ceil": _math.ceil, "round": _math.round, "sign": _math.sign,
+    "reciprocal": _math.reciprocal, "cumsum": _math.cumsum,
+    "cumprod": _math.cumprod, "isnan": _math.isnan, "isinf": _math.isinf,
+    "isfinite": _math.isfinite, "maximum": _math.maximum, "minimum": _math.minimum,
+    "neg": _math.neg, "lerp": _math.lerp,
+    # reduction
+    "sum": reduction.sum, "mean": reduction.mean, "prod": reduction.prod,
+    "max": reduction.max, "min": reduction.min, "argmax": reduction.argmax,
+    "argmin": reduction.argmin, "all": reduction.all, "any": reduction.any,
+    "std": reduction.std, "var": reduction.var, "logsumexp": reduction.logsumexp,
+    # manipulation
+    "reshape": manipulation.reshape, "transpose": manipulation.transpose,
+    "squeeze": manipulation.squeeze, "unsqueeze": manipulation.unsqueeze,
+    "flatten": manipulation.flatten, "tile": manipulation.tile,
+    "expand": manipulation.expand, "broadcast_to": manipulation.broadcast_to,
+    "gather": manipulation.gather, "gather_nd": manipulation.gather_nd,
+    "scatter": manipulation.scatter, "index_select": manipulation.index_select,
+    "flip": manipulation.flip, "roll": manipulation.roll,
+    "split": manipulation.split, "chunk": manipulation.chunk,
+    "unbind": manipulation.unbind, "topk": manipulation.topk,
+    "sort": manipulation.sort, "argsort": manipulation.argsort,
+    "tril": manipulation.tril, "triu": manipulation.triu,
+    "masked_fill": manipulation.masked_fill, "masked_select": manipulation.masked_select,
+    "take_along_axis": manipulation.take_along_axis,
+    "repeat_interleave": manipulation.repeat_interleave,
+    "diagonal": manipulation.diagonal, "where": manipulation.where,
+    "pad": manipulation.pad,
+    # comparison
+    "equal": comparison.equal, "not_equal": comparison.not_equal,
+    "less_than": comparison.less_than, "less_equal": comparison.less_equal,
+    "greater_than": comparison.greater_than, "greater_equal": comparison.greater_equal,
+    "logical_and": comparison.logical_and, "logical_or": comparison.logical_or,
+    "logical_not": comparison.logical_not, "allclose": comparison.allclose,
+    "isclose": comparison.isclose, "equal_all": comparison.equal_all,
+    # linalg
+    "matmul": linalg.matmul, "mm": linalg.mm, "bmm": linalg.bmm,
+    "dot": linalg.dot, "norm": linalg.norm, "cholesky": linalg.cholesky,
+    "inverse": linalg.inv,
+}
+
+
+def _inplace_variant(fn):
+    def method(self, *args, **kwargs):
+        out = fn(_tape_alias(self), *args, **kwargs)
+        self._value = out._value
+        self._grad_node = out._grad_node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+        return self
+    return method
+
+
+_INPLACE = {
+    "add_": _math.add, "subtract_": _math.subtract, "multiply_": _math.multiply,
+    "divide_": _math.divide, "clip_": _math.clip, "scale_": _math.scale,
+    "exp_": _math.exp, "sqrt_": _math.sqrt, "reciprocal_": _math.reciprocal,
+    "tanh_": _math.tanh, "fill_": None, "zero_": None,
+}
+
+
+def monkey_patch_tensor() -> None:
+    for name, fn in _BINOPS.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    Tensor.__neg__ = lambda self: _math.neg(self)
+    Tensor.__abs__ = lambda self: _math.abs(self)
+    Tensor.__invert__ = lambda self: comparison.logical_not(self)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__hash__ = lambda self: id(self)
+
+    for name, fn in _METHODS.items():
+        setattr(Tensor, name, (lambda f: lambda self, *a, **kw: f(self, *a, **kw))(fn))
+
+    for name, fn in _INPLACE.items():
+        if fn is not None:
+            setattr(Tensor, name, _inplace_variant(fn))
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    Tensor.fill_ = fill_
+    Tensor.zero_ = zero_
+
+    @property
+    def T(self):
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    Tensor.T = T

@@ -1,0 +1,150 @@
+"""Reduction ops (paddle/phi/kernels reduce family; python/paddle/tensor/math.py
+reductions; stat.py). Reductions lower to XLA reduce — MXU-adjacent VPU work
+that XLA tiles per dtype; keepdim semantics follow paddle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+
+__all__ = [
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "argmax", "argmin",
+    "all", "any", "std", "var", "median", "nanmedian", "nansum", "nanmean",
+    "logsumexp", "count_nonzero", "mode", "quantile",
+]
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+@register_op("sum", ref="paddle/phi/ops/yaml/ops.yaml:sum")
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@register_op("mean", ref="paddle/phi/ops/yaml/ops.yaml:mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@register_op("max")
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("min")
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("argmax", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    r = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return r.astype(jnp.dtype(dtype))
+
+
+@register_op("argmin", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    r = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return r.astype(jnp.dtype(dtype))
+
+
+@register_op("all", differentiable=False)
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("any", differentiable=False)
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("nanmedian")
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@register_op("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    import jax.scipy.special as sp
+    return sp.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("mode", n_outputs=2, differentiable=False)
+def mode(x, axis=-1, keepdim=False):
+    from jax import lax
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    ax = axis % x.ndim
+    # run length with segment reset: position - index of the run's start
+    same = jnp.concatenate(
+        [jnp.zeros_like(jnp.take(sorted_x, jnp.array([0]), axis=ax), dtype=jnp.int32),
+         (jnp.diff(sorted_x, axis=ax) == 0).astype(jnp.int32)], axis=ax)
+    shape = [1] * x.ndim
+    shape[ax] = n
+    pos = jnp.reshape(jnp.arange(n, dtype=jnp.int32), shape)
+    start = lax.associative_scan(jnp.maximum, jnp.where(same == 1, -1, pos), axis=ax)
+    run = pos - start + 1
+    idx = jnp.argmax(run, axis=ax, keepdims=True)
+    vals = jnp.take_along_axis(sorted_x, idx, axis=ax)
+    # index into the ORIGINAL tensor: first position holding the mode value
+    orig_idx = jnp.argmax(x == vals, axis=ax, keepdims=True)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis=ax)
+        orig_idx = jnp.squeeze(orig_idx, axis=ax)
+    return vals, orig_idx.astype(jnp.int64)
+
+
+@register_op("quantile")
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)

@@ -1,0 +1,202 @@
+"""Declarative op registry + eager dispatch.
+
+TPU-native redesign of the reference's op stack: the YAML op schema
+(paddle/phi/ops/yaml/ops.yaml) + generated C++ API (paddle/phi/api/) +
+``KernelFactory`` dispatch (paddle/phi/core/kernel_factory.cc:230) collapse
+into one table: op name -> pure-JAX implementation. "Kernel selection" is
+XLA's job; what the registry owns is
+
+- the op schema (name, impl, reference citation, custom-vjp flag),
+- eager dispatch: unwrap Tensors -> run impl -> wrap outputs,
+- autograd recording: when any input requires grad, the op is run through
+  ``jax.vjp`` and a GradNode is pushed on the tape (see autograd/tape.py),
+- optional NaN/Inf scanning (FLAGS_check_nan_inf analog,
+  paddle/fluid/eager/nan_inf_utils.cc).
+
+Every impl must be jax-traceable: the same table serves eager execution and
+``to_static``/jit tracing (one generation of the op system, not three).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import tape
+from paddle_tpu.flags import flags
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["OpDef", "register_op", "get_op", "apply_op", "OPS", "op_api"]
+
+
+class OpDef:
+    __slots__ = ("name", "impl", "ref", "n_outputs", "differentiable", "doc")
+
+    def __init__(self, name: str, impl: Callable, ref: str = "", n_outputs: int = 1,
+                 differentiable: bool = True, doc: str = ""):
+        self.name = name
+        self.impl = impl
+        self.ref = ref
+        self.n_outputs = n_outputs
+        self.differentiable = differentiable
+        self.doc = doc
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, *, ref: str = "", n_outputs: int = 1, differentiable: bool = True):
+    """Register a pure-JAX impl under `name`. Returns the user-facing API fn."""
+
+    def deco(impl: Callable):
+        opdef = OpDef(name, impl, ref=ref, n_outputs=n_outputs,
+                      differentiable=differentiable, doc=impl.__doc__ or "")
+        if name in OPS:
+            raise KeyError(f"op {name!r} registered twice")
+        OPS[name] = opdef
+
+        @functools.wraps(impl)
+        def api(*args, **kwargs):
+            return apply_op(opdef, args, kwargs)
+
+        api.op = opdef
+        return api
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OPS[name]
+
+
+def op_api(name: str) -> Callable:
+    opdef = OPS[name]
+
+    def api(*args, **kwargs):
+        return apply_op(opdef, args, kwargs)
+
+    api.__name__ = name
+    api.op = opdef
+    return api
+
+
+class _Slot:
+    """Placeholder marking a differentiable input position in the arg template."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+def _scan_args(args: Sequence[Any]) -> Tuple[list, List[Tensor]]:
+    """Split positional args into a template (with _Slot markers) + flat Tensor list.
+
+    A positional arg that is a Tensor, or a list/tuple of Tensors, is treated as a
+    differentiable input; everything else is a static attribute closed over.
+    """
+    template: list = []
+    tensors: List[Tensor] = []
+    for a in args:
+        if isinstance(a, Tensor):
+            template.append(_Slot(len(tensors)))
+            tensors.append(a)
+        elif isinstance(a, (list, tuple)) and a and all(isinstance(x, Tensor) for x in a):
+            slots = []
+            for x in a:
+                slots.append(_Slot(len(tensors)))
+                tensors.append(x)
+            template.append(slots)
+        else:
+            template.append(a)
+    return template, tensors
+
+
+def _build_args(template: list, values: Sequence[Any]) -> list:
+    out = []
+    for item in template:
+        if isinstance(item, _Slot):
+            out.append(values[item.index])
+        elif isinstance(item, list) and item and isinstance(item[0], _Slot):
+            out.append([values[s.index] for s in item])
+        else:
+            out.append(item)
+    return out
+
+
+def _wrap_outputs(opdef: OpDef, out_vals, node=None):
+    single = not isinstance(out_vals, (tuple, list))
+    vals = (out_vals,) if single else tuple(out_vals)
+    outs = []
+    for i, v in enumerate(vals):
+        t = Tensor(v, stop_gradient=node is None)
+        if node is not None:
+            t._grad_node = node
+            t._out_index = i
+        outs.append(t)
+    return outs[0] if single else tuple(outs)
+
+
+def _check_nan_inf(opdef: OpDef, vals) -> None:
+    vs = vals if isinstance(vals, (tuple, list)) else (vals,)
+    for v in vs:
+        if isinstance(v, jax.core.Tracer):
+            return  # cannot scan inside a trace; executor-level check applies
+        if hasattr(v, "dtype") and jnp.issubdtype(jnp.dtype(v.dtype), jnp.floating):
+            bad = bool(jnp.any(~jnp.isfinite(v)))
+            if bad:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{opdef.name}' "
+                    "(FLAGS_check_nan_inf)")
+
+
+def apply_op(opdef: OpDef, args: Sequence[Any], kwargs: Dict[str, Any]):
+    """Eager dispatch path (the matmul call-stack analog, SURVEY §3.1)."""
+    # unwrap any Tensor passed via kwargs (treated as non-differentiable attr)
+    kwargs = {k: (v.value if isinstance(v, Tensor) else v) for k, v in kwargs.items()}
+    template, tensors = _scan_args(args)
+
+    needs_grad = (
+        opdef.differentiable
+        and tape.is_grad_enabled()
+        and any(not t.stop_gradient for t in tensors)
+    )
+
+    values = [t._value for t in tensors]
+
+    # AMP auto-cast insertion (paddle/fluid/eager/amp_auto_cast.h analog)
+    from paddle_tpu.amp.auto_cast import amp_dtype_for_op
+    amp_dt = amp_dtype_for_op(opdef.name)
+    if amp_dt is not None:
+        values = [
+            v.astype(amp_dt)
+            if hasattr(v, "dtype") and jnp.issubdtype(jnp.dtype(v.dtype), jnp.floating)
+            and jnp.dtype(v.dtype) != jnp.dtype(amp_dt) else v
+            for v in values
+        ]
+
+    if not needs_grad:
+        out_vals = opdef.impl(*_build_args(template, values), **kwargs)
+        if flags.check_nan_inf:
+            _check_nan_inf(opdef, out_vals)
+        return _wrap_outputs(opdef, out_vals, node=None)
+
+    def closure(*primal_values):
+        return opdef.impl(*_build_args(template, primal_values), **kwargs)
+
+    out_vals, vjp_fn = jax.vjp(closure, *values)
+    if flags.check_nan_inf:
+        _check_nan_inf(opdef, out_vals)
+
+    vals = out_vals if isinstance(out_vals, (tuple, list)) else (out_vals,)
+    out_avals = [(tuple(v.shape), jnp.dtype(v.dtype)) for v in vals]
+    node = tape.GradNode(opdef.name, vjp_fn, tensors, len(vals), out_avals)
+    return _wrap_outputs(opdef, out_vals, node=node)
+
+
+def as_value(x):
+    """Coerce Tensor | array | python scalar -> jax-compatible value."""
+    return x._value if isinstance(x, Tensor) else x

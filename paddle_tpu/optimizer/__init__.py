@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (python/paddle/optimizer analog)."""
+
+from paddle_tpu.optimizer.optimizer import Adagrad, Momentum, Optimizer, RMSProp, SGD  # noqa: F401
+from paddle_tpu.optimizer.adam import Adam, AdamW, Lamb  # noqa: F401
+from paddle_tpu.optimizer import lr  # noqa: F401

@@ -1,0 +1,111 @@
+"""Adam / AdamW / Lamb (python/paddle/optimizer/{adam.py,adamw.py,lamb.py}
+analog). Master-weight behavior: accumulators and the update math are f32
+regardless of param dtype (bf16 params keep an implicit f32 view via the
+f32 moments + cast), matching the reference's multi-precision path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Lamb"]
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon, self._multi_precision)
+
+    def _init_static(self, beta1, beta2, epsilon, multi_precision):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def init_state(self, p):
+        st = {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
+              "moment2": jnp.zeros_like(p, dtype=jnp.float32),
+              "beta1_pow": jnp.ones((), jnp.float32),
+              "beta2_pow": jnp.ones((), jnp.float32)}
+        if self._multi_precision and p.dtype != jnp.float32:
+            st["master_weight"] = p.astype(jnp.float32)
+        return st
+
+    def _decayed_grad(self, g, p32, wd):
+        # Adam: L2 regularization folded into grad (paddle semantics)
+        return g + wd * p32
+
+    def _apply_decay(self, p32, lr, wd):
+        return p32
+
+    def update(self, g, st, p, lr, wd):
+        p32 = st.get("master_weight", p.astype(jnp.float32))
+        g = g.astype(jnp.float32)
+        g = self._decayed_grad(g, p32, wd)
+        b1, b2 = self._beta1, self._beta2
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        m1 = b1 * st["moment1"] + (1 - b1) * g
+        m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        p32 = self._apply_decay(p32, lr, wd)
+        new_p32 = p32 - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        new_st = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        if "master_weight" in st:
+            new_st["master_weight"] = new_p32
+        return new_p32.astype(p.dtype), new_st
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (adamw.py analog)."""
+
+    def _decayed_grad(self, g, p32, wd):
+        return g
+
+    def _apply_decay(self, p32, lr, wd):
+        return p32 * (1.0 - lr * wd)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    def _init_static(self, beta1, beta2, epsilon):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p, dtype=jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def update(self, g, st, p, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        m1 = b1 * st["moment1"] + (1 - b1) * g
+        m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m1, "moment2": m2,
+                                       "beta1_pow": b1p, "beta2_pow": b2p}

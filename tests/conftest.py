@@ -1,0 +1,18 @@
+"""Test env: force a deterministic 8-device CPU mesh before jax import.
+
+The reference validates distributed logic without clusters via Gloo/fake
+devices (SURVEY §4e); our analog is XLA's forced host-platform device count.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: outer env may point at a TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores JAX_PLATFORMS; the config knob wins
+jax.config.update("jax_platforms", "cpu")

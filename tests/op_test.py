@@ -1,0 +1,74 @@
+"""OpTest-style harness.
+
+Analog of the reference's ``OpTest`` base (test/legacy_test/op_test.py:418):
+one declaration drives (a) forward check against a numpy reference and
+(b) analytic-vs-numeric gradient comparison (get_numeric_gradient analog,
+op_test.py:148). "Multiple runtimes" here = eager dispatch vs jit-traced
+execution of the same registered op.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def numeric_grad(fn: Callable, tensors: Sequence[Tensor], wrt: int,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central-difference gradient of sum(fn(*tensors)) wrt tensors[wrt]."""
+    base = [t.numpy().astype(np.float64) for t in tensors]
+    x = base[wrt]
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        for sign in (+1, -1):
+            pert = [b.copy() for b in base]
+            pert[wrt][idx] += sign * eps
+            args = [Tensor(p.astype(np.float32)) for p in pert]
+            out = fn(*args)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            val = sum(float(np.sum(o.numpy().astype(np.float64))) for o in outs)
+            if sign > 0:
+                f_plus = val
+            else:
+                f_minus = val
+        g[idx] = (f_plus - f_minus) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_forward(fn: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                  rtol: float = 1e-5, atol: float = 1e-6, **kwargs):
+    tensors = [Tensor(np.asarray(i)) for i in inputs]
+    out = fn(*tensors, **kwargs)
+    ref = np_ref(*[np.asarray(i) for i in inputs], **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol,
+                                   err_msg=f"forward mismatch for {fn}")
+    return out
+
+
+def check_grad(fn: Callable, inputs: Sequence[np.ndarray], wrt: Sequence[int] = (0,),
+               rtol: float = 1e-2, atol: float = 1e-3, eps: float = 1e-3, **kwargs):
+    """Compare tape backward vs central differences."""
+    tensors = [Tensor(np.asarray(i, dtype=np.float32), stop_gradient=False)
+               for i in inputs]
+    out = fn(*tensors, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = outs[0].sum()
+    for o in outs[1:]:
+        loss = loss + o.sum()
+    loss.backward()
+    for i in wrt:
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(lambda *ts: fn(*ts, **kwargs), tensors, i, eps=eps)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=rtol, atol=atol,
+            err_msg=f"grad mismatch for {fn} wrt arg {i}")

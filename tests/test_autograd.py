@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = (y * 2).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.exp([1.0, 2.0]), rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    y = (a + b).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32), stop_gradient=False)
+    paddle.matmul(a, b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    a, b, c = paddle.split(x, 3)
+    (a.sum() * 1 + b.sum() * 2 + c.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_jacobian_hessian():
+    from paddle_tpu.autograd import hessian, jacobian
+
+    x = paddle.to_tensor([1.0, 2.0])
+    jac = jacobian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(jac.numpy(), [2.0, 4.0])
+    hes = hessian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(hes.numpy(), 2 * np.eye(2), atol=1e-6)
+
+
+def test_embedding_integer_input_grad():
+    w = paddle.to_tensor(np.random.rand(10, 4).astype(np.float32), stop_gradient=False)
+    idx = paddle.to_tensor([1, 3, 1])
+    from paddle_tpu.nn import functional as F
+    out = F.embedding(idx, w)
+    out.sum().backward()
+    g = w.grad.numpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 hit twice
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0.0

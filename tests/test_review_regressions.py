@@ -1,0 +1,98 @@
+"""Regressions for review findings on the core (tape self-loops, starvation,
+duplicate roots, mode, scatter, pooling ceil_mode, weighted CE, GradScaler)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_inplace_setitem_keeps_grad_flow():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    v = paddle.to_tensor([10.0], stop_gradient=False)
+    y[0] = v
+    loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+def test_inplace_add_keeps_grad_flow():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.add_(paddle.to_tensor([1.0, 1.0]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_duplicate_root_node_backward():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    vals, idx = paddle.topk(x, 3)
+    # pass two outputs of the same node as roots (idx grad is float0/none)
+    paddle.autograd.backward([vals.sum(), (vals * 2).sum()])
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), [0, 0, 0, 3, 3, 3])
+
+
+def test_mixed_path_no_starvation():
+    # one consumer contributes only non-differentiable (int) edges; the other
+    # path must still deliver gradients
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 2
+    i = b.astype("int32")  # differentiable=True op but int output -> float0
+    w = paddle.to_tensor(np.eye(8, dtype=np.float32), stop_gradient=False)
+    g = paddle.gather(w, i.astype("int32"))
+    loss = g.sum() + b.sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0, 2.0])
+
+
+def test_mode():
+    v, i = paddle.ops.reduction.mode(paddle.to_tensor([1.0, 1.0, 1.0, 2.0, 2.0]))
+    assert float(v) == 1.0
+    assert int(i) == 0
+    v2, _ = paddle.ops.reduction.mode(paddle.to_tensor([[3.0, 3.0, 1.0], [5.0, 6.0, 6.0]]), axis=-1)
+    np.testing.assert_allclose(v2.numpy(), [3.0, 6.0])
+
+
+def test_scatter_non_overwrite_zeros_first():
+    x = paddle.to_tensor([[1.0, 1.0], [2.0, 2.0]])
+    out = paddle.scatter(x, paddle.to_tensor([0]), paddle.to_tensor([[5.0, 5.0]]),
+                         overwrite=False)
+    np.testing.assert_allclose(out.numpy(), [[5.0, 5.0], [2.0, 2.0]])
+
+
+def test_max_pool_ceil_mode():
+    x = paddle.rand([1, 1, 5, 5])
+    out = F.max_pool2d(x, kernel_size=2, stride=2, ceil_mode=True)
+    assert out.shape == (1, 1, 3, 3)
+    out2 = F.max_pool2d(x, kernel_size=2, stride=2, ceil_mode=False)
+    assert out2.shape == (1, 1, 2, 2)
+
+
+def test_weighted_cross_entropy_mean():
+    logits = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    labels = paddle.to_tensor(np.array([1, 1, 1, 1]))
+    w = paddle.to_tensor(np.array([1.0, 9.0], np.float32))
+    loss = F.cross_entropy(logits, labels, weight=w)
+    # all-equal logits -> per-sample loss log(2); weighted mean == log(2)
+    np.testing.assert_allclose(float(loss), np.log(2), rtol=1e-5)
+
+
+def test_grad_scaler_no_double_unscale():
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.optimizer import SGD
+
+    p = paddle.framework.tensor.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=1.0, parameters=[p])
+    scaler = GradScaler(init_loss_scaling=8.0)
+    loss = (p * 2).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    g1 = p.grad.numpy().copy()
+    scaler.step(opt)  # must not unscale again
+    np.testing.assert_allclose(g1, [2.0])
+    np.testing.assert_allclose(p.numpy(), [-1.0])  # 1 - 1.0*2

@@ -1,0 +1,110 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == (2, 2)
+    assert t.dtype == paddle.float32
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_to_tensor_dtypes():
+    assert paddle.to_tensor([1, 2, 3]).dtype == paddle.int64 or \
+        paddle.to_tensor([1, 2, 3]).dtype == paddle.int32
+    assert paddle.to_tensor([1.0], dtype="bfloat16").dtype == paddle.bfloat16
+    assert paddle.to_tensor(True).dtype == paddle.bool_dtype
+
+
+def test_arithmetic_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((2.0 * a).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+
+
+def test_comparison_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    np.testing.assert_array_equal((a >= b).numpy(), [False, True, True])
+
+
+def test_indexing():
+    x = paddle.arange(12).reshape([3, 4])
+    np.testing.assert_array_equal(x[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_array_equal(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_array_equal(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = 1.0
+    assert float(x[0, 0]) == 1.0
+
+
+def test_methods():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert float(x.sum()) == 10.0
+    assert float(x.mean()) == 2.5
+    assert x.reshape([4]).shape == (4,)
+    assert x.T.shape == (2, 2)
+    np.testing.assert_allclose(x.T.numpy(), [[1, 3], [2, 4]])
+    assert x.astype("int32").dtype == paddle.int32
+
+
+def test_item_and_scalars():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == 3.5
+    assert float(x) == 3.5
+    assert x.size == 1
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == (2, 3)
+    assert paddle.ones([2]).dtype == paddle.float32
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), [0, 1, 2, 3, 4])
+    assert paddle.full([2, 2], 7.0).numpy()[0, 0] == 7.0
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    assert paddle.linspace(0, 1, 5).shape == (5,)
+
+
+def test_random_reproducibility():
+    paddle.seed(42)
+    a = paddle.rand([4, 4])
+    paddle.seed(42)
+    b = paddle.rand([4, 4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_detach_and_clone():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    np.testing.assert_array_equal(c.numpy(), x.numpy())
+
+
+def test_save_load(tmp_path):
+    state = {"w": paddle.rand([3, 3]), "step": 7, "nested": {"b": paddle.ones([2])}}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    np.testing.assert_array_equal(loaded["w"].numpy(), state["w"].numpy())
+    assert loaded["step"] == 7
+    np.testing.assert_array_equal(loaded["nested"]["b"].numpy(), [1, 1])
