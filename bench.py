@@ -1,0 +1,92 @@
+"""Benchmark driver: flagship Llama train-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is the
+ratio against the measured-and-recorded target in BASELINE.json when
+present, else null.
+
+Protocol (BASELINE.md): median over steady-state steps after compilation
+warmup; MFU printed as auxiliary info on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama_tp_plan
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    import jax
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    # ~134M-param Llama (GPT2-small scale); float32 for now (bf16 policy is
+    # upcoming perf work — MFU below is vs the bf16 peak, i.e. conservative)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                      num_hidden_layers=12, num_attention_heads=12,
+                      num_key_value_heads=12, max_position_embeddings=1024)
+    B, S = (8, 1024) if on_tpu else (2, 128)
+    steps = 20 if on_tpu else 3
+
+    mesh = init_mesh((1, 1, n_dev) if n_dev > 1 else (1, 1, 1), ("dp", "sep", "mp"))
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    plan = llama_tp_plan(model, mesh)
+
+    def loss_fn(m, ids, labels):
+        return m.loss(ids, labels)
+
+    trainer = ShardedTrainer(model, opt, loss_fn, mesh, plan)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (B, S))
+    labels = rng.integers(0, cfg.vocab_size, (B, S))
+
+    # NOTE: block_until_ready does not actually fence on the tunneled TPU
+    # runtime; a host fetch does. TPU executes programs FIFO, so fetching the
+    # last step's loss fences the whole timed window.
+    with mesh:
+        float(np.asarray(trainer.train_step(ids, labels).value))  # compile+warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.train_step(ids, labels)
+        float(np.asarray(loss.value))
+        total = time.perf_counter() - t0
+
+    step_time = total / steps
+    tokens_per_sec = B * S / step_time
+
+    n_params = model.num_params()
+    flops_per_step = model.flops_per_token(S) * B * S
+    achieved = flops_per_step / step_time
+    peak = {"tpu": 459e12, "cpu": 1e12}.get(jax.devices()[0].platform, 1e12)
+    print(f"step_time={step_time*1e3:.1f}ms params={n_params/1e6:.1f}M "
+          f"MFU~{achieved/ (peak*n_dev) *100:.1f}% (peak={peak/1e12:.0f}TF/chip)",
+          file=sys.stderr)
+
+    vs = None
+    try:
+        with open("BASELINE.json") as f:
+            base = json.load(f).get("published", {})
+        target = base.get("tokens_per_sec")
+        if target:
+            vs = tokens_per_sec / float(target)
+    except Exception:
+        pass
+
+    print(json.dumps({"metric": "llama_110m_train_tokens_per_sec",
+                      "value": round(tokens_per_sec, 1),
+                      "unit": "tokens/sec",
+                      "vs_baseline": vs}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+"""paddle_tpu.models — reference model families (flagship: Llama)."""
+
+from paddle_tpu.models.llama import (  # noqa: F401
+    LLAMA_7B_CONFIG, TINY_CONFIG, LlamaConfig, LlamaForCausalLM, LlamaModel,
+    llama_tp_plan,
+)

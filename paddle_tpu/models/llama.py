@@ -1,0 +1,245 @@
+"""Llama-family causal LM — the flagship model.
+
+Capability analog of the reference's hybrid-parallel Llama configs
+(test/auto_parallel/hybrid_strategy/, PaddleNLP-style modeling): RMSNorm +
+RoPE + GQA attention + SwiGLU MLP, with tensor/sequence parallelism
+expressed TPU-natively as GSPMD sharding annotations instead of
+ColumnParallelLinear/RowParallelLinear comm layers
+(fleet/layers/mpu/mp_layers.py:334,:541) — XLA inserts the
+allgather/reduce-scatter that Megatron-style code issues by hand.
+
+The module doubles as the benchmark workload (`bench.py`) and the driver
+entry (`__graft_entry__.py`).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.parallel import (
+    ProcessMesh, Replicate, Shard, get_mesh, placements_to_spec,
+)
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "llama_tp_plan",
+           "TINY_CONFIG", "LLAMA_7B_CONFIG"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+TINY_CONFIG = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=128)
+
+LLAMA_7B_CONFIG = LlamaConfig()  # Llama-2-7B dims (BASELINE.md north star)
+
+
+def _rope_tables(seq_len: int, head_dim: int, theta: float, dtype):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)            # (S, D/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+from paddle_tpu.ops.registry import register_op
+
+
+@register_op("rope", ref="paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu (capability analog)")
+def _rope_op(x, cos, sin):
+    """Rotate (B, S, H, D) by position tables (S, D/2). Interleaved halves
+    (Llama convention: split at D/2, not even/odd)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _constrain(x: Tensor, spec_entries) -> Tensor:
+    """Annotate activation sharding if a mesh is active (GSPMD's
+    with_sharding_constraint = the reference's implicit activation
+    dist_attr propagation). No-op off-mesh, so the model runs anywhere."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    names = set(mesh.dim_names)
+    entries = [e if (e in names if isinstance(e, str) else False) else None
+               for e in spec_entries]
+    if not any(entries):
+        return x
+    from paddle_tpu.ops.registry import OpDef, apply_op
+    ns = NamedSharding(mesh.jax_mesh, P(*entries))
+    opdef = OpDef("sharding_constraint",
+                  lambda v: jax.lax.with_sharding_constraint(v, ns))
+    return apply_op(opdef, (x,), {})
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, kv = config.num_attention_heads, config.num_key_value_heads
+        d = config.head_dim
+        self.q_proj = nn.Linear(config.hidden_size, h * d, bias_attr=False)
+        self.k_proj = nn.Linear(config.hidden_size, kv * d, bias_attr=False)
+        self.v_proj = nn.Linear(config.hidden_size, kv * d, bias_attr=False)
+        self.o_proj = nn.Linear(h * d, config.hidden_size, bias_attr=False)
+
+    def forward(self, hidden, cos, sin, attn_mask=None):
+        cfg = self.config
+        B, S, _ = hidden.shape
+        q = self.q_proj(hidden).reshape([B, S, cfg.num_attention_heads, cfg.head_dim])
+        k = self.k_proj(hidden).reshape([B, S, cfg.num_key_value_heads, cfg.head_dim])
+        v = self.v_proj(hidden).reshape([B, S, cfg.num_key_value_heads, cfg.head_dim])
+        # heads are the tp-sharded axis ('mp'); batch rides 'dp'
+        q = _constrain(q, ("dp", None, "mp", None))
+        k = _constrain(k, ("dp", None, "mp", None))
+        v = _constrain(v, ("dp", None, "mp", None))
+        from paddle_tpu.ops.registry import op_api
+        rope = op_api("rope")
+        q = rope(q, Tensor(cos), Tensor(sin))
+        k = rope(k, Tensor(cos), Tensor(sin))
+        rep = cfg.num_attention_heads // cfg.num_key_value_heads
+        if rep > 1:
+            k = paddle.repeat_interleave(k, rep, axis=2)
+            v = paddle.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=True, training=self.training)
+        out = out.reshape([B, S, cfg.num_attention_heads * cfg.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(config.hidden_size, config.intermediate_size, bias_attr=False)
+        self.up_proj = nn.Linear(config.hidden_size, config.intermediate_size, bias_attr=False)
+        self.down_proj = nn.Linear(config.intermediate_size, config.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        a = _constrain(F.silu(self.gate_proj(x)) * self.up_proj(x),
+                       ("dp", None, "mp"))
+        return self.down_proj(a)
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden, cos, sin, attn_mask=None):
+        hidden = hidden + self.self_attn(self.input_layernorm(hidden), cos, sin, attn_mask)
+        hidden = hidden + self.mlp(self.post_attention_layernorm(hidden))
+        # sequence parallelism: between blocks activations shard S over 'sep'
+        return _constrain(hidden, ("dp", "sep", None))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        cfg = self.config
+        S = input_ids.shape[1]
+        dt = jnp.dtype(cfg.dtype)
+        cos, sin = _rope_tables(S, cfg.head_dim, cfg.rope_theta, dt)
+        hidden = self.embed_tokens(input_ids)
+        hidden = _constrain(hidden, ("dp", "sep", None))
+        for layer in self.layers:
+            hidden = layer(hidden, cos, sin, attn_mask)
+        return self.norm(hidden)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        hidden = self.model(input_ids, attn_mask)
+        if self.lm_head is None:
+            w = self.model.embed_tokens.weight  # (V, H)
+            return paddle.matmul(hidden, w.t())
+        return self.lm_head(hidden)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Train-step FLOPs per token: 6N matmul (fwd+bwd) plus the
+        attention score/value term 12·L·H·S (PaLM appendix-B accounting)."""
+        cfg = self.config
+        return (6 * self.num_params()
+                + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len)
+
+
+def llama_tp_plan(model: LlamaForCausalLM, mesh: ProcessMesh) -> Dict[str, Sequence]:
+    """Megatron-parity tensor-parallel plan as placements per param name.
+
+    Column-parallel (shard output dim=1 of (in,out) weights): q/k/v, gate/up.
+    Row-parallel (shard input dim=0): o_proj, down_proj.
+    Vocab-parallel embedding: shard vocab dim 0; lm_head shard output.
+    Norm weights replicate. Reference layers being replaced:
+    fleet/layers/mpu/mp_layers.py:47 (VocabParallelEmbedding), :334
+    (ColumnParallelLinear), :541 (RowParallelLinear).
+    """
+    mp_axis = mesh.dim_names.index("mp") if "mp" in mesh.dim_names else None
+    plan: Dict[str, Sequence] = {}
+    for name, _p in model.named_parameters():
+        pls = [Replicate()] * mesh.ndim
+        if mp_axis is not None:
+            if any(k in name for k in ("q_proj", "k_proj", "v_proj",
+                                       "gate_proj", "up_proj")) and name.endswith("weight"):
+                pls[mp_axis] = Shard(1)
+            elif any(k in name for k in ("o_proj", "down_proj")) and name.endswith("weight"):
+                pls[mp_axis] = Shard(0)
+            elif "embed_tokens" in name:
+                pls[mp_axis] = Shard(0)
+            elif "lm_head" in name and name.endswith("weight"):
+                pls[mp_axis] = Shard(1)
+        plan[name] = pls
+    return plan

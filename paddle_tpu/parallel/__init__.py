@@ -1,0 +1,18 @@
+"""paddle_tpu.parallel — device mesh, placements, and the GSPMD tensor API.
+
+The TPU-native core that replaces the reference's DistTensor + SPMD-rule +
+reshard machinery (paddle/phi/core/distributed/auto_parallel/) with
+jax.sharding meshes and XLA sharding propagation. Higher-level surfaces
+(paddle_tpu.distributed.*) build on this.
+"""
+
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    ProcessMesh, auto_mesh, get_mesh, init_mesh, set_mesh,
+)
+from paddle_tpu.parallel.placements import (  # noqa: F401
+    Partial, Placement, ReduceType, Replicate, Shard,
+)
+from paddle_tpu.parallel.api import (  # noqa: F401
+    dtensor_from_fn, local_shape, named_sharding, placements_to_spec,
+    reshard, shard_layer, shard_tensor, spec_to_placements, unshard,
+)

@@ -1,0 +1,154 @@
+"""ProcessMesh — the device mesh.
+
+Analog of the reference's ``ProcessMesh``
+(paddle/phi/core/distributed/auto_parallel/process_mesh.h and
+python/paddle/distributed/auto_parallel/process_mesh.py) redesigned around
+``jax.sharding.Mesh``: an N-D arrangement of devices with named axes. On
+TPU the mesh layout determines which collectives ride ICI vs DCN; XLA's
+GSPMD partitioner inserts the collectives, so the mesh (not a ProcessGroup
+object per ring) is the unit of communication topology.
+
+A global "current mesh" supports the auto-parallel API
+(``shard_tensor`` etc. default to it), mirroring the reference's implicit
+default process group.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "init_mesh", "get_mesh", "set_mesh", "auto_mesh"]
+
+_GLOBAL_MESH: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """N-D named device mesh. ``dim_names`` follow the reference's hybrid
+    axis conventions: dp / pp / sharding / sep / mp (fleet/base/topology.py:65),
+    but any names are accepted."""
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = tuple(mesh.axis_names)
+            return
+        devices = np.asarray(jax.devices())
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            shape = arr.shape
+            process_ids = arr.reshape(-1)
+        if shape is None:
+            shape = (len(np.asarray(process_ids).reshape(-1))
+                     if process_ids is not None else devices.size,)
+        shape = tuple(int(s) for s in shape)
+        if dim_names is None:
+            dim_names = tuple(f"d{i}" for i in range(len(shape)))
+        dim_names = tuple(dim_names)
+        if process_ids is not None:
+            ids = np.asarray(process_ids).reshape(-1)
+            devs = devices[ids]
+        else:
+            n = int(np.prod(shape))
+            if n > devices.size:
+                raise ValueError(
+                    f"mesh shape {shape} needs {n} devices, have {devices.size}")
+            devs = devices[:n]
+        self._jax_mesh = Mesh(devs.reshape(shape), dim_names)
+        self._shape = shape
+        self._dim_names = dim_names
+
+    # -- reference-parity surface -------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [d.id for d in self._jax_mesh.devices.reshape(-1)]
+
+    @property
+    def mesh(self):
+        return np.array([d.id for d in self._jax_mesh.devices.reshape(-1)]).reshape(self._shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._shape))
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def dim_size(self, name) -> int:
+        if isinstance(name, str):
+            return self._shape[self._dim_names.index(name)]
+        return self._shape[name]
+
+    def get_dim_size(self, name) -> int:
+        return self.dim_size(name)
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id: int) -> int:
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        flat = [d.id for d in self._jax_mesh.devices.reshape(-1)]
+        coord = np.unravel_index(flat.index(process_id), self._shape)
+        return int(coord[axis])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and self.process_ids == other.process_ids)
+
+    def __hash__(self):
+        return hash((self._shape, self._dim_names, tuple(self.process_ids)))
+
+    def __enter__(self):
+        self._prev = _GLOBAL_MESH
+        set_mesh(self)
+        self._ctx = self._jax_mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._jax_mesh.__exit__(*exc)
+        set_mesh(self._prev)
+        return False
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={list(self._shape)}, dim_names={list(self._dim_names)})"
+
+
+def init_mesh(shape: Sequence[int], dim_names: Sequence[str]) -> ProcessMesh:
+    """Create a mesh over the local devices and install it as the default."""
+    m = ProcessMesh(shape=shape, dim_names=dim_names)
+    set_mesh(m)
+    return m
+
+
+def set_mesh(mesh: Optional[ProcessMesh]) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH
+
+
+def auto_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sep: int = 1) -> ProcessMesh:
+    """Build a hybrid mesh [dp, pp, sep, mp] like HybridCommunicateGroup's
+    rank topology (fleet/base/topology.py:178); axes of size 1 are kept so
+    sharding specs can always name them."""
+    return ProcessMesh(shape=(dp, pp, sep, mp), dim_names=("dp", "pp", "sep", "mp"))

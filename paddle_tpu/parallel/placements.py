@@ -1,0 +1,102 @@
+"""Placement types for distributed (global-view) tensors.
+
+TPU-native analog of the reference's placement model
+(paddle/phi/core/distributed/auto_parallel/placement_types.h): a tensor's
+distribution over an N-D ProcessMesh is one placement per mesh dimension —
+``Shard(dim)`` (tensor dim split over that mesh axis), ``Replicate()``
+(full copy per device along that axis), or ``Partial(op)`` (each device
+holds an unreduced partial term; reduction pending).
+
+On TPU the Shard/Replicate cases lower directly to a
+``jax.sharding.NamedSharding`` PartitionSpec; ``Partial`` is metadata the
+XLA sharding system has internally but does not expose, so we carry it on
+the Tensor and materialize it with a compiled ``psum`` at reshard time —
+mirroring how the reference's PToRReshardFunction issues an allreduce
+(paddle/phi/core/distributed/auto_parallel/reshard/p_to_r_reshard_function.cc).
+"""
+
+from __future__ import annotations
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "ReduceType"]
+
+
+class ReduceType:
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+    kRedAny = "any"
+    kRedAll = "all"
+
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    """Tensor dimension `dim` is split across this mesh axis."""
+
+    __slots__ = ("dim",)
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    __slots__ = ()
+
+    def is_replicated(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Each device along this mesh axis holds an unreduced partial value."""
+
+    __slots__ = ("reduce_type",)
+
+    def __init__(self, reduce_type: str = ReduceType.kRedSum):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"

@@ -1,0 +1,200 @@
+"""Sharded training step: nn.Layer + Optimizer -> one compiled SPMD program.
+
+TPU-native replacement for the reference's whole distributed runtime around
+a train step — EagerReducer bucketed allreduce (collective/reducer.h:88),
+HybridParallelOptimizer grad sync (hybrid_parallel_optimizer.py:255), and
+the semi-auto Engine/Parallelizer pipeline (auto_parallel/static/engine.py:62):
+the model is lifted to a pure fn(params, batch), differentiated with
+jax.grad, the optimizer's functional update is applied, and the whole step
+is jit-compiled over a mesh with NamedShardings on every param. XLA's SPMD
+partitioner inserts the reduce-scatter/allreduce that the reference issues
+by hand; donated buffers give in-place param/optimizer-state updates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.parallel.api import named_sharding, placements_to_spec
+from paddle_tpu.parallel.mesh import ProcessMesh
+from paddle_tpu.parallel.placements import Replicate, Shard
+
+__all__ = ["ShardedTrainer", "sharded_data_spec"]
+
+
+def _apply_grad_clip(clip, grads: dict) -> dict:
+    """Functional (jit-safe) form of the nn.clip classes; global-norm clip
+    matches HybridParallelClipGrad semantics (hybrid_parallel_optimizer.py:41)
+    — with GSPMD the cross-group norm allreduce is implicit in the sharded sum."""
+    from paddle_tpu.nn.clip import (
+        ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+    )
+    if clip is None:
+        return grads
+    if isinstance(clip, ClipGradByGlobalNorm):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in grads.values()))
+        scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+        return {n: (g * scale).astype(g.dtype) for n, g in grads.items()}
+    if isinstance(clip, ClipGradByNorm):
+        out = {}
+        for n, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            s = jnp.minimum(clip.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out[n] = (g * s).astype(g.dtype)
+        return out
+    if isinstance(clip, ClipGradByValue):
+        return {n: jnp.clip(g, clip.min, clip.max) for n, g in grads.items()}
+    raise NotImplementedError(f"grad clip {type(clip).__name__} in compiled step")
+
+
+def sharded_data_spec(mesh: ProcessMesh, batch_axes=("dp",)) -> P:
+    """Batch dim sharded over the data-parallel mesh axes."""
+    axes = tuple(a for a in batch_axes if a in mesh.dim_names)
+    return P(axes if len(axes) > 1 else (axes[0] if axes else None))
+
+
+class ShardedTrainer:
+    """Compile-once distributed trainer.
+
+    ``plan`` maps param name -> placements (one per mesh dim); unknown names
+    replicate. ``loss_fn(model, *batch) -> scalar Tensor`` drives the forward
+    pass (the model's params are transparently swapped for traced values).
+    Optimizer state inherits each param's sharding (ZeRO-free default;
+    sharding-stage variants remap these in distributed.sharding).
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Callable,
+                 mesh: ProcessMesh, plan: Optional[Dict[str, Sequence]] = None,
+                 data_spec: Optional[P] = None, donate: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.plan = plan or {}
+        self.data_spec = data_spec if data_spec is not None else sharded_data_spec(mesh)
+        self._step = None
+
+        state = dict(model.state_dict())
+        for name, b in model.named_buffers():
+            state.setdefault(name, b)
+        self.state_names = tuple(state.keys())
+        self.trainable = tuple(
+            n for n, p in model.named_parameters() if not p.stop_gradient)
+        self._tensors = state
+
+        # place every param/buffer per plan (replicate by default)
+        self.shardings: Dict[str, NamedSharding] = {}
+        for name, t in state.items():
+            pls = list(self.plan.get(name, [Replicate()] * mesh.ndim))
+            sh = named_sharding(mesh, pls, ndim=t.ndim)
+            t._set_value(jax.device_put(t._value, sh))
+            t._placements = pls
+            t._process_mesh = mesh
+            self.shardings[name] = sh
+
+        # functional optimizer state, sharded like its param
+        self.opt_state = {}
+        self.opt_shardings = {}
+        for name in self.trainable:
+            p = state[name]
+            st = optimizer.init_state(p.value)
+            pst, psh = {}, {}
+            for k, v in st.items():
+                sh = (self.shardings[name] if getattr(v, "shape", ()) == tuple(p.shape)
+                      else NamedSharding(mesh.jax_mesh, P()))
+                pst[k] = jax.device_put(v, sh)
+                psh[k] = sh
+            self.opt_state[name] = pst
+            self.opt_shardings[name] = psh
+
+    # -- compiled step ------------------------------------------------------
+    def _build(self, n_batch: int):
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        state_names, trainable = self.state_names, self.trainable
+        wd = getattr(opt, "_weight_decay", 0.0) or 0.0
+
+        def step(params, buffers, opt_state, lr, *batch):
+            def compute_loss(train_params):
+                full = dict(buffers)
+                full.update(train_params)
+                from paddle_tpu.autograd import tape
+                with tape.no_grad():
+                    # swap param values for traced ones; loss_fn drives forward
+                    state = dict(model.state_dict())
+                    for n, b in model.named_buffers():
+                        state.setdefault(n, b)
+                    originals = []
+                    try:
+                        for n, t in state.items():
+                            if n in full:
+                                originals.append((t, t._value))
+                                t._value = full[n]
+                        loss = loss_fn(model, *[Tensor(b) for b in batch])
+                    finally:
+                        for t, v in originals:
+                            t._value = v
+                return loss._value if isinstance(loss, Tensor) else loss
+
+            loss, grads = jax.value_and_grad(compute_loss)(params)
+            grads = _apply_grad_clip(getattr(opt, "_grad_clip", None), grads)
+            new_params, new_opt = {}, {}
+            for name in trainable:
+                g = grads[name]
+                p, st = params[name], opt_state[name]
+                new_p, new_st = opt.update(g, st, p, lr, wd)
+                new_params[name] = new_p
+                new_opt[name] = new_st
+            return new_params, new_opt, loss
+
+        in_shardings = (
+            {n: self.shardings[n] for n in trainable},
+            {n: self.shardings[n] for n in state_names if n not in trainable},
+            self.opt_shardings,
+            NamedSharding(self.mesh.jax_mesh, P()),
+        ) + tuple(NamedSharding(self.mesh.jax_mesh, self.data_spec)
+                  for _ in range(n_batch))
+        out_shardings = (
+            {n: self.shardings[n] for n in trainable},
+            self.opt_shardings,
+            NamedSharding(self.mesh.jax_mesh, P()),
+        )
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 2))
+
+    def train_step(self, *batch) -> Tensor:
+        """Run one step; updates model params + optimizer state in place."""
+        vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        vals = [jax.device_put(v, NamedSharding(self.mesh.jax_mesh, self.data_spec))
+                for v in vals]
+        if self._step is None:
+            self._step = self._build(len(vals))
+        params = {n: self._tensors[n]._value for n in self.trainable}
+        buffers = {n: self._tensors[n]._value for n in self.state_names
+                   if n not in self.trainable}
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        new_params, new_opt, loss = self._step(params, buffers, self.opt_state, lr, *vals)
+        for n in self.trainable:
+            self._tensors[n]._set_value(new_params[n])
+        self.opt_state = new_opt
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    def compile_lowered(self, *batch_shapes_dtypes):
+        """AOT-lower the step (for dryrun/compile checks without execution)."""
+        import numpy as np
+        vals = [jnp.zeros(s, d) for s, d in batch_shapes_dtypes]
+        if self._step is None:
+            self._step = self._build(len(vals))
+        params = {n: self._tensors[n]._value for n in self.trainable}
+        buffers = {n: self._tensors[n]._value for n in self.state_names
+                   if n not in self.trainable}
+        lr = jnp.asarray(0.0, dtype=jnp.float32)
+        return self._step.lower(params, buffers, self.opt_state, lr, *vals)
