@@ -1,0 +1,51 @@
+"""paddle_tpu.distributed — the distributed training surface.
+
+Parity map to python/paddle/distributed/ (SURVEY §2.3):
+- communication API (D1)            -> .collective
+- env init / DataParallel (D2)      -> .parallel
+- fleet facade + topology (D4)      -> .fleet
+- tensor parallel layers (D5)       -> .fleet.meta_parallel
+- pipeline parallel (D6)            -> .pipeline + .fleet.meta_parallel
+- sharding / ZeRO (D7)              -> .sharding
+- sequence parallel (D8)            -> .fleet.sequence_parallel_utils
+- recompute (D10)                   -> .recompute
+- semi-auto parallel (D11)          -> re-exported from paddle_tpu.parallel
+- dist checkpoint (D17)             -> .checkpoint
+- launcher (D3)                     -> .launch (python -m paddle_tpu.distributed.launch)
+"""
+
+from paddle_tpu.parallel import (  # noqa: F401  (semi-auto API, D11)
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    get_mesh, init_mesh, reshard, shard_layer, shard_tensor, unshard,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    alltoall, barrier, broadcast, destroy_process_group, gather, get_group,
+    irecv, isend, new_group, recv, reduce, reduce_scatter, scatter, send,
+    stack_for_group, unstack_from_group,
+)
+from paddle_tpu.distributed.parallel import (  # noqa: F401
+    DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
+    is_initialized,
+)
+from paddle_tpu.distributed.recompute import recompute, recompute_sequential  # noqa: F401
+from paddle_tpu.distributed.sharding import group_sharded_parallel  # noqa: F401
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.fleet import DistributedStrategy  # noqa: F401
+
+
+def get_mesh_or_init():
+    m = get_mesh()
+    if m is None:
+        init_parallel_env()
+        m = get_mesh()
+    return m
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("checkpoint", "launch", "pipeline", "auto_parallel"):
+        mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

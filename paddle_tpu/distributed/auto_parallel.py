@@ -1,0 +1,117 @@
+"""Semi-auto parallel namespace (python/paddle/distributed/auto_parallel/).
+
+The dygraph semi-auto API (api.py: shard_tensor:130, reshard:346,
+shard_layer:445, dtensor_from_fn:312) lives in paddle_tpu.parallel; this
+module is the reference-compatible namespace plus `to_static`, which turns
+a sharded Layer + loss + optimizer into a compiled DistModel
+(auto_parallel/api.py:2096 `to_static` -> DistModel over Engine — here the
+"Engine/Parallelizer/Partitioner/Resharder" pipeline is XLA's GSPMD
+partitioner, reached through parallel.train.ShardedTrainer)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from paddle_tpu.parallel import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    get_mesh, reshard, shard_layer, shard_tensor, unshard,
+)
+from paddle_tpu.distributed.fleet.strategy import Strategy  # noqa: F401
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn", "unshard",
+    "Strategy", "to_static", "DistModel", "shard_optimizer",
+]
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """api.py:1120 analog: mark the optimizer's state for sharded init.
+    With ShardedTrainer, states inherit param placements automatically;
+    shard_fn (ShardingStage1/2/3 style) may set a ZeRO stage instead."""
+    if shard_fn is not None:
+        stage = getattr(shard_fn, "stage", None)
+        if stage:
+            optimizer._zero_stage = int(stage)
+    return optimizer
+
+
+class ShardingStage1:
+    stage = 1
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+
+class ShardingStage2:
+    stage = 2
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+
+class ShardingStage3:
+    stage = 3
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+
+class DistModel:
+    """api.py:1631 DistModel analog: __call__ runs the compiled sharded
+    train step when (loss, optimizer) were given, else compiled eval."""
+
+    def __init__(self, layer, loader=None, loss_fn: Optional[Callable] = None,
+                 optimizer=None, strategy: Optional[Strategy] = None,
+                 plan: Optional[dict] = None):
+        self.network = layer
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._mode = "train" if optimizer is not None else "predict"
+        mesh = get_mesh()
+        if mesh is None:
+            raise RuntimeError("to_static requires an active mesh "
+                               "(use `with mesh:` or init fleet topology)")
+        self._trainer = None
+        if optimizer is not None and loss_fn is not None:
+            from paddle_tpu.parallel.train import ShardedTrainer
+
+            def wrapped_loss(model, *batch):
+                out = model(*batch[:-1])
+                return loss_fn(out, batch[-1])
+
+            self._trainer = ShardedTrainer(layer, optimizer, wrapped_loss,
+                                           mesh, plan or {})
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *batch):
+        if self._mode == "train":
+            if self._trainer is None:
+                raise RuntimeError("DistModel built without loss/optimizer")
+            return self._trainer.train_step(*batch)
+        from paddle_tpu.autograd import tape
+        with tape.no_grad():
+            out = self.network(*batch[:-1] if self._mode == "eval" else batch)
+            if self._mode == "eval" and self._loss_fn is not None:
+                return self._loss_fn(out, batch[-1])
+            return out
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def dist_main_program(self, mode=None):  # parity stub: IR is XLA-side
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              plan=None) -> DistModel:
+    """api.py:2096 analog."""
+    return DistModel(layer, loader, loss, optimizer, strategy, plan)

@@ -1,0 +1,93 @@
+"""Distributed checkpoint: save/load with reshard-on-load.
+
+Redesign of python/paddle/distributed/checkpoint/ (save_state_dict.py,
+load_state_dict.py, metadata.py): the reference has every rank write its
+local shards plus a global metadata file mapping logical tensor slices to
+files, and rebuilds other topologies at load via slice + p2p assembly.
+
+Single-controller TPU form: the controller holds global-view tensors, so a
+checkpoint is {flat metadata json} + one .npz per host with the tensors'
+global values (written shard-by-shard host-side to bound memory); load
+reshards by simply device_put-ing with the *target* mesh/placements —
+cross-topology resume (tp4 -> tp2 etc.) falls out of the global view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.parallel.api import shard_tensor
+from paddle_tpu.parallel.mesh import ProcessMesh, get_mesh
+from paddle_tpu.parallel.placements import Replicate, Shard
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+_META = "metadata.json"
+_DATA = "data_{rank}.npz"
+
+
+def _placement_meta(p):
+    if isinstance(p, Shard):
+        return {"kind": "shard", "dim": p.dim}
+    return {"kind": "replicate"}
+
+
+def _placement_from_meta(m):
+    return Shard(m["dim"]) if m.get("kind") == "shard" else Replicate()
+
+
+def save_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank: int = 0) -> None:
+    """checkpoint/save_state_dict.py analog."""
+    os.makedirs(path, exist_ok=True)
+    import jax
+    rank = jax.process_index()
+    meta = {"version": 1, "tensors": {}}
+    arrays = {}
+    for name, t in state_dict.items():
+        if not isinstance(t, Tensor):
+            t = Tensor(t)
+        arrays[name] = np.asarray(t.value)
+        entry = {"shape": list(t.shape), "dtype": str(t.dtype),
+                 "file": _DATA.format(rank=rank)}
+        if t._placements is not None:
+            entry["placements"] = [_placement_meta(p) for p in t._placements]
+            entry["mesh_shape"] = t._process_mesh.shape
+            entry["mesh_dims"] = t._process_mesh.dim_names
+        meta["tensors"][name] = entry
+    np.savez(os.path.join(path, _DATA.format(rank=rank)), **arrays)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, _META), "w") as f:
+            json.dump(meta, f)
+
+
+def load_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, offload: bool = False) -> None:
+    """checkpoint/load_state_dict.py analog: fill `state_dict`'s tensors
+    in place, resharding saved values onto each destination tensor's
+    current mesh/placements (which may differ from the saved topology)."""
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    cache: Dict[str, np.lib.npyio.NpzFile] = {}
+    for name, t in state_dict.items():
+        entry = meta["tensors"].get(name)
+        if entry is None:
+            raise KeyError(f"tensor {name!r} not in checkpoint {path}")
+        fname = entry["file"]
+        if fname not in cache:
+            cache[fname] = np.load(os.path.join(path, fname))
+        arr = cache[fname][name]
+        if tuple(arr.shape) != tuple(t.shape):
+            raise ValueError(
+                f"{name}: checkpoint shape {arr.shape} != target {tuple(t.shape)}")
+        if t._placements is not None and t._process_mesh is not None:
+            new = shard_tensor(arr, t._process_mesh, t._placements)
+            t._set_value(new.value)
+        else:
+            import jax.numpy as jnp
+            t._set_value(jnp.asarray(arr, dtype=t.dtype))

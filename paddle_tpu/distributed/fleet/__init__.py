@@ -1,0 +1,101 @@
+"""fleet — the hybrid-parallel facade.
+
+Analog of python/paddle/distributed/fleet/ (fleet.py:100 `Fleet`):
+`init(strategy)` builds the hybrid mesh topology, `distributed_model` /
+`distributed_optimizer` wrap model and optimizer per strategy. On TPU the
+wrappers attach GSPMD sharding plans instead of comm-hook machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.topology import (
+    CommunicateTopology, HybridCommunicateGroup,
+)
+
+__all__ = [
+    "init", "DistributedStrategy", "HybridCommunicateGroup",
+    "CommunicateTopology", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "worker_index", "worker_num", "fleet",
+]
+
+_HCG: Optional[HybridCommunicateGroup] = None
+_STRATEGY: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = False,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init analog (fleet.py:167)."""
+    global _HCG, _STRATEGY
+    strategy = strategy or DistributedStrategy()
+    _STRATEGY = strategy
+    conf = strategy.hybrid_configs
+    _HCG = HybridCommunicateGroup(
+        dp_degree=conf.get("dp_degree", 1),
+        mp_degree=conf.get("mp_degree", 1),
+        pp_degree=conf.get("pp_degree", 1),
+        sharding_degree=conf.get("sharding_degree", 1),
+        sep_degree=conf.get("sep_degree", 1),
+    )
+    from paddle_tpu.distributed.parallel import init_parallel_env  # noqa: F401
+    import paddle_tpu.distributed.parallel as _p
+    _p._INITIALIZED = True
+    return _HCG
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG
+
+
+def distributed_model(model):
+    """fleet/model.py:32 analog: wrap per strategy. TP/DP need no wrapper
+    (sharding plans do the work); PP wraps in PipelineParallel."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallel, TensorParallel,
+    )
+    if _HCG is None:
+        raise RuntimeError("call fleet.init() first")
+    if _HCG.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, _HCG, _STRATEGY)
+    if _HCG.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, _HCG, _STRATEGY)
+    from paddle_tpu.distributed.parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.py:1302 analog: attach hybrid grad sync. With GSPMD the clip /
+    grad sync live in the compiled step; sharding-stage wrappers come from
+    distributed.sharding."""
+    conf = (strategy or _STRATEGY or DistributedStrategy()).hybrid_configs
+    if conf.get("sharding_degree", 1) > 1:
+        from paddle_tpu.distributed.sharding import DygraphShardingOptimizer
+        return DygraphShardingOptimizer(optimizer, _HCG)
+    return optimizer
+
+
+def worker_index() -> int:
+    from paddle_tpu.distributed.parallel import get_rank
+    return get_rank()
+
+
+def worker_num() -> int:
+    import jax
+    return jax.process_count()
+
+
+class _FleetModule:
+    """`from paddle_tpu.distributed import fleet; fleet.init(...)` surface."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    DistributedStrategy = DistributedStrategy
+
+
+fleet = _FleetModule()

@@ -1,0 +1,320 @@
+"""meta_parallel: TP wrapper + Megatron-parity parallel layers.
+
+Redesign of fleet/meta_parallel/ + fleet/layers/mpu/:
+
+- ``VocabParallelEmbedding`` (mp_layers.py:47), ``ColumnParallelLinear``
+  (:334), ``RowParallelLinear`` (:541), ``ParallelCrossEntropy`` — same
+  constructor surface, but instead of manual identity/allreduce PyLayers
+  the weights carry GSPMD shardings over the hybrid mesh's 'mp' axis and
+  activations get sharding constraints; XLA inserts the
+  allgather/reduce-scatter (including the sequence-parallel variants that
+  the reference hand-rolls in sequence_parallel_utils.py).
+- ``TensorParallel``/``PipelineLayer``/``PipelineParallel`` wrappers keep
+  the fleet.distributed_model contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.parallel import Replicate, Shard, get_mesh, shard_tensor
+
+__all__ = [
+    "TensorParallel", "VocabParallelEmbedding", "ColumnParallelLinear",
+    "RowParallelLinear", "ParallelCrossEntropy", "PipelineLayer",
+    "LayerDesc", "SharedLayerDesc", "PipelineParallel",
+    "get_rng_state_tracker", "RNGStatesTracker",
+]
+
+
+def _mp_axis_placements(mesh, tensor_dim: int):
+    pls = [Replicate()] * mesh.ndim
+    if "mp" in mesh.dim_names:
+        pls[mesh.dim_names.index("mp")] = Shard(tensor_dim)
+    return pls
+
+
+def _maybe_shard_param(param, tensor_dim: int):
+    mesh = get_mesh()
+    if mesh is None or "mp" not in mesh.dim_names:
+        return param
+    sharded = shard_tensor(param, mesh, _mp_axis_placements(mesh, tensor_dim))
+    param._set_value(sharded.value)
+    param._placements = sharded._placements
+    param._process_mesh = sharded._process_mesh
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """mp_layers.py:47 — embedding table sharded over vocab (dim 0)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.embedding = nn.Embedding(num_embeddings, embedding_dim,
+                                      weight_attr=weight_attr)
+        _maybe_shard_param(self.embedding.weight, 0)
+
+    @property
+    def weight(self):
+        return self.embedding.weight
+
+    def forward(self, x):
+        return self.embedding(x)
+
+
+class ColumnParallelLinear(Layer):
+    """mp_layers.py:334 — weight (in, out) sharded on out; output stays
+    mp-sharded when gather_output=False (the transformer fast path)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        bias_attr = None if (has_bias or has_bias is None) else False
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr, bias_attr=bias_attr)
+        _maybe_shard_param(self.linear.weight, 1)
+        if self.linear.bias is not None:
+            _maybe_shard_param(self.linear.bias, 0)
+        self.gather_output = gather_output
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        out = self.linear(x)
+        if self.gather_output:
+            from paddle_tpu.parallel import reshard
+            mesh = get_mesh()
+            if mesh is not None and out.is_dist:
+                out = reshard(out, mesh, [Replicate()] * mesh.ndim)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """mp_layers.py:541 — weight (in, out) sharded on in; XLA emits the
+    partial-sum allreduce the reference issues manually."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        bias_attr = None if has_bias else False
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr, bias_attr=bias_attr)
+        _maybe_shard_param(self.linear.weight, 0)
+        self.input_is_parallel = input_is_parallel
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        return self.linear(x)
+
+
+class ParallelCrossEntropy(Layer):
+    """mp_layers.py ParallelCrossEntropy — with a vocab-sharded logits
+    tensor the softmax reduction compiles to the cross-mp allreduce."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class TensorParallel(Layer):
+    """meta_parallel/tensor_parallel.py analog: in the reference this
+    broadcasts mp params at init; GSPMD placements make that implicit."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# pipeline structure (schedules in distributed/pipeline.py)
+# ---------------------------------------------------------------------------
+
+class LayerDesc:
+    """parallel_layers/pp_layers.py LayerDesc: deferred layer construction."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """pp_layers.py:76 — layers shared across stages (tied embeddings)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """pp_layers.py:257 `PipelineLayer`: a list of LayerDescs partitioned
+    into stages. TPU redesign: all stages live in one process; the stage
+    axis maps to the mesh 'pp' axis at schedule time."""
+
+    def __init__(self, layers, num_stages=None, loss_fn=None,
+                 topology=None, seg_method="uniform", recompute_interval=0,
+                 **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+        built = []
+        self._shared: dict = {}
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.key in self._shared:
+                    built.append(_SharedRef(self._shared[d.key], d))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.key] = layer
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer) or callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"bad pipeline desc {d!r}")
+        self.run_order = built
+        self._layerlist = nn.LayerList([x for x in built if isinstance(x, Layer)])
+        # uniform segmentation (SegmentLayers:92 analog)
+        n = len(built)
+        per = max(1, n // self.num_stages)
+        self.stage_bounds = [min(i * per, n) for i in range(self.num_stages)] + [n]
+
+    def get_stage_layers(self, stage: int):
+        lo, hi = self.stage_bounds[stage], self.stage_bounds[stage + 1]
+        return self.run_order[lo:hi]
+
+    def forward(self, x):
+        from paddle_tpu.distributed.recompute import recompute
+        for i, layer in enumerate(self.run_order):
+            if (self.recompute_interval and isinstance(layer, Layer)
+                    and i % self.recompute_interval == 0):
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+
+class _SharedRef:
+    """Second occurrence of a SharedLayerDesc: run forward_func with the
+    shared layer's weight (tied-embedding head)."""
+
+    def __init__(self, layer, desc):
+        self.layer = layer
+        self.desc = desc
+
+    def __call__(self, x):
+        if self.desc.forward_func is not None:
+            return self.desc.forward_func(self.layer, x)
+        return self.layer(x)
+
+
+class PipelineParallel(Layer):
+    """meta_parallel/pipeline_parallel.py wrapper; train_batch dispatches to
+    the schedule runner in distributed/pipeline.py."""
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        conf = (strategy.pipeline_configs if strategy is not None else
+                {"accumulate_steps": 1, "schedule_mode": "1F1B"})
+        self.accumulate_steps = conf.get("accumulate_steps", 1)
+        self.schedule_mode = conf.get("schedule_mode", "1F1B")
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from paddle_tpu.distributed.pipeline import pipeline_train_batch
+        return pipeline_train_batch(self._layers, data, optimizer,
+                                    micro_batches=self.accumulate_steps,
+                                    schedule=self.schedule_mode, scaler=scaler)
+
+
+# ---------------------------------------------------------------------------
+# RNG state tracker (mpu/random.py) — determinism for parallel dropout
+# ---------------------------------------------------------------------------
+
+class RNGStatesTracker:
+    """mpu/random.py RNGStatesTracker analog over functional PRNG keys."""
+
+    def __init__(self):
+        self.states: dict = {}
+
+    def add(self, name, seed):
+        import jax
+        if name in self.states:
+            raise ValueError(f"state {name} already exists")
+        self.states[name] = jax.random.PRNGKey(seed)
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            from paddle_tpu.framework import random as rnd
+            if name not in self.states:
+                self.add(name, hash(name) % (2 ** 31))
+            key = self.states[name]
+            import jax
+            key, sub = jax.random.split(key)
+            self.states[name] = key
+            rnd.push_trace_key(sub)
+            try:
+                yield
+            finally:
+                rnd.pop_trace_key()
+
+        return ctx()
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

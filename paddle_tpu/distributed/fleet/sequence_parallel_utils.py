@@ -1,0 +1,110 @@
+"""Sequence parallelism utilities.
+
+Redesign of fleet/utils/sequence_parallel_utils.py: the reference
+implements SP with four hand-written PyLayers (ScatterOp:85, GatherOp,
+AllGatherOp, ReduceScatterOp) plus Column/RowSequenceParallelLinear that
+interleave comm with matmul. TPU-natively, sequence parallelism is a
+*sharding choice on the sequence dim* over the mesh 'sep' (or 'mp') axis;
+the functions below exist for API parity and express the transitions as
+reshards — XLA emits the same allgather/reduce-scatter, fused into the
+surrounding matmuls.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.parallel import Replicate, Shard, get_mesh, reshard
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+
+def _sp_axis():
+    mesh = get_mesh()
+    if mesh is None:
+        return None, None
+    for name in ("sep", "mp"):
+        if name in mesh.dim_names and mesh.dim_size(name) > 1:
+            return mesh, name
+    return mesh, None
+
+
+def _with_seq_placement(x: Tensor, shard: bool, seq_dim: int = 1) -> Tensor:
+    mesh, axis = _sp_axis()
+    if mesh is None or axis is None:
+        return x
+    pls = list(x._placements or [Replicate()] * mesh.ndim)
+    ax = mesh.dim_names.index(axis)
+    pls[ax] = Shard(seq_dim) if shard else Replicate()
+    return reshard(x, mesh, pls)
+
+
+class ScatterOp:
+    """sequence_parallel_utils.py:85 — split activations along sequence."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        return _with_seq_placement(x, shard=True, seq_dim=axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=1):
+        return _with_seq_placement(x, shard=False, seq_dim=axis)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def mark_as_sequence_parallel_parameter(param) -> None:
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """:192 analog — under GSPMD the layernorm-param grad allreduce over the
+    sp group is produced by the partitioner; nothing to hook."""
+    return None
+
+
+class ColumnSequenceParallelLinear(paddle.nn.Linear):
+    """:395 analog — allgather(seq) then column-parallel matmul; expressed
+    as placement transitions around a Linear with out-dim-sharded weight."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, mp_group=None, name=None):
+        bias_attr = None if (has_bias or has_bias is None) else False
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         bias_attr=bias_attr)
+        from paddle_tpu.distributed.fleet.meta_parallel import _maybe_shard_param
+        _maybe_shard_param(self.weight, 1)
+        if self.bias is not None:
+            _maybe_shard_param(self.bias, 0)
+
+    def forward(self, x):
+        x = GatherOp.apply(x)  # seq gathered before the column matmul
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(paddle.nn.Linear):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None, name=None):
+        bias_attr = None if has_bias else False
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         bias_attr=bias_attr)
+        from paddle_tpu.distributed.fleet.meta_parallel import _maybe_shard_param
+        _maybe_shard_param(self.weight, 0)
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ScatterOp.apply(out)  # back to seq-sharded between blocks

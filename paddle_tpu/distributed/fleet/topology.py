@@ -1,0 +1,133 @@
+"""Hybrid communicate topology.
+
+Analog of fleet/base/topology.py (CommunicateTopology:65,
+HybridCommunicateGroup:178): the 5-D rank space [dp, pp, sharding, sep, mp]
+becomes an actual 5-axis device mesh; "creating a subgroup per axis"
+becomes naming that axis in a collective/sharding spec — XLA compiles the
+ring. The accessors (get_model_parallel_world_size etc.) are kept for
+user-code parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from paddle_tpu.parallel.mesh import ProcessMesh, set_mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=_AXES, dims=(1, 1, 1, 1, 1)):
+        self._names = tuple(hybrid_group_names)
+        self._dims = tuple(int(d) for d in dims)
+
+    def get_hybrid_group_names(self):
+        return list(self._names)
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_dim_size(self, name):
+        return self.get_dim(name)
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1):
+        if topology is not None:
+            dims = dict(zip(topology.get_hybrid_group_names(), topology._dims))
+            dp_degree = dims.get("dp", 1)
+            pp_degree = dims.get("pp", 1)
+            sharding_degree = dims.get("sharding", 1)
+            sep_degree = dims.get("sep", 1)
+            mp_degree = dims.get("mp", 1)
+        self._topo = CommunicateTopology(
+            _AXES, (dp_degree, pp_degree, sharding_degree, sep_degree, mp_degree))
+        need = self._topo.world_size()
+        have = len(jax.devices())
+        if need > have:
+            raise ValueError(f"hybrid topology needs {need} devices, have {have}")
+        self.mesh = ProcessMesh(
+            shape=(dp_degree, pp_degree, sharding_degree, sep_degree, mp_degree),
+            dim_names=_AXES)
+        set_mesh(self.mesh)
+        from paddle_tpu.distributed.collective import Group, _set_default_group
+        self._groups = {ax: Group(self.mesh, ax) for ax in _AXES}
+        _set_default_group(self._groups["dp"])
+
+    # -- per-axis accessors (topology.py parity) ----------------------------
+    def _axis_size(self, ax):
+        return self.mesh.dim_size(ax)
+
+    def get_parallel_mode(self):
+        if self._axis_size("pp") > 1:
+            return "pipeline"
+        if self._axis_size("sharding") > 1:
+            return "sharding_parallel"
+        if self._axis_size("mp") > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def get_data_parallel_world_size(self):
+        return self._axis_size("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._axis_size("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._axis_size("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._axis_size("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._axis_size("sep")
+
+    # single-controller: the "current rank" is host-level; per-device ranks
+    # exist only inside compiled programs, so ranks report 0
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a):
+        return self._groups["mp"]
+
+    def topology(self):
+        return self._topo

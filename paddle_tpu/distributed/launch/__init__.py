@@ -1,0 +1,12 @@
+"""Launcher: ``python -m paddle_tpu.distributed.launch [--nnodes N] train.py``.
+
+Redesign of python/paddle/distributed/launch/ (main.py,
+controllers/collective.py:37 build_pod): the reference spawns one process
+per GPU with PADDLE_* env and an HTTP/etcd rendezvous master. On TPU the
+runtime owns all local chips from one process, so the launcher's real jobs
+are (a) multi-host coordination env (jax.distributed coordinator), (b)
+per-node log dirs + child supervision with restart, (c) elastic resume
+hooks. Single-node it simply supervises one worker process.
+"""
+
+from paddle_tpu.distributed.launch.main import launch, main  # noqa: F401

@@ -1,0 +1,79 @@
+"""Launcher implementation (launch/main.py + controllers/ analog)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU-native launcher (paddle.distributed.launch analog)")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count or elastic range 'min:max'")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="coordinator host:port for multi-host")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--devices", type=str, default="",
+                   help="accepted for reference-CLI parity; the TPU runtime "
+                        "owns local chips, so this is informational")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, restarts: int) -> dict:
+    env = dict(os.environ)
+    nmin = args.nnodes.split(":")[0]
+    env["PADDLE_TRAINERS_NUM"] = str(int(nmin))
+    env["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env["COORDINATOR_ADDRESS"] = args.master
+    env["PADDLE_RESTART_COUNT"] = str(restarts)
+    return env
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    os.makedirs(args.log_dir, exist_ok=True)
+    restarts = 0
+    while True:
+        log_path = os.path.join(
+            args.log_dir, f"worker.{args.node_rank}.{restarts}.log")
+        cmd = [sys.executable, args.script] + list(args.script_args)
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(cmd, env=_worker_env(args, restarts),
+                                    stdout=logf, stderr=subprocess.STDOUT)
+            try:
+                ret = proc.wait()
+            except KeyboardInterrupt:
+                proc.send_signal(signal.SIGTERM)
+                return 130
+        if ret == 0:
+            return 0
+        restarts += 1
+        if restarts > args.max_restarts:
+            sys.stderr.write(
+                f"worker failed {restarts} times (last={ret}); giving up. "
+                f"logs: {log_path}\n")
+            return ret
+        sys.stderr.write(f"worker exited {ret}; restart {restarts}/"
+                         f"{args.max_restarts}\n")
+        time.sleep(1)
+
+
+def main() -> None:
+    raise SystemExit(launch())

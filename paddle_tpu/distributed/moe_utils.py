@@ -1,0 +1,72 @@
+"""MoE all-to-all utilities.
+
+Redesign of python/paddle/distributed/utils/moe_utils.py:20
+(global_scatter / global_gather, backed by the reference's
+collective/global_scatter_op): token exchange across expert-parallel
+ranks. TPU-native: one ragged token exchange = dense all_to_all over the
+'ep' (or given) mesh axis on capacity-padded buffers — the dense layout is
+what the MXU wants anyway (expert-capacity padding replaces dynamic
+counts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.collective import Group, _default_group, alltoall
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather", "dispatch_tokens", "combine_tokens"]
+
+
+def global_scatter(x: Tensor, local_count, global_count,
+                   group: Optional[Group] = None) -> Tensor:
+    """Capacity-padded analog of moe_utils.global_scatter: x is the
+    rank-stacked [n, n, cap, d] send buffer (rank i's chunk j goes to
+    expert-rank j); counts are carried in the padding mask (see
+    dispatch_tokens)."""
+    return alltoall(x, group=group)
+
+
+def global_gather(x: Tensor, local_count, global_count,
+                  group: Optional[Group] = None) -> Tensor:
+    """Inverse exchange (moe_utils.global_gather)."""
+    return alltoall(x, group=group)
+
+
+def dispatch_tokens(tokens, expert_ids, n_experts: int, capacity: int):
+    """Host/trace-side dense dispatch: scatter tokens into an
+    [n_experts, capacity, d] buffer with an overflow-drop policy (the
+    reference's expert-capacity semantics in incubate MoE).
+
+    Returns (buffer, combine_index, valid_mask); combine with
+    combine_tokens. Pure jnp — usable inside jit and as the local block of
+    an 'ep' shard_map.
+    """
+    tokens = tokens.value if isinstance(tokens, Tensor) else jnp.asarray(tokens)
+    expert_ids = expert_ids.value if isinstance(expert_ids, Tensor) else jnp.asarray(expert_ids)
+    t, d = tokens.shape
+    # position of each token within its expert's capacity slots
+    onehot = jax.nn.one_hot(expert_ids, n_experts, dtype=jnp.int32)  # (t, e)
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based
+    pos = jnp.sum(pos_in_expert, axis=1) - 1  # (t,)
+    keep = pos < capacity
+    slot = expert_ids * capacity + jnp.where(keep, pos, 0)
+    buf = jnp.zeros((n_experts * capacity, d), tokens.dtype)
+    buf = buf.at[slot].add(jnp.where(keep[:, None], tokens, 0.0))
+    return (Tensor(buf.reshape(n_experts, capacity, d)),
+            Tensor(slot), Tensor(keep))
+
+
+def combine_tokens(expert_out, combine_index, valid_mask):
+    """Gather expert outputs back to token order; dropped tokens get 0."""
+    buf = expert_out.value if isinstance(expert_out, Tensor) else jnp.asarray(expert_out)
+    slot = combine_index.value if isinstance(combine_index, Tensor) else jnp.asarray(combine_index)
+    keep = valid_mask.value if isinstance(valid_mask, Tensor) else jnp.asarray(valid_mask)
+    e, c, d = buf.shape
+    flat = buf.reshape(e * c, d)
+    out = flat[slot]
+    return Tensor(jnp.where(keep[:, None], out, 0.0))

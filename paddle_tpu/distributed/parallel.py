@@ -1,0 +1,128 @@
+"""Environment init + DataParallel.
+
+Redesign of python/paddle/distributed/parallel.py (init_parallel_env:943):
+under the single-controller model there is no TCPStore rendezvous between
+Python workers for collectives — the TPU runtime owns the mesh. What
+remains meaningful: process/host identity (jax.process_index for
+multi-host), device mesh construction, and the DataParallel wrapper, which
+on TPU is just "shard the batch, replicate params" — the EagerReducer
+bucket machinery (collective/reducer.h:88) is replaced by XLA fusing the
+gradient psum into the backward.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.parallel.mesh import ProcessMesh, get_mesh, init_mesh
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "DataParallel", "is_initialized",
+]
+
+_INITIALIZED = False
+
+
+def init_parallel_env(mesh_shape=None, dim_names=None) -> "ParallelEnv":
+    """Create the default world mesh (parallel.py:943 analog).
+
+    Multi-host: jax.distributed is initialized from the standard env
+    (COORDINATOR_ADDRESS / PADDLE_MASTER set by paddle_tpu.distributed.launch)
+    before mesh construction so jax.devices() spans all hosts.
+    """
+    global _INITIALIZED
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nproc > 1 and not _INITIALIZED:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    if get_mesh() is None:
+        if mesh_shape is None:
+            mesh_shape = (len(jax.devices()),)
+            dim_names = ("world",)
+        init_mesh(mesh_shape, dim_names)
+    from paddle_tpu.distributed.collective import _default_group
+    _default_group()
+    _INITIALIZED = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return 0
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return len(jax.devices())
+
+
+class ParallelEnv:
+    """python/paddle/base/dygraph `ParallelEnv` analog."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return jax.devices()[0].id
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel analog.
+
+    Wraps a layer so its parameters are replicated over the mesh's dp axis
+    and training steps shard the batch: with GSPMD the gradient allreduce
+    is inserted by XLA — no reducer hooks, no buckets
+    (vs parallel.py `class DataParallel` + EagerReducer).
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        mesh = get_mesh()
+        if mesh is not None:
+            from paddle_tpu.parallel import Replicate, shard_layer
+            shard_layer(layers, mesh)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

@@ -1,0 +1,69 @@
+"""Pipeline-parallel schedules.
+
+Redesign of fleet/meta_parallel/pipeline_parallel.py
+(forward_backward_pipeline 1F1B :459, interleave :987, FThenB :1799) and
+pp_utils/p2p_communication.py.
+
+TPU-native model: all stages live in one SPMD program. Micro-batching is a
+host loop (eager) or ``lax.scan`` (compiled); the cross-stage "p2p" is a
+sharding boundary on the mesh 'pp' axis — the hidden-state tensor's
+constraint flips stage shards, which XLA lowers to collective-permute over
+ICI. Round-1 scope: correct micro-batch grad accumulation over a staged
+layer list (FThenB semantics — same results as 1F1B; 1F1B's memory shape
+comes from the compiled schedule in a later milestone).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["pipeline_train_batch", "split_micro_batches"]
+
+
+def split_micro_batches(data, n: int) -> List[tuple]:
+    """Split a [x, y] batch into n micro-batches along dim 0
+    (micro-batch slicing in pipeline_parallel.py train_batch)."""
+    xs, ys = data
+    xv = xs.numpy() if isinstance(xs, Tensor) else np.asarray(xs)
+    yv = ys.numpy() if isinstance(ys, Tensor) else np.asarray(ys)
+    if xv.shape[0] % n != 0:
+        raise ValueError(f"batch {xv.shape[0]} not divisible by {n} micro-batches")
+    mx = np.split(xv, n)
+    my = np.split(yv, n)
+    return [(paddle.to_tensor(a), paddle.to_tensor(b)) for a, b in zip(mx, my)]
+
+
+def pipeline_train_batch(pipeline_layer, data, optimizer, micro_batches: int = 1,
+                         schedule: str = "1F1B", scaler=None) -> Tensor:
+    """Run fwd+bwd over micro-batches, accumulate grads, step once.
+
+    Matches PipelineParallel.train_batch's contract (loss averaged over
+    micro-batches; optimizer stepped after the full batch).
+    """
+    loss_fn = pipeline_layer.loss_fn
+    if loss_fn is None:
+        raise ValueError("PipelineLayer needs loss_fn for train_batch")
+    micros = split_micro_batches(data, micro_batches)
+    total = None
+    for x, y in micros:
+        out = pipeline_layer(x)
+        loss = loss_fn(out, y)
+        scaled = loss / micro_batches
+        if scaler is not None:
+            scaler.scale(scaled).backward()
+        else:
+            scaled.backward()
+        # accumulate on device; no per-micro-batch host sync
+        total = scaled.detach() if total is None else total + scaled.detach()
+    if scaler is not None:
+        scaler.step(optimizer)
+        scaler.update()
+    else:
+        optimizer.step()
+    optimizer.clear_grad()
+    return total

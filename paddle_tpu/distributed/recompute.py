@@ -1,0 +1,96 @@
+"""Recompute (activation checkpointing).
+
+Redesign of fleet/recompute/recompute.py:403 (`RecomputeFunction` PyLayer
+with RNG-state replay): on TPU this is ``jax.checkpoint`` — the forward is
+re-traced in the backward, RNG replay is free because randomness is
+functional (keys are inputs), and XLA schedules the rematerialized
+segment. Works eagerly (taped op) and inside to_static/jit tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.ops.registry import OpDef, apply_op
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute analog.
+
+    `function` may be a Layer or a callable over Tensors. Non-tensor kwargs
+    are static. use_reentrant is accepted and ignored (jax.checkpoint is
+    the non-reentrant saved-tensor-hooks design by construction).
+    """
+    kwargs.pop("use_reentrant", None)
+    preserve = kwargs.pop("preserve_rng_state", True)
+
+    if isinstance(function, Layer):
+        layer = function
+        state = dict(layer.state_dict())
+        for n, b in layer.named_buffers():
+            state.setdefault(n, b)
+        names = tuple(state.keys())
+        param_tensors = [state[n] for n in names]
+
+        def pure(*vals):
+            pvals = vals[:len(names)]
+            avals = vals[len(names):]
+            originals = []
+            try:
+                for n, v in zip(names, pvals):
+                    t = state[n]
+                    originals.append((t, t._value))
+                    t._value = v
+                from paddle_tpu.autograd import tape
+                with tape.no_grad():
+                    out = layer(*[Tensor(a) for a in avals], **kwargs)
+                return out._value if isinstance(out, Tensor) else tuple(
+                    o._value for o in out)
+            finally:
+                for t, v in originals:
+                    t._value = v
+
+        ck = jax.checkpoint(pure)
+        opdef = OpDef(f"recompute<{type(layer).__name__}>", ck)
+        return apply_op(opdef, tuple(param_tensors) + tuple(
+            a if isinstance(a, Tensor) else Tensor(a) for a in args), {})
+
+    fn: Callable = function
+
+    def pure(*vals):
+        from paddle_tpu.autograd import tape
+        with tape.no_grad():
+            out = fn(*[Tensor(v) for v in vals], **kwargs)
+        return out._value if isinstance(out, Tensor) else tuple(
+            o._value for o in out)
+
+    ck = jax.checkpoint(pure)
+    opdef = OpDef(f"recompute<{getattr(fn, '__name__', 'fn')}>", ck)
+    return apply_op(opdef, tuple(a if isinstance(a, Tensor) else Tensor(a)
+                                 for a in args), {})
+
+
+def recompute_sequential(ctx: dict, functions, *args):
+    """fleet/recompute/recompute.py:567 analog: checkpoint a Sequential in
+    `segments` chunks."""
+    segments = int(ctx.get("segments", 1)) if ctx else 1
+    if isinstance(functions, Layer):
+        layers = list(functions.children()) if hasattr(functions, "children") \
+            else [functions]
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(1, n // segments)
+    out = args
+    import paddle_tpu.nn as nn
+    for i in range(0, n, per):
+        seg = nn.Sequential(*layers[i:i + per])
+        res = recompute(seg, *(out if isinstance(out, tuple) else (out,)))
+        out = res
+    return out

@@ -99,7 +99,15 @@ class ShardedTrainer:
             t._process_mesh = mesh
             self.shardings[name] = sh
 
-        # functional optimizer state, sharded like its param
+        # functional optimizer state, sharded like its param — or, with a
+        # ZeRO stage set (distributed.sharding), additionally sharded over
+        # the sharding/dp axis (stage-1/2 optimizer-state partitioning:
+        # dygraph_sharding_optimizer.py:44 analog, done as placements)
+        zero_stage = getattr(optimizer, "_zero_stage", 0)
+        zero_axis = None
+        if zero_stage >= 1:
+            from paddle_tpu.distributed.sharding import shard_axis_for
+            zero_axis = shard_axis_for(mesh)
         self.opt_state = {}
         self.opt_shardings = {}
         for name in self.trainable:
@@ -107,12 +115,24 @@ class ShardedTrainer:
             st = optimizer.init_state(p.value)
             pst, psh = {}, {}
             for k, v in st.items():
-                sh = (self.shardings[name] if getattr(v, "shape", ()) == tuple(p.shape)
-                      else NamedSharding(mesh.jax_mesh, P()))
+                if getattr(v, "shape", ()) == tuple(p.shape):
+                    sh = self.shardings[name]
+                    if zero_axis is not None:
+                        sh = self._zero_sharding(p, name, zero_axis) or sh
+                else:
+                    sh = NamedSharding(mesh.jax_mesh, P())
                 pst[k] = jax.device_put(v, sh)
                 psh[k] = sh
             self.opt_state[name] = pst
             self.opt_shardings[name] = psh
+
+    def _zero_sharding(self, p, name: str, axis: str):
+        """Optimizer-state sharding over `axis`, layered on the param's own
+        plan (dygraph_sharding_optimizer.py:44 stage-1 semantics)."""
+        from paddle_tpu.distributed.sharding import zero_shard_placements
+        pls = self.plan.get(name, [Replicate()] * self.mesh.ndim)
+        new = zero_shard_placements(p.shape, pls, self.mesh, axis)
+        return named_sharding(self.mesh, new, ndim=p.ndim) if new else None
 
     # -- compiled step ------------------------------------------------------
     def _build(self, n_batch: int):
