@@ -75,25 +75,37 @@ def _reduce_full(x, op: str, axis: str, n: int):
 
 
 class Group:
-    """A communication group = a named axis of a ProcessMesh.
+    """A communication group = one (or a tuple of) named mesh axes.
 
-    Reference: communication/group.py `Group`. `ranks` are global device
-    ids participating; `axis` is the mesh axis the collective compiles
-    over.
+    Reference: communication/group.py `Group`. Single-controller
+    semantics: `src`/`dst` arguments to collectives are *group ranks*
+    (positions along the group axes, 0..nranks-1), and `ranks` lists them;
+    there is no separate global-rank space because one controller owns all
+    devices.
     """
 
     _next_gid = 0
 
-    def __init__(self, mesh: ProcessMesh, axis: str, ranks: Optional[List[int]] = None):
+    def __init__(self, mesh: ProcessMesh, axis, ranks: Optional[List[int]] = None):
         self.mesh = mesh
-        self.axis = axis
-        self.ranks = ranks if ranks is not None else mesh.process_ids
+        self.axis = axis  # str or tuple[str, ...]
+        self.ranks = (ranks if ranks is not None
+                      else list(range(self._axis_size(mesh, axis))))
         self.id = Group._next_gid
         Group._next_gid += 1
 
+    @staticmethod
+    def _axis_size(mesh, axis) -> int:
+        if isinstance(axis, tuple):
+            n = 1
+            for a in axis:
+                n *= mesh.dim_size(a)
+            return n
+        return mesh.dim_size(axis)
+
     @property
     def nranks(self) -> int:
-        return self.mesh.dim_size(self.axis)
+        return self._axis_size(self.mesh, self.axis)
 
     @property
     def world_size(self) -> int:
@@ -115,14 +127,15 @@ _DEFAULT_GROUP: Optional[Group] = None
 
 
 def _default_group() -> Group:
+    """World group: every mesh axis (reference: the global default group)."""
     global _DEFAULT_GROUP
     if _DEFAULT_GROUP is None:
         mesh = get_mesh()
         if mesh is None:
             from paddle_tpu.parallel.mesh import init_mesh
             mesh = init_mesh((len(jax.devices()),), ("world",))
-        axis = mesh.dim_names[0]
-        _DEFAULT_GROUP = Group(mesh, axis)
+        axes = tuple(mesh.dim_names)
+        _DEFAULT_GROUP = Group(mesh, axes[0] if len(axes) == 1 else axes)
         _GROUPS[_DEFAULT_GROUP.id] = _DEFAULT_GROUP
     return _DEFAULT_GROUP
 
@@ -181,7 +194,9 @@ def stack_for_group(tensors: Sequence, group: Optional[Group] = None) -> Tensor:
     vals = [t.value if isinstance(t, Tensor) else jnp.asarray(t) for t in tensors]
     stacked = jnp.stack(vals)
     pls = [Replicate()] * group.mesh.ndim
-    pls[group.mesh.dim_names.index(group.axis)] = Shard(0)
+    axes = group.axis if isinstance(group.axis, tuple) else (group.axis,)
+    for ax in axes:
+        pls[group.mesh.dim_names.index(ax)] = Shard(0)
     return shard_tensor(stacked, group.mesh, pls)
 
 
@@ -248,6 +263,7 @@ def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM,
     (communication/reduce.py)."""
     group = group or _default_group()
     _group_size_check(tensor, group)
+    _check_group_rank(dst, group, "dst")
     axis = group.axis
     red = op
 
@@ -317,11 +333,18 @@ def reduce_scatter(tensor: Tensor, tensor_list=None, op: str = ReduceOp.SUM,
     return _run_collective("reduce_scatter", src, group, local)
 
 
+def _check_group_rank(r: int, group: Group, what: str) -> None:
+    if not 0 <= r < group.nranks:
+        raise ValueError(f"{what}={r} out of range for group of size "
+                         f"{group.nranks} (src/dst are group ranks)")
+
+
 def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
               sync_op: bool = True) -> Tensor:
     """out[i] = in[src] (communication/broadcast.py)."""
     group = group or _default_group()
     _group_size_check(tensor, group)
+    _check_group_rank(src, group, "src")
     axis = group.axis
 
     def local(x):
@@ -387,18 +410,27 @@ def alltoall(out_tensor_list, in_tensor_list=None, group: Optional[Group] = None
 all_to_all = alltoall
 
 
+_BARRIER_CACHE: dict = {}
+
+
 def barrier(group: Optional[Group] = None) -> None:
     """Device-side sync point (communication/batch_isend_irecv.py barrier
-    analog): a tiny psum forces all shards to rendezvous."""
+    analog): a tiny psum forces all shards to rendezvous. The jitted
+    program is cached per (mesh, axis) — a per-step barrier costs no
+    retrace."""
     group = group or _default_group()
     axis = group.axis
+    key = (group.mesh.jax_mesh, axis)
+    fn = _BARRIER_CACHE.get(key)
+    if fn is None:
+        def local(x):
+            return jax.lax.psum(x, axis)
 
-    def local(x):
-        return jax.lax.psum(x, axis)
-
-    fn = shard_map(local, mesh=group.mesh.jax_mesh, in_specs=(P(axis),),
-                   out_specs=P(axis), check_vma=False)
-    jax.block_until_ready(jax.jit(fn)(jnp.zeros((group.nranks, 1), jnp.float32)))
+        fn = jax.jit(shard_map(local, mesh=group.mesh.jax_mesh,
+                               in_specs=(P(axis),), out_specs=P(axis),
+                               check_vma=False))
+        _BARRIER_CACHE[key] = fn
+    jax.block_until_ready(fn(jnp.zeros((group.nranks, 1), jnp.float32)))
 
 
 # -- p2p: ppermute-based send/recv on rank-stacked tensors -------------------

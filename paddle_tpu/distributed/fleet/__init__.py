@@ -31,6 +31,10 @@ def init(role_maker=None, is_collective: bool = False,
     global _HCG, _STRATEGY
     strategy = strategy or DistributedStrategy()
     _STRATEGY = strategy
+    # multi-host bring-up (jax.distributed) happens here, BEFORE the mesh is
+    # built, so jax.devices() spans all hosts (parallel.py:943 analog)
+    from paddle_tpu.distributed.parallel import init_parallel_env
+    init_parallel_env()
     conf = strategy.hybrid_configs
     _HCG = HybridCommunicateGroup(
         dp_degree=conf.get("dp_degree", 1),
@@ -39,9 +43,6 @@ def init(role_maker=None, is_collective: bool = False,
         sharding_degree=conf.get("sharding_degree", 1),
         sep_degree=conf.get("sep_degree", 1),
     )
-    from paddle_tpu.distributed.parallel import init_parallel_env  # noqa: F401
-    import paddle_tpu.distributed.parallel as _p
-    _p._INITIALIZED = True
     return _HCG
 
 

@@ -78,7 +78,8 @@ class ColumnParallelLinear(Layer):
                  has_bias=None, gather_output=True, fuse_matmul_bias=False,
                  mp_group=None, name=None):
         super().__init__()
-        bias_attr = None if (has_bias or has_bias is None) else False
+        # reference semantics (mp_layers.py:438 `if has_bias:`): None -> no bias
+        bias_attr = None if has_bias else False
         self.linear = nn.Linear(in_features, out_features,
                                 weight_attr=weight_attr, bias_attr=bias_attr)
         _maybe_shard_param(self.linear.weight, 1)

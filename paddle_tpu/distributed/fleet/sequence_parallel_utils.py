@@ -83,7 +83,8 @@ class ColumnSequenceParallelLinear(paddle.nn.Linear):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=None, gather_output=False, mp_group=None, name=None):
-        bias_attr = None if (has_bias or has_bias is None) else False
+        # reference :458 `if has_bias:` — None means no bias
+        bias_attr = None if has_bias else False
         super().__init__(in_features, out_features, weight_attr=weight_attr,
                          bias_attr=bias_attr)
         from paddle_tpu.distributed.fleet.meta_parallel import _maybe_shard_param

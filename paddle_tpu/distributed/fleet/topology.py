@@ -64,7 +64,8 @@ class HybridCommunicateGroup:
         set_mesh(self.mesh)
         from paddle_tpu.distributed.collective import Group, _set_default_group
         self._groups = {ax: Group(self.mesh, ax) for ax in _AXES}
-        _set_default_group(self._groups["dp"])
+        # default group = the whole world (all axes), reference semantics
+        _set_default_group(Group(self.mesh, tuple(_AXES)))
 
     # -- per-axis accessors (topology.py parity) ----------------------------
     def _axis_size(self, ax):

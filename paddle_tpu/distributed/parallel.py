@@ -42,11 +42,10 @@ def init_parallel_env(mesh_shape=None, dim_names=None) -> "ParallelEnv":
     if coord and nproc > 1 and not _INITIALIZED:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
-    if get_mesh() is None:
-        if mesh_shape is None:
-            mesh_shape = (len(jax.devices()),)
-            dim_names = ("world",)
+    if mesh_shape is not None:
         init_mesh(mesh_shape, dim_names)
+    elif get_mesh() is None:
+        init_mesh((len(jax.devices()),), ("world",))
     from paddle_tpu.distributed.collective import _default_group
     _default_group()
     _INITIALIZED = True

@@ -79,10 +79,12 @@ def recompute(function, *args, **kwargs):
 def recompute_sequential(ctx: dict, functions, *args):
     """fleet/recompute/recompute.py:567 analog: checkpoint a Sequential in
     `segments` chunks."""
+    import paddle_tpu.nn as nn
     segments = int(ctx.get("segments", 1)) if ctx else 1
-    if isinstance(functions, Layer):
-        layers = list(functions.children()) if hasattr(functions, "children") \
-            else [functions]
+    if isinstance(functions, nn.Sequential):
+        layers = list(functions.children())
+    elif isinstance(functions, Layer):
+        layers = [functions]  # leaf/composite Layer: checkpoint whole
     else:
         layers = list(functions)
     n = len(layers)

@@ -323,3 +323,48 @@ def test_reduce_prod_supported():
     out2 = dist.reduce(dist.stack_for_group(per_rank, g), dst=0,
                        op=dist.ReduceOp.PROD, group=g)
     np.testing.assert_allclose(dist.unstack_from_group(out2)[0].numpy(), 2.0 ** n)
+
+
+def test_world_default_group_after_fleet_init():
+    """Review r2: default group must be the whole world, not the dp axis."""
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4, "pp_degree": 1,
+                               "sharding_degree": 2, "sep_degree": 1}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.collective import _default_group
+    g = _default_group()
+    assert g.nranks == 8  # all devices, not dp=1
+    per_rank = [np.full((2,), 1.0, np.float32) for _ in range(8)]
+    out = dist.all_reduce(dist.stack_for_group(per_rank, g), group=g)
+    np.testing.assert_allclose(dist.unstack_from_group(out)[0].numpy(), 8.0)
+
+
+def test_broadcast_src_out_of_range_raises():
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 2, "sep_degree": 1}
+    hcg = dist.fleet.init(is_collective=True, strategy=strategy)
+    g = hcg.get_model_parallel_group()
+    t = dist.stack_for_group([np.zeros((2,), np.float32)] * 2, g)
+    with pytest.raises(ValueError, match="out of range"):
+        dist.broadcast(t, src=5, group=g)
+
+
+def test_recompute_sequential_leaf_layer():
+    """Review r2: leaf Layer must actually run, not be skipped."""
+    import paddle_tpu.nn as nn
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    out = dist.recompute_sequential({"segments": 1}, lin, x)
+    np.testing.assert_allclose(out.numpy(), lin(x).numpy(), rtol=1e-6)
+
+
+def test_column_parallel_default_no_bias():
+    """Review r2: has_bias=None means no bias (mp_layers.py:438)."""
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.fleet.meta_parallel import ColumnParallelLinear
+    col = ColumnParallelLinear(8, 16)
+    assert col.bias is None

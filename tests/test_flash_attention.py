@@ -1,0 +1,98 @@
+"""Flash attention kernel tests (interpret mode on CPU).
+
+OpTest-style: compare the Pallas kernel against the reference sdpa
+(nn/functional.py _sdpa_ref) for outputs and gradients — the reference's
+"one schema, N runtimes" cross-check pattern (SURVEY §4a)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_fn
+
+
+def _ref_attention(q, k, v, causal):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qT = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kT = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vT = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(1, 128, 2, 64), (2, 256, 4, 32)])
+def test_forward_matches_reference(shape, causal):
+    rng = np.random.default_rng(0)
+    b, s, h, d = shape
+    q = rng.normal(size=shape).astype(np.float32)
+    k = rng.normal(size=shape).astype(np.float32)
+    v = rng.normal(size=shape).astype(np.float32)
+    out = flash_attention_fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             causal=causal, block_q=64, block_k=64)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(causal):
+    rng = np.random.default_rng(1)
+    shape = (1, 128, 2, 32)
+    q = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    k = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    v = jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention_fn(q, k, v, causal=causal,
+                                          block_q=64, block_k=64) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v, causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_wired_into_functional():
+    """nn.functional.scaled_dot_product_attention uses the kernel when
+    shapes allow (FLAGS use_fused_attention)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(2)
+    q = paddle.to_tensor(rng.normal(size=(1, 128, 2, 32)).astype(np.float32),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.normal(size=(1, 128, 2, 32)).astype(np.float32))
+    v = paddle.to_tensor(rng.normal(size=(1, 128, 2, 32)).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    ref = _ref_attention(q.numpy(), k.numpy(), v.numpy(), True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    # grads flow through the tape
+    paddle.sum(out).backward()
+    assert q.grad is not None
+
+
+def test_bf16_io():
+    rng = np.random.default_rng(3)
+    shape = (1, 128, 1, 64)
+    q = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    out = flash_attention_fn(q, k, v, causal=True, block_q=64, block_k=64)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32), True)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
