@@ -129,8 +129,18 @@ class LlamaAttention(nn.Layer):
         if rep > 1:
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=True, training=self.training)
+        mesh = get_mesh()
+        from paddle_tpu.flags import flags
+        if (attn_mask is None and mesh is not None and flags.use_ring_attention
+                and "sep" in mesh.dim_names and mesh.dim_size("sep") > 1
+                and S % mesh.dim_size("sep") == 0):
+            # context parallelism: blockwise ring attention over the sep axis
+            from paddle_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, mesh, axis="sep", causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=True,
+                                                 training=self.training)
         out = out.reshape([B, S, cfg.num_attention_heads * cfg.head_dim])
         return self.o_proj(out)
 

@@ -782,8 +782,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         try:
             from paddle_tpu.ops.pallas import flash_attention as fa
             return fa.flash_attention_op(query, key, value, causal=is_causal)
-        except Exception:
-            pass
+        except ValueError:
+            pass  # shape not kernel-eligible (ragged seq, sq!=sk causal)
     dk = rnd.split_key() if (dropout_p > 0.0 and training) else None
     return _sdpa_ref(query, key, value, attn_mask=attn_mask, dropout_key=dk,
                      dropout_p=dropout_p if training else 0.0, causal=is_causal)

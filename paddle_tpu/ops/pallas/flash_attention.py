@@ -232,11 +232,6 @@ def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (bh, sq, 1)
 
-    common_in = [
-        pl.BlockSpec((1, block_q, d), None),   # q — per-kernel index maps below
-    ]
-    del common_in
-
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk),
@@ -328,6 +323,11 @@ def flash_attention_fn(q, k, v, causal: bool = False, scale=None,
     if sq % block_q or sk % block_k:
         raise ValueError(f"flash_attention: seq ({sq},{sk}) not divisible by "
                          f"blocks ({block_q},{block_k})")
+    if causal and sq != sk:
+        # the kernel's mask is top-left aligned; paddle causal semantics for
+        # sq != sk (KV-cache decode chunks) are bottom-right (tril(k=sk-sq))
+        raise ValueError("flash_attention: causal with sq != sk unsupported; "
+                         "use the sdpa reference path")
     if k.shape[2] != h:
         raise ValueError("flash_attention: repeat kv heads before the kernel")
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
