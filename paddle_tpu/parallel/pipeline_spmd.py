@@ -1,0 +1,114 @@
+"""Compiled SPMD pipeline parallelism.
+
+Redesign of the reference's pipeline runtime (fleet/meta_parallel/
+pipeline_parallel.py 1F1B :459, pp_utils/p2p_communication.py, and the
+FleetExecutor interceptor dataflow N21): instead of per-micro-batch NCCL
+p2p orchestrated from Python, the whole schedule compiles into ONE SPMD
+program over the mesh 'pp' axis:
+
+- stage params live sharded over 'pp' (stage i's weights on ring rank i),
+- micro-batches stream through a rotating state buffer moved by
+  ``lax.ppermute`` (collective-permute rides ICI),
+- the schedule loop is a static Python loop of T = M + S - 1 ticks
+  (GPipe-style fill/drain; every device computes every tick, with bubble
+  ticks masked), and
+- backward is ``jax.grad`` through the loop — XLA reverses the permutes,
+  which reproduces the 1F1B-reversed communication pattern without any
+  hand-written schedule; per-tick ``jax.checkpoint`` bounds activation
+  memory the way recompute_interval does in the reference.
+
+This is the deadlock-free-by-construction answer to SURVEY §7.3 hard
+part #1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import ProcessMesh
+
+__all__ = ["spmd_pipeline", "stack_stage_params"]
+
+
+def stack_stage_params(stage_states: Sequence[dict]) -> dict:
+    """Stack per-stage param dicts (same structure) along a leading stage
+    axis: the 'pp'-shardable layout (stage i's slice lands on ring rank i)."""
+    keys = list(stage_states[0].keys())
+    for st in stage_states[1:]:
+        if list(st.keys()) != keys:
+            raise ValueError("pipeline stages must have identical param structure")
+    return {k: jnp.stack([st[k] for st in stage_states]) for k in keys}
+
+
+def spmd_pipeline(stage_fn: Callable, stacked_params: dict, x,
+                  mesh: ProcessMesh, n_micro: int, axis: str = "pp",
+                  checkpoint_ticks: bool = True, partial_manual: bool = False):
+    """Run `x` through S pipeline stages as one compiled SPMD program.
+
+    stage_fn(params_slice, microbatch) -> microbatch (same shape/dtype);
+    stacked_params[k] has leading dim S (stage axis, sharded over `axis`);
+    x has leading dim M = n_micro (micro-batch axis, replicated).
+
+    Returns the pipeline output with leading dim M.
+    """
+    S = mesh.dim_size(axis)
+    lead = next(iter(stacked_params.values())).shape[0] if stacked_params else S
+    if lead != S:
+        raise ValueError(f"stacked stage dim {lead} != pp axis size {S}")
+    M = x.shape[0]
+    if M != n_micro:
+        raise ValueError(f"x leading dim {M} != n_micro {n_micro}")
+
+    param_specs = {k: P(axis) for k in stacked_params}
+    x_spec = P()          # micro-batches replicated; tiny vs activations
+    out_spec = P()
+
+    def local(params_loc, x_all):
+        # params_loc[k]: (1, ...) this rank's stage slice
+        r = jax.lax.axis_index(axis)
+        p_here = {k: v[0] for k, v in params_loc.items()}
+        state = jnp.zeros_like(x_all[0])
+        outputs = jnp.zeros((M,) + x_all.shape[1:], x_all.dtype)
+
+        # checkpoint ONLY the stage compute: the accumulator ops (.at.set,
+        # where, ppermute) are linear and need no residuals — wrapping the
+        # whole tick would keep T copies of the (M, ...) buffer live
+        compute = jax.checkpoint(stage_fn) if checkpoint_ticks else stage_fn
+
+        def tick(t, state, outputs):
+            # stage 0 ingests micro-batch t (while t < M); others take the
+            # state handed over the ring last tick
+            inject = x_all[jnp.minimum(t, M - 1)]
+            state = jnp.where(r == 0, inject if t < M else state, state)
+            y = compute(p_here, state)
+            # last stage emits micro-batch t-(S-1) once the pipe is full
+            mb = t - (S - 1)
+            if 0 <= mb < M:
+                emit = jnp.where(r == S - 1, y, jnp.zeros_like(y))
+                outputs = outputs.at[mb].set(emit)
+            state = jax.lax.ppermute(
+                y, axis, [(j, (j + 1) % S) for j in range(S)])
+            return state, outputs
+
+        for t in range(M + S - 1):
+            state, outputs = tick(t, state, outputs)
+        # outputs live on the last ring rank only; share them ringwide
+        outputs = jax.lax.psum(outputs, axis)
+        return outputs
+
+    kwargs = dict(mesh=mesh.jax_mesh,
+                  in_specs=({k: param_specs[k] for k in stacked_params},
+                            x_spec),
+                  out_specs=out_spec, check_vma=False)
+    if partial_manual:
+        # manual only over the pp ring; dp/mp/sep stay GSPMD-automatic so
+        # hybrid tp/dp sharding inside a stage keeps working
+        kwargs["axis_names"] = {axis}
+    fn = shard_map(local, **kwargs)
+    return fn(stacked_params, x)

@@ -1,0 +1,94 @@
+"""Compiled SPMD pipeline tests: parity vs sequential stage application."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import ProcessMesh
+from paddle_tpu.parallel.mesh import set_mesh
+from paddle_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
+
+
+@pytest.fixture
+def mesh():
+    m = ProcessMesh(shape=(4,), dim_names=("pp",))
+    yield m
+    set_mesh(None)
+
+
+def _stage_fn(params, x):
+    # simple residual MLP stage
+    h = jnp.tanh(x @ params["w"] + params["b"])
+    return x + h
+
+
+def _make_stages(n, d, rng):
+    return [{"w": jnp.asarray(rng.normal(size=(d, d)) * 0.1, jnp.float32),
+             "b": jnp.zeros((d,), jnp.float32)} for _ in range(n)]
+
+
+def test_pipeline_matches_sequential(mesh):
+    rng = np.random.default_rng(0)
+    d, M, B = 8, 6, 4
+    stages = _make_stages(4, d, rng)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    out = spmd_pipeline(_stage_fn, stacked, x, mesh, n_micro=M)
+
+    ref = x
+    for st in stages:
+        ref = jax.vmap(lambda mb, st=st: _stage_fn(st, mb))(ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential(mesh):
+    rng = np.random.default_rng(1)
+    d, M, B = 4, 4, 2
+    stages = _make_stages(4, d, rng)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    def loss_pipe(params):
+        return jnp.sum(spmd_pipeline(_stage_fn, params, x, mesh, n_micro=M) ** 2)
+
+    def loss_seq(params):
+        ref = x
+        for i in range(4):
+            st = {k: v[i] for k, v in params.items()}
+            ref = jax.vmap(lambda mb, st=st: _stage_fn(st, mb))(ref)
+        return jnp.sum(ref ** 2)
+
+    g1 = jax.grad(loss_pipe)(stacked)
+    g2 = jax.grad(loss_seq)(stacked)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_pipeline_train_step_end_to_end(mesh):
+    """Full compiled train step: pipeline fwd + grad + sgd update."""
+    rng = np.random.default_rng(2)
+    d, M, B = 8, 4, 2
+    stages = _make_stages(4, d, rng)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            out = spmd_pipeline(_stage_fn, p, x, mesh, n_micro=M)
+            return jnp.mean((out - y) ** 2)
+        l, g = jax.value_and_grad(loss)(params)
+        return {k: v - 0.5 * g[k] for k, v in params.items()}, l
+
+    params = stacked
+    losses = []
+    for _ in range(12):
+        params, l = step(params)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses
