@@ -1,0 +1,177 @@
+// Shared-memory ring queue — dataloader worker -> trainer fast path.
+//
+// TPU-native equivalent of the reference's DataLoader shared-memory
+// tensor transport (python/paddle/io/dataloader/dataloader_iter.py worker
+// shared-mem + paddle/fluid/operators/reader/buffered_reader.cc): worker
+// processes serialize batches into fixed-size shm slots; the trainer maps
+// the same segment and pops without pipe copies or pickle overhead for
+// the bulk payload.
+//
+// Layout: [Header | slot_size * n_slots]. Single-producer-group /
+// single-consumer ring with atomic head/tail and per-slot ready flags
+// (multiple producers claim slots with fetch_add on `claim`).
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct Header {
+  uint64_t magic;
+  uint32_t n_slots;
+  uint32_t slot_size;          // payload bytes per slot (incl. 4-byte len)
+  std::atomic<uint64_t> claim; // next sequence number producers claim
+  std::atomic<uint64_t> tail;  // next sequence number consumer reads
+  // per-slot ready flags follow (n_slots bytes, atomic use)
+};
+
+constexpr uint64_t kMagic = 0x70616464746f7075ULL;  // "paddtopu"
+
+struct Handle {
+  int fd;
+  size_t total;
+  Header* hdr;
+  std::atomic<uint8_t>* ready;
+  char* slots;
+  std::string name;
+  bool owner;
+};
+
+size_t total_size(uint32_t n_slots, uint32_t slot_size) {
+  return sizeof(Header) + n_slots + static_cast<size_t>(n_slots) * slot_size;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shmq_create(const char* name, uint32_t n_slots, uint32_t slot_size) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = total_size(n_slots, slot_size);
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  hdr->magic = kMagic;
+  hdr->n_slots = n_slots;
+  hdr->slot_size = slot_size;
+  hdr->claim.store(0);
+  hdr->tail.store(0);
+  auto* ready = reinterpret_cast<std::atomic<uint8_t>*>(
+      static_cast<char*>(mem) + sizeof(Header));
+  for (uint32_t i = 0; i < n_slots; ++i) ready[i].store(0);
+  auto* h = new Handle{fd, total, hdr, ready,
+                       static_cast<char*>(mem) + sizeof(Header) + n_slots,
+                       name, true};
+  return h;
+}
+
+void* shmq_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    ::close(fd);
+    return nullptr;
+  }
+  auto* ready = reinterpret_cast<std::atomic<uint8_t>*>(
+      static_cast<char*>(mem) + sizeof(Header));
+  auto* h = new Handle{fd, static_cast<size_t>(st.st_size), hdr, ready,
+                       static_cast<char*>(mem) + sizeof(Header) + hdr->n_slots,
+                       name, false};
+  return h;
+}
+
+// push: claim a sequence slot, spin until it is free, write payload.
+// returns 0 ok, -1 payload too large, -2 timed out waiting for space.
+int shmq_push(void* handle, const char* data, uint32_t len, int64_t timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  Header* hdr = h->hdr;
+  if (len + 4 > hdr->slot_size) return -1;
+  uint64_t seq = hdr->claim.fetch_add(1);
+  uint32_t slot = static_cast<uint32_t>(seq % hdr->n_slots);
+  // wait until the consumer has drained the previous occupant of this slot
+  int64_t waited = 0;
+  while (h->ready[slot].load(std::memory_order_acquire) != 0 ||
+         seq >= hdr->tail.load(std::memory_order_acquire) + hdr->n_slots) {
+    usleep(200);
+    waited += 1;
+    if (timeout_ms >= 0 && waited * 200 / 1000 > timeout_ms) return -2;
+  }
+  char* p = h->slots + static_cast<size_t>(slot) * hdr->slot_size;
+  memcpy(p, &len, 4);
+  memcpy(p + 4, data, len);
+  h->ready[slot].store(1, std::memory_order_release);
+  return 0;
+}
+
+// pop: wait for the tail slot to become ready, copy out. returns payload
+// length, or -1 buffer too small, -2 timeout.
+int shmq_pop(void* handle, char* buf, uint32_t buflen, int64_t timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  Header* hdr = h->hdr;
+  uint64_t seq = hdr->tail.load(std::memory_order_relaxed);
+  uint32_t slot = static_cast<uint32_t>(seq % hdr->n_slots);
+  int64_t waited_us = 0;
+  while (h->ready[slot].load(std::memory_order_acquire) == 0) {
+    usleep(200);
+    waited_us += 200;
+    if (timeout_ms >= 0 && waited_us / 1000 > timeout_ms) return -2;
+  }
+  char* p = h->slots + static_cast<size_t>(slot) * hdr->slot_size;
+  uint32_t len;
+  memcpy(&len, p, 4);
+  if (len > buflen) return -1;
+  memcpy(buf, p + 4, len);
+  h->ready[slot].store(0, std::memory_order_release);
+  hdr->tail.store(seq + 1, std::memory_order_release);
+  return static_cast<int>(len);
+}
+
+uint32_t shmq_slot_size(void* handle) {
+  return static_cast<Handle*>(handle)->hdr->slot_size;
+}
+
+int shmq_pending(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  return static_cast<int>(h->hdr->claim.load() - h->hdr->tail.load());
+}
+
+void shmq_close(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  munmap(h->hdr, h->total);
+  ::close(h->fd);
+  if (h->owner) shm_unlink(h->name.c_str());
+  delete h;
+}
+
+}  // extern "C"

@@ -34,6 +34,11 @@ from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed.fleet import DistributedStrategy  # noqa: F401
 
 
+def __getattr_tcpstore():
+    from paddle_tpu.native import TCPStore
+    return TCPStore
+
+
 def get_mesh_or_init():
     m = get_mesh()
     if m is None:
@@ -48,4 +53,8 @@ def __getattr__(name):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod
         return mod
+    if name == "TCPStore":  # native store; compiled lazily on first use
+        cls = __getattr_tcpstore()
+        globals()[name] = cls
+        return cls
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -127,6 +127,9 @@ class DataLoader:
                 yield self.collate_fn(batch)
 
     def __iter__(self):
+        if self.num_workers > 0 and not self._is_iterable:
+            from paddle_tpu.io.worker_pool import MultiProcessIter
+            return MultiProcessIter(self)
         if self.use_buffer_reader:
             return _PrefetchIter(self)
         return self._iter_batches()

@@ -1,0 +1,164 @@
+"""Multi-process DataLoader workers over the native shm ring.
+
+Analog of _DataLoaderIterMultiProcess (python/paddle/io/dataloader/
+dataloader_iter.py): worker subprocesses pull index lists from a task
+pipe, build+collate batches, and push serialized numpy payloads through
+the shared-memory ring (csrc/shm_queue.cpp) — the bulk tensor bytes never
+transit a pickle pipe, mirroring the reference's shared-mem tensor
+transport. Batch order is restored on the consumer side via sequence ids.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import multiprocessing as mp
+import os
+import pickle
+import queue as pyqueue
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["MultiProcessIter"]
+
+
+def _serialize_batch(seq: int, batch) -> bytes:
+    """[seq u64][npy-count u32][npy blobs...][pickle rest]. Tensors/ndarrays
+    go as raw .npy blobs (zero-pickle bulk); structure via a small pickle."""
+    arrays = []
+
+    def strip(obj):
+        if isinstance(obj, Tensor):
+            arrays.append(np.asarray(obj.value))
+            return ("__arr__", len(arrays) - 1)
+        if isinstance(obj, np.ndarray):
+            arrays.append(obj)
+            return ("__arr__", len(arrays) - 1)
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(strip(x) for x in obj)
+        if isinstance(obj, dict):
+            return {k: strip(v) for k, v in obj.items()}
+        return obj
+
+    structure = strip(batch)
+    out = bytearray(struct.pack("<QI", seq, len(arrays)))
+    for a in arrays:
+        buf = _io.BytesIO()
+        np.save(buf, a, allow_pickle=False)
+        blob = buf.getvalue()
+        out += struct.pack("<I", len(blob))
+        out += blob
+    out += pickle.dumps(structure)
+    return bytes(out)
+
+
+def _deserialize_batch(data: bytes):
+    seq, n = struct.unpack_from("<QI", data, 0)
+    off = 12
+    arrays = []
+    for _ in range(n):
+        (blen,) = struct.unpack_from("<I", data, off)
+        off += 4
+        arrays.append(np.load(_io.BytesIO(data[off:off + blen]),
+                              allow_pickle=False))
+        off += blen
+    structure = pickle.loads(data[off:])
+
+    def rebuild(obj):
+        if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__arr__":
+            return Tensor(arrays[obj[1]])
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(rebuild(x) for x in obj)
+        if isinstance(obj, dict):
+            return {k: rebuild(v) for k, v in obj.items()}
+        return obj
+
+    return seq, rebuild(structure)
+
+
+def _worker_main(dataset, collate_fn, qname, task_q, init_fn, wid):
+    from paddle_tpu.native import ShmQueue
+    if init_fn is not None:
+        init_fn(wid)
+    shm = ShmQueue(qname, create=False)
+    while True:
+        task = task_q.get()
+        if task is None:
+            break
+        seq, indices = task
+        batch = collate_fn([dataset[i] for i in indices])
+        shm.push(_serialize_batch(seq, batch), timeout=300.0)
+
+
+class MultiProcessIter:
+    def __init__(self, loader):
+        from paddle_tpu.native import ShmQueue
+        self.loader = loader
+        self._qname = f"ptdl_{os.getpid()}_{id(self) & 0xFFFF}"
+        slot = 1 << 24  # 16MB batches
+        self._shm = ShmQueue(self._qname, n_slots=2 * loader.num_workers + 2,
+                             slot_size=slot, create=True)
+        ctx = mp.get_context("spawn")
+        self._task_q = ctx.Queue()
+        self._procs = [
+            ctx.Process(target=_worker_main,
+                        args=(loader.dataset, loader.collate_fn, self._qname,
+                              self._task_q, None, w), daemon=True)
+            for w in range(loader.num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self._batches = list(loader.batch_sampler)
+        self._n = len(self._batches)
+        self._sent = 0
+        self._received = 0
+        self._reorder = {}
+        self._next_seq = 0
+        # seed the pipeline: 2 outstanding tasks per worker
+        for _ in range(min(self._n, 2 * loader.num_workers)):
+            self._send_next()
+
+    def _send_next(self):
+        if self._sent < self._n:
+            self._task_q.put((self._sent, self._batches[self._sent]))
+            self._sent += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_seq >= self._n:
+            self._shutdown()
+            raise StopIteration
+        while self._next_seq not in self._reorder:
+            data = self._shm.pop(timeout=300.0)
+            seq, batch = _deserialize_batch(data)
+            self._reorder[seq] = batch
+            self._received += 1
+            self._send_next()
+        batch = self._reorder.pop(self._next_seq)
+        self._next_seq += 1
+        return batch
+
+    def _shutdown(self):
+        for _ in self._procs:
+            self._task_q.put(None)
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._shm.close()
+
+    def __len__(self):
+        return self._n
+
+    def __del__(self):
+        try:
+            if any(p.is_alive() for p in self._procs):
+                self._shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,65 @@
+"""Shared-memory queue python surface (dataloader worker transport)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+__all__ = ["ShmQueue"]
+
+
+class ShmQueue:
+    """Fixed-slot shared-memory ring queue across processes.
+
+    create=True allocates (owner unlinks on close); workers open by name.
+    Payloads are raw bytes (callers serialize; io.dataloader uses numpy
+    .tobytes + shape/dtype header).
+    """
+
+    def __init__(self, name: str, n_slots: int = 8,
+                 slot_size: int = 1 << 22, create: bool = False):
+        from paddle_tpu.native import load_library
+        self._lib = load_library()
+        self.name = name if name.startswith("/") else "/" + name
+        if create:
+            self._h = self._lib.shmq_create(self.name.encode(), n_slots,
+                                            slot_size)
+        else:
+            self._h = self._lib.shmq_open(self.name.encode())
+        if not self._h:
+            raise OSError(f"ShmQueue {'create' if create else 'open'} "
+                          f"{self.name} failed")
+
+    def push(self, data: bytes, timeout: Optional[float] = None) -> None:
+        t = int(timeout * 1000) if timeout is not None else -1
+        rc = self._lib.shmq_push(self._h, data, len(data), t)
+        if rc == -1:
+            raise ValueError(f"payload {len(data)} exceeds slot size "
+                             f"{self._lib.shmq_slot_size(self._h) - 4}")
+        if rc == -2:
+            raise TimeoutError("ShmQueue push timed out (queue full)")
+
+    def pop(self, timeout: Optional[float] = None) -> bytes:
+        size = self._lib.shmq_slot_size(self._h)
+        buf = ctypes.create_string_buffer(size)
+        t = int(timeout * 1000) if timeout is not None else -1
+        n = self._lib.shmq_pop(self._h, buf, size, t)
+        if n == -2:
+            raise TimeoutError("ShmQueue pop timed out")
+        if n < 0:
+            raise IOError("ShmQueue pop failed")
+        return buf.raw[:n]
+
+    def pending(self) -> int:
+        return int(self._lib.shmq_pending(self._h))
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.shmq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

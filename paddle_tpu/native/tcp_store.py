@@ -1,0 +1,96 @@
+"""TCPStore python surface (phi TCPStore parity: set/get/wait/add +
+barrier built on add/wait, tcp_store.h:121)."""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Optional
+
+__all__ = ["TCPStore"]
+
+
+class TCPStore:
+    """KV store + barrier over the native server.
+
+    ``TCPStore(host, port, is_master=True)`` starts the in-process server
+    (master rank) and connects a client; workers connect only.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        from paddle_tpu.native import load_library
+        self._lib = load_library()
+        self._server = None
+        self.world_size = world_size
+        self.timeout = timeout
+        if is_master:
+            handle = ctypes.c_void_p()
+            rc = self._lib.ts_server_start(host.encode(), port,
+                                           ctypes.byref(handle))
+            if rc < 0:
+                raise OSError(f"TCPStore server failed to start (errno {-rc})")
+            self._server = handle
+            port = rc
+        self.host, self.port = host, port
+        deadline = time.time() + timeout
+        fd = -1
+        while time.time() < deadline:
+            fd = self._lib.ts_client_connect(host.encode(), port)
+            if fd >= 0:
+                break
+            time.sleep(0.05)
+        if fd < 0:
+            raise ConnectionError(f"TCPStore connect {host}:{port} failed")
+        self._fd = fd
+
+    # -- kv -----------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._lib.ts_set(self._fd, key.encode(), data, len(data)) != 0:
+            raise IOError("TCPStore set failed")
+
+    def get(self, key: str) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.ts_get(self._fd, key.encode(), buf, len(buf))
+        if n == -1:
+            return None
+        if n < 0:
+            raise IOError("TCPStore get io error")
+        return buf.raw[:n]
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        t = int((timeout if timeout is not None else self.timeout) * 1000)
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.ts_wait(self._fd, key.encode(), t, buf, len(buf))
+        if n == -1:
+            raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+        if n < 0:
+            raise IOError("TCPStore wait io error")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        r = self._lib.ts_add(self._fd, key.encode(), delta)
+        if r == -(2 ** 63):
+            raise IOError("TCPStore add io error")
+        return int(r)
+
+    def delete_key(self, key: str) -> None:
+        self._lib.ts_delete(self._fd, key.encode())
+
+    # -- barrier (store-based, parallel.py init barrier analog) -------------
+    def barrier(self, name: str = "default", timeout: Optional[float] = None):
+        n = self.add(f"__barrier__/{name}/count", 1)
+        if n == self.world_size:
+            self.set(f"__barrier__/{name}/go", b"1")
+        self.wait(f"__barrier__/{name}/go", timeout)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_fd", -1) >= 0:
+                self._lib.ts_close(self._fd)
+            if getattr(self, "_server", None):
+                self._lib.ts_server_stop(self._server)
+        except Exception:
+            pass
