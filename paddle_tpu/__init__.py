@@ -49,8 +49,18 @@ def __getattr__(name):
     # heavier subsystems load lazily: distributed, profiler, vision, incubate
     if name in ("distributed", "profiler", "vision", "incubate", "models",
                 "static", "hapi", "device", "distribution", "sparse",
-                "quantization"):
+                "quantization", "text", "audio", "fft", "signal", "onnx",
+                "linalg"):
         mod = _lazy(name)
         globals()[name] = mod
         return mod
+    if name in ("Model", "summary"):  # paddle.Model / paddle.summary
+        from paddle_tpu import hapi
+        val = getattr(hapi, name)
+        globals()[name] = val
+        return val
+    if name == "DataParallel":
+        from paddle_tpu.distributed.parallel import DataParallel
+        globals()[name] = DataParallel
+        return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

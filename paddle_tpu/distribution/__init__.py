@@ -1,0 +1,490 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Analog of python/paddle/distribution/ (SURVEY P17): Distribution base with
+sample/log_prob/entropy, the standard families, and a kl_divergence
+registry. Sampling uses the framework's functional PRNG (framework.random
+split keys), so results are reproducible under paddle.seed and traceable
+under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework import random as rnd
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
+    "Multinomial", "Geometric", "kl_divergence", "register_kl",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _shape(sample_shape) -> tuple:
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        eps = jax.random.normal(key, _shape(shape) + self.batch_shape)
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.base.loc + self.base.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.base.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.base.loc + s2))
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self.base.sample(shape).value))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(self.base.log_prob(jnp.log(v)).value - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(self.base.entropy().value + self.base.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        u = jax.random.uniform(key, _shape(shape) + self.batch_shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v <= self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _v(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _v(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        return Tensor(jax.random.bernoulli(
+            key, self.probs, _shape(shape) + self.batch_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jax.nn.log_sigmoid(self.logits)
+                      + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        eps = 1e-12
+        return Tensor(-(p * jnp.log(p + eps) + (1 - p) * jnp.log(1 - p + eps)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _v(logits)
+            self.probs = jax.nn.softmax(self.logits, -1)
+        elif probs is not None:
+            self.probs = _v(probs)
+            self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+            self.logits = jnp.log(self.probs + 1e-30)
+        else:
+            raise ValueError("pass logits or probs")
+        super().__init__(self.probs.shape[:-1])
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=_shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        idx = _v(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(jnp.take_along_axis(logp, idx[..., None], -1)[..., 0])
+
+    def probs_of(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(self.probs * logp, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        cat = jax.random.categorical(
+            key, jnp.log(self.probs + 1e-30),
+            shape=_shape(shape) + (self.total_count,) + self.batch_shape)
+        onehot = jax.nn.one_hot(cat, self.probs.shape[-1])
+        axis = len(_shape(shape))
+        return Tensor(jnp.sum(onehot, axis=axis))
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import gammaln
+        return Tensor(gammaln(self.total_count + 1.0)
+                      - jnp.sum(gammaln(v + 1.0), -1)
+                      + jnp.sum(v * jnp.log(self.probs + 1e-30), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate ** -2)
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        e = jax.random.exponential(key, _shape(shape) + self.batch_shape)
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        g = jax.random.gamma(key, self.concentration,
+                             _shape(shape) + self.batch_shape)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        return Tensor(jax.random.beta(key, self.alpha, self.beta,
+                                      _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _v(value)
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        return Tensor(jax.random.dirichlet(key, self.concentration,
+                                           _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
+                      + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        u = jax.random.uniform(key, _shape(shape) + self.batch_shape,
+                               minval=-0.5, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs)
+
+    def sample(self, shape=()):
+        key = rnd.split_key()
+        u = jax.random.uniform(key, _shape(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1.0)
+        return Tensor(jnp.ceil(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor((v - 1) * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+# -- KL registry -------------------------------------------------------------
+
+_KL_TABLE = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_TABLE[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_TABLE.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(p.probs * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = 1e-12
+    a, b = p.probs, q.probs
+    return Tensor(a * (jnp.log(a + eps) - jnp.log(b + eps))
+                  + (1 - a) * (jnp.log(1 - a + eps) - jnp.log(1 - b + eps)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(1 / r) + r - 1)

@@ -1,0 +1,181 @@
+"""hapi Model — Keras-like fit/evaluate/predict.
+
+Analog of python/paddle/hapi/model.py:1052 (`Model.fit`). The reference
+maintains separate dynamic/static adapters; here eager execution is
+already compile-and-cache, so one code path serves both (`prepare` +
+fit/evaluate/predict/save/load/summary).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.hapi.callbacks import Callback, CallbackList, ProgBarLogger
+from paddle_tpu.io import DataLoader
+from paddle_tpu.metric import Metric
+
+__all__ = ["Model"]
+
+
+def _to_loader(data, batch_size, shuffle):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            metrics = []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+
+    # -- steps --------------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        loss = self._loss(out, *(labels if isinstance(labels, (list, tuple))
+                                 else [labels]))
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return float(loss.numpy()), out
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with paddle.no_grad():
+            out = self.network(*inputs)
+            loss = self._loss(out, *(labels if isinstance(labels, (list, tuple))
+                                     else [labels])) if self._loss else None
+        return (float(loss.numpy()) if loss is not None else None), out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with paddle.no_grad():
+            return self.network(*inputs)
+
+    # -- high level ---------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = _to_loader(train_data, batch_size, shuffle)
+        eval_loader = _to_loader(eval_data, batch_size, False)
+        cbks = CallbackList(callbacks)
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            from paddle_tpu.hapi.callbacks import ModelCheckpoint
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+        cbks.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            epoch_losses = []
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                x, y = self._split_batch(batch)
+                loss, out = self.train_batch(x, y)
+                epoch_losses.append(loss)
+                logs = {"loss": loss}
+                for m in self._metrics:
+                    m.update(m.compute(out, *y))
+                    logs[m.name()] = m.accumulate()
+                cbks.on_train_batch_end(step, logs)
+            logs = {"loss": float(np.mean(epoch_losses))}
+            history["loss"].append(logs["loss"])
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end()
+        return history
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], [None]
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        loader = _to_loader(eval_data, batch_size, False)
+        cbks = _callbacks or CallbackList(callbacks)
+        if _callbacks is None:
+            cbks.set_model(self)
+            cbks.set_params({"verbose": verbose})
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            x, y = self._split_batch(batch)
+            loss, out = self.eval_batch(x, y)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                m.update(m.compute(out, *y))
+            cbks.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = _to_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch(x))
+        if stack_outputs:
+            import jax.numpy as jnp
+            return Tensor(jnp.concatenate([o.value for o in outputs]))
+        return outputs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(paddle.load(path + ".pdparams"))
+        import os
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(path + ".pdopt")):
+            self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi.summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

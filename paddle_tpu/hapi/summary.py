@@ -1,0 +1,33 @@
+"""paddle.summary analog (python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, sub in net.named_sublayers(include_self=True):
+        own = [p for p in sub._parameters.values() if p is not None]
+        n = int(sum(int(np.prod(p.shape)) for p in own))
+        t = int(sum(int(np.prod(p.shape)) for p in own if not p.stop_gradient))
+        if n or name == "":
+            rows.append((name or type(net).__name__,
+                         type(sub).__name__, n))
+        total_params += n
+        trainable_params += t
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    print(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
+    print("-" * (width + 36))
+    for name, tname, n in rows:
+        print(f"{name:<{width}}{tname:<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable_params:,}")
+    return {"total_params": total_params, "trainable_params": trainable_params}

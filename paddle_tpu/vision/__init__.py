@@ -1,0 +1,5 @@
+"""paddle_tpu.vision — torchvision-like models/transforms/datasets
+(python/paddle/vision/ analog, SURVEY P16)."""
+
+from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision.models import *  # noqa: F401,F403

@@ -1,0 +1,121 @@
+"""Vision datasets (python/paddle/vision/datasets/ analog).
+
+No network egress in this environment, so MNIST/Cifar load from local
+files when present (same on-disk formats as the reference) and raise a
+clear error otherwise; FakeData provides deterministic synthetic images
+for tests/benchmarks (the reference's approach of faking data sources in
+CI, SURVEY §4e)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=128, image_shape=(3, 32, 32), num_classes=10,
+                 transform: Optional[Callable] = None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+class MNIST(Dataset):
+    """idx-format MNIST (reference: vision/datasets/mnist.py), local files
+    only: pass image_path/label_path to the raw gz files."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download:
+            raise RuntimeError("no network egress; place MNIST idx files "
+                               "locally and pass image_path/label_path")
+        if image_path is None or label_path is None:
+            raise ValueError("MNIST requires local image_path and label_path")
+        self.transform = transform
+        with gzip.open(image_path, "rb") as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], "big")
+        rows = int.from_bytes(data[8:12], "big")
+        cols = int.from_bytes(data[12:16], "big")
+        self.images = np.frombuffer(data, np.uint8, offset=16).reshape(
+            n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            ldata = f.read()
+        self.labels = np.frombuffer(ldata, np.uint8, offset=8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """python-pickle CIFAR tarball (reference: vision/datasets/cifar.py),
+    local file only."""
+
+    N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download:
+            raise RuntimeError("no network egress; pass a local data_file")
+        if data_file is None:
+            raise ValueError("Cifar10 requires a local data_file tar.gz")
+        self.transform = transform
+        want = "test_batch" if mode == "test" else "data_batch"
+        if self.N_CLASSES == 100:
+            want = "test" if mode == "test" else "train"
+        images, labels = [], []
+        with tarfile.open(data_file, "r:gz") as tf:
+            for member in tf.getmembers():
+                if want in member.name:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images.append(d[b"data"])
+                    key = b"labels" if b"labels" in d else b"fine_labels"
+                    labels.extend(d[key])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    N_CLASSES = 100

@@ -1,0 +1,9 @@
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
+    resnet101, resnet152, wide_resnet50_2, wide_resnet101_2,
+)
+from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
+)
+from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401

@@ -1,0 +1,119 @@
+"""MobileNetV1/V2 (python/paddle/vision/models/mobilenetv1.py / v2 analog)."""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _conv_bn(in_c, out_c, k, stride=1, padding=0, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(out_c), nn.ReLU6())
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.dw = _conv_bn(in_c, in_c, 3, stride, 1, groups=in_c)
+        self.pw = _conv_bn(in_c, out_c, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1), (c(256), c(512), 2),
+               *[(c(512), c(512), 1)] * 5,
+               (c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        layers += [_DepthwiseSeparable(i, o, s) for i, o, s in cfg]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(inp, hidden, 1))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride, 1, groups=hidden),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = max(8, int(32 * scale))
+        last_c = max(8, int(1280 * scale))
+        layers = [_conv_bn(3, in_c, 3, stride=2, padding=1)]
+        for t, ch, n, s in cfg:
+            out_c = max(8, int(ch * scale))
+            for i in range(n):
+                layers.append(_InvertedResidual(in_c, out_c,
+                                                s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(_conv_bn(in_c, last_c, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    if pretrained:
+        raise NotImplementedError("no network access for pretrained weights")
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    if pretrained:
+        raise NotImplementedError("no network access for pretrained weights")
+    return MobileNetV2(scale=scale, **kw)

@@ -1,0 +1,210 @@
+"""Vision transforms (python/paddle/vision/transforms/ analog).
+
+numpy-based host-side transforms; images are HWC uint8/float arrays (or
+CHW when `data_format='CHW'` output is requested by ToTensor/Normalize).
+"""
+
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "BrightnessTransform", "to_tensor", "normalize", "resize", "hflip",
+    "center_crop",
+]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def _as_float(img):
+    img = np.asarray(img)
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0
+    return img.astype(np.float32)
+
+
+def to_tensor(img, data_format="CHW") -> Tensor:
+    arr = _as_float(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """HWC numpy resize via jax.image (device-side when under jit)."""
+    import jax.image
+
+    arr = np.asarray(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    out = np.asarray(jax.image.resize(
+        arr.astype(np.float32), (size[0], size[1], arr.shape[2]),
+        method=interpolation))
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out[:, :, 0] if squeeze else out
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return arr[i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)]
+            if arr.ndim == 3:
+                pad.append((0, 0))
+            arr = np.pad(arr, pad, mode="constant")
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return arr[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        pad = [(p[1], p[3]), (p[0], p[2])]
+        if arr.ndim == 3:
+            pad.append((0, 0))
+        kwargs = {"constant_values": self.fill} if self.mode == "constant" else {}
+        return np.pad(arr, pad, mode=self.mode, **kwargs)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        factor = 1.0 + random.uniform(-self.value, self.value)
+        out = arr * factor
+        if np.asarray(img).dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out

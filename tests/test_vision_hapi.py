@@ -1,0 +1,133 @@
+"""Vision models / transforms / hapi Model / distribution tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_resnet_variants_forward():
+    from paddle_tpu.vision.models import resnet18, resnet50
+    x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
+    assert resnet18(num_classes=7)(x).shape == (1, 7)
+    assert resnet50(num_classes=5)(x).shape == (1, 5)
+
+
+def test_mobilenet_vgg_lenet_forward():
+    from paddle_tpu.vision.models import LeNet, mobilenet_v2, vgg11
+    x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
+    assert mobilenet_v2(scale=0.35, num_classes=4)(x).shape == (1, 4)
+    xv = paddle.to_tensor(np.random.rand(1, 3, 224, 224).astype(np.float32))
+    assert vgg11(num_classes=3)(xv).shape == (1, 3)
+    xm = paddle.to_tensor(np.random.rand(2, 1, 28, 28).astype(np.float32))
+    assert LeNet()(xm).shape == (2, 10)
+
+
+def test_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.rand(40, 60, 3) * 255).astype(np.uint8)
+    pipe = T.Compose([T.Resize(32), T.CenterCrop(32), T.ToTensor(),
+                      T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])])
+    out = pipe(img)
+    assert out.shape == (3, 32, 32)
+    assert float(out.numpy().max()) <= 1.0 + 1e-6
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.datasets import FakeData
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    model = Model(net)
+    model.prepare(optimizer=paddle.optimizer.AdamW(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=[Accuracy()])
+    hist = model.fit(ds, epochs=8, batch_size=16, verbose=0, shuffle=True)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.8
+    pred = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert pred.shape == (64, 3)
+    model.save(str(tmp_path / "m"))
+    net2 = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    m2 = Model(net2)
+    m2.load(str(tmp_path / "m"), reset_optimizer=True)
+    np.testing.assert_allclose(
+        m2.predict(ds, batch_size=64, stack_outputs=True).numpy(),
+        pred.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_hapi_early_stopping():
+    from paddle_tpu.hapi import EarlyStopping, Model
+    from paddle_tpu.io import TensorDataset
+    X = np.random.rand(16, 4).astype(np.float32)
+    y = np.random.randint(0, 2, 16).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(0.0, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=8, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # lr=0 -> no improvement -> stopped early
+
+
+def test_distribution_normal_sampling_and_kl():
+    import paddle_tpu.distribution as D
+    paddle.seed(0)
+    n = D.Normal(1.0, 2.0)
+    s = n.sample((5000,))
+    assert abs(float(np.mean(s.numpy())) - 1.0) < 0.15
+    assert abs(float(np.std(s.numpy())) - 2.0) < 0.15
+    lp = n.log_prob(paddle.to_tensor(1.0))
+    import math
+    np.testing.assert_allclose(float(lp.numpy()),
+                               -math.log(2.0) - 0.5 * math.log(2 * math.pi),
+                               rtol=1e-5)
+    m = D.Normal(0.0, 1.0)
+    kl = D.kl_divergence(n, m)
+    expected = 0.5 * (4.0 + 1.0 - 1.0 - math.log(4.0))
+    np.testing.assert_allclose(float(kl.numpy()), expected, rtol=1e-5)
+
+
+def test_distribution_categorical_beta_gamma():
+    import paddle_tpu.distribution as D
+    paddle.seed(1)
+    c = D.Categorical(logits=np.log(np.asarray([0.2, 0.3, 0.5], np.float32)))
+    s = c.sample((8000,))
+    freqs = np.bincount(s.numpy().astype(int), minlength=3) / 8000
+    np.testing.assert_allclose(freqs, [0.2, 0.3, 0.5], atol=0.03)
+    np.testing.assert_allclose(float(c.entropy().numpy()),
+                               -(0.2 * np.log(0.2) + 0.3 * np.log(0.3)
+                                 + 0.5 * np.log(0.5)), rtol=1e-5)
+    b = D.Beta(2.0, 3.0)
+    np.testing.assert_allclose(float(b.mean.numpy()), 0.4, rtol=1e-6)
+    g = D.Gamma(3.0, 2.0)
+    np.testing.assert_allclose(float(g.mean.numpy()), 1.5, rtol=1e-6)
+    sg = g.sample((4000,))
+    assert abs(float(np.mean(sg.numpy())) - 1.5) < 0.1
+
+
+def test_fake_data_and_resnet_training_step():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.datasets import FakeData
+    from paddle_tpu.vision.models import resnet18
+
+    ds = FakeData(size=8, image_shape=(3, 32, 32), num_classes=4)
+    net = resnet18(num_classes=4)
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), metrics=[Accuracy()])
+    hist = model.fit(ds, epochs=1, batch_size=4, verbose=0)
+    assert len(hist["loss"]) == 1 and np.isfinite(hist["loss"][0])
