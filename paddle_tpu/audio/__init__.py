@@ -1,0 +1,4 @@
+"""paddle_tpu.audio — audio features (python/paddle/audio/ analog)."""
+
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio.features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

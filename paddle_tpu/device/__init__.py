@@ -1,0 +1,175 @@
+"""paddle_tpu.device — device management namespace (P12 analog).
+
+paddle.device.cuda.* maps to the TPU runtime where a real equivalent
+exists (memory stats via jax device memory profile, synchronize, device
+properties); stream/graph APIs are no-ops with documented reasons (XLA
+owns scheduling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from paddle_tpu.framework.device import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, current_place, device_count, get_device,
+    is_compiled_with_tpu, set_device, synchronize,
+)
+
+__all__ = ["set_device", "get_device", "device_count", "synchronize",
+           "get_available_device", "get_available_custom_device",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "is_compiled_with_tpu", "cuda", "tpu",
+           "Stream", "Event", "current_stream", "stream_guard"]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+class Stream:
+    """XLA owns stream scheduling; kept for API parity (device/cuda/streams
+    analog). Work enqueued 'on' a Stream is just async dispatch."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, other):
+        pass
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end: "Event") -> float:
+        if self._t is None or end._t is None:
+            return 0.0
+        return (end._t - self._t) * 1000.0
+
+
+_CURRENT_STREAM = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _CURRENT_STREAM
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _DeviceNamespace:
+    """Shared surface for paddle.device.cuda / paddle.device.tpu."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count() -> int:
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _CURRENT_STREAM
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def memory_stats(device: Optional[int] = None) -> dict:
+        d = jax.devices()[device or 0]
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        return stats
+
+    @classmethod
+    def max_memory_allocated(cls, device=None) -> int:
+        return int(cls.memory_stats(device).get("peak_bytes_in_use", 0))
+
+    @classmethod
+    def memory_allocated(cls, device=None) -> int:
+        return int(cls.memory_stats(device).get("bytes_in_use", 0))
+
+    @classmethod
+    def max_memory_reserved(cls, device=None) -> int:
+        return int(cls.memory_stats(device).get("bytes_limit", 0))
+
+    @classmethod
+    def memory_reserved(cls, device=None) -> int:
+        return int(cls.memory_stats(device).get("bytes_reserved",
+                                                cls.memory_allocated(device)))
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = jax.devices()[device or 0]
+        class _Props:
+            name = str(d.device_kind)
+            platform = d.platform
+        return _Props()
+
+    @staticmethod
+    def get_device_name(device=None) -> str:
+        return str(jax.devices()[device or 0].device_kind)
+
+
+cuda = _DeviceNamespace()
+tpu = _DeviceNamespace()

@@ -1,10 +1,14 @@
 """paddle_tpu.distribution — probability distributions.
 
 Analog of python/paddle/distribution/ (SURVEY P17): Distribution base with
-sample/log_prob/entropy, the standard families, and a kl_divergence
-registry. Sampling uses the framework's functional PRNG (framework.random
-split keys), so results are reproducible under paddle.seed and traceable
-under jit.
+sample/rsample/log_prob/entropy, the standard families, and a
+kl_divergence registry.
+
+Differentiability: every formula is written in framework Tensor ops, so
+log_prob/entropy/kl are recorded on the autograd tape and gradients flow
+to learnable parameters (VAE/policy-gradient use). ``rsample`` draws the
+base noise with the functional PRNG and applies the reparameterization in
+Tensor math, so pathwise gradients work. ``sample`` detaches.
 """
 
 from __future__ import annotations
@@ -15,8 +19,10 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
 from paddle_tpu.framework import random as rnd
-from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.framework.tensor import Tensor, to_tensor
 
 __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
@@ -24,11 +30,13 @@ __all__ = [
     "Multinomial", "Geometric", "kl_divergence", "register_kl",
 ]
 
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
 
-def _v(x):
+
+def _t(x) -> Tensor:
     if isinstance(x, Tensor):
-        return x.value
-    return jnp.asarray(x, jnp.float32)
+        return x
+    return to_tensor(x, dtype="float32")
 
 
 def _shape(sample_shape) -> tuple:
@@ -37,6 +45,11 @@ def _shape(sample_shape) -> tuple:
     if isinstance(sample_shape, int):
         return (sample_shape,)
     return tuple(int(s) for s in sample_shape)
+
+
+def _noise(fn, shape):
+    """Draw base noise with the functional PRNG (detached by design)."""
+    return Tensor(fn(rnd.split_key(), shape))
 
 
 class Distribution:
@@ -61,16 +74,16 @@ class Distribution:
         raise NotImplementedError
 
     def sample(self, shape=()):
-        raise NotImplementedError
+        return self.rsample(shape).detach()
 
     def rsample(self, shape=()):
-        return self.sample(shape)
+        raise NotImplementedError
 
     def log_prob(self, value) -> Tensor:
         raise NotImplementedError
 
     def prob(self, value) -> Tensor:
-        return Tensor(jnp.exp(self.log_prob(value).value))
+        return paddle.exp(self.log_prob(value))
 
     def entropy(self) -> Tensor:
         raise NotImplementedError
@@ -81,38 +94,36 @@ class Distribution:
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = _v(loc)
-        self.scale = _v(scale)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+        return paddle.broadcast_to(self.loc, list(self.batch_shape)) \
+            if self.batch_shape else self.loc
 
     @property
     def variance(self):
-        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+        return self.scale * self.scale
 
     @property
     def stddev(self):
-        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+        return self.scale
 
-    def sample(self, shape=()):
-        key = rnd.split_key()
-        eps = jax.random.normal(key, _shape(shape) + self.batch_shape)
-        return Tensor(self.loc + self.scale * eps)
-
-    rsample = sample
+    def rsample(self, shape=()):
+        eps = _noise(lambda k, s: jax.random.normal(k, s),
+                     _shape(shape) + self.batch_shape)
+        return self.loc + self.scale * eps
 
     def log_prob(self, value):
-        v = _v(value)
-        var = self.scale ** 2
-        return Tensor(-((v - self.loc) ** 2) / (2 * var)
-                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        v = _t(value)
+        d = v - self.loc
+        return -(d * d) / (2.0 * self.scale * self.scale) \
+            - paddle.log(self.scale) - _HALF_LOG_2PI
 
     def entropy(self):
-        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
-        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+        return 0.5 + _HALF_LOG_2PI + paddle.log(self.scale)
 
 
 class LogNormal(Distribution):
@@ -122,54 +133,53 @@ class LogNormal(Distribution):
 
     @property
     def mean(self):
-        return Tensor(jnp.exp(self.base.loc + self.base.scale ** 2 / 2))
+        return paddle.exp(self.base.loc + self.base.variance * 0.5)
 
     @property
     def variance(self):
-        s2 = self.base.scale ** 2
-        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.base.loc + s2))
+        s2 = self.base.variance
+        return (paddle.exp(s2) - 1.0) * paddle.exp(2.0 * self.base.loc + s2)
 
-    def sample(self, shape=()):
-        return Tensor(jnp.exp(self.base.sample(shape).value))
+    def rsample(self, shape=()):
+        return paddle.exp(self.base.rsample(shape))
 
     def log_prob(self, value):
-        v = _v(value)
-        return Tensor(self.base.log_prob(jnp.log(v)).value - jnp.log(v))
+        v = _t(value)
+        return self.base.log_prob(paddle.log(v)) - paddle.log(v)
 
     def entropy(self):
-        return Tensor(self.base.entropy().value + self.base.loc)
+        return self.base.entropy() + self.base.loc
 
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
-        self.low = _v(low)
-        self.high = _v(high)
+        self.low = _t(low)
+        self.high = _t(high)
         super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
 
     @property
     def mean(self):
-        return Tensor((self.low + self.high) / 2)
+        return (self.low + self.high) * 0.5
 
     @property
     def variance(self):
-        return Tensor((self.high - self.low) ** 2 / 12)
+        d = self.high - self.low
+        return d * d / 12.0
 
-    def sample(self, shape=()):
-        key = rnd.split_key()
-        u = jax.random.uniform(key, _shape(shape) + self.batch_shape)
-        return Tensor(self.low + (self.high - self.low) * u)
-
-    rsample = sample
+    def rsample(self, shape=()):
+        u = _noise(lambda k, s: jax.random.uniform(k, s),
+                   _shape(shape) + self.batch_shape)
+        return self.low + (self.high - self.low) * u
 
     def log_prob(self, value):
-        v = _v(value)
-        inside = (v >= self.low) & (v <= self.high)
-        lp = -jnp.log(self.high - self.low)
-        return Tensor(jnp.where(inside, lp, -jnp.inf))
+        v = _t(value)
+        lp = -paddle.log(self.high - self.low)
+        inside = paddle.logical_and(v >= self.low, v <= self.high)
+        return paddle.where(inside, lp + paddle.zeros_like(v),
+                            paddle.full_like(v, -float("inf")))
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
-                                       self.batch_shape))
+        return paddle.log(self.high - self.low)
 
 
 class Bernoulli(Distribution):
@@ -177,46 +187,49 @@ class Bernoulli(Distribution):
         if (probs is None) == (logits is None):
             raise ValueError("pass exactly one of probs/logits")
         if probs is not None:
-            self.probs = _v(probs)
-            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+            self.probs = _t(probs)
+            self.logits = paddle.log(self.probs) - paddle.log1p(-self.probs)
         else:
-            self.logits = _v(logits)
-            self.probs = jax.nn.sigmoid(self.logits)
+            self.logits = _t(logits)
+            self.probs = paddle.sigmoid(self.logits)
         super().__init__(self.probs.shape)
 
     @property
     def mean(self):
-        return Tensor(self.probs)
+        return self.probs
 
     @property
     def variance(self):
-        return Tensor(self.probs * (1 - self.probs))
+        return self.probs * (1.0 - self.probs)
 
     def sample(self, shape=()):
         key = rnd.split_key()
         return Tensor(jax.random.bernoulli(
-            key, self.probs, _shape(shape) + self.batch_shape).astype(jnp.float32))
+            key, self.probs.value,
+            _shape(shape) + self.batch_shape).astype(jnp.float32))
+
+    rsample = sample  # discrete: no pathwise gradient
 
     def log_prob(self, value):
-        v = _v(value)
-        return Tensor(v * jax.nn.log_sigmoid(self.logits)
-                      + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+        v = _t(value)
+        return v * F.log_sigmoid(self.logits) \
+            + (1.0 - v) * F.log_sigmoid(-self.logits)
 
     def entropy(self):
         p = self.probs
         eps = 1e-12
-        return Tensor(-(p * jnp.log(p + eps) + (1 - p) * jnp.log(1 - p + eps)))
+        return -(p * paddle.log(p + eps) + (1.0 - p) * paddle.log(1.0 - p + eps))
 
 
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
         if logits is not None and probs is None:
-            self.logits = _v(logits)
-            self.probs = jax.nn.softmax(self.logits, -1)
+            self.logits = _t(logits)
+            self.probs = F.softmax(self.logits, axis=-1)
         elif probs is not None:
-            self.probs = _v(probs)
-            self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
-            self.logits = jnp.log(self.probs + 1e-30)
+            p = _t(probs)
+            self.probs = p / paddle.sum(p, axis=-1, keepdim=True)
+            self.logits = paddle.log(self.probs + 1e-30)
         else:
             raise ValueError("pass logits or probs")
         super().__init__(self.probs.shape[:-1])
@@ -224,216 +237,219 @@ class Categorical(Distribution):
     def sample(self, shape=()):
         key = rnd.split_key()
         return Tensor(jax.random.categorical(
-            key, self.logits, shape=_shape(shape) + self.batch_shape))
+            key, self.logits.value, shape=_shape(shape) + self.batch_shape))
 
     def log_prob(self, value):
-        idx = _v(value).astype(jnp.int32)
-        logp = jax.nn.log_softmax(self.logits, -1)
-        return Tensor(jnp.take_along_axis(logp, idx[..., None], -1)[..., 0])
+        idx = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+        n = self.probs.shape[-1]
+        onehot = F.one_hot(idx.astype("int64"), n).astype("float32")
+        logp = F.log_softmax(self.logits, axis=-1)
+        return paddle.sum(onehot * logp, axis=-1)
 
     def probs_of(self, value):
-        return Tensor(jnp.exp(self.log_prob(value).value))
+        return paddle.exp(self.log_prob(value))
 
     def entropy(self):
-        logp = jax.nn.log_softmax(self.logits, -1)
-        return Tensor(-jnp.sum(self.probs * logp, -1))
+        logp = F.log_softmax(self.logits, axis=-1)
+        return -paddle.sum(self.probs * logp, axis=-1)
 
 
 class Multinomial(Distribution):
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
-        self.probs = _v(probs)
+        self.probs = _t(probs)
         super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
 
     @property
     def mean(self):
-        return Tensor(self.total_count * self.probs)
+        return self.probs * float(self.total_count)
 
     def sample(self, shape=()):
         key = rnd.split_key()
         cat = jax.random.categorical(
-            key, jnp.log(self.probs + 1e-30),
+            key, jnp.log(self.probs.value + 1e-30),
             shape=_shape(shape) + (self.total_count,) + self.batch_shape)
         onehot = jax.nn.one_hot(cat, self.probs.shape[-1])
         axis = len(_shape(shape))
         return Tensor(jnp.sum(onehot, axis=axis))
 
     def log_prob(self, value):
-        v = _v(value)
-        from jax.scipy.special import gammaln
-        return Tensor(gammaln(self.total_count + 1.0)
-                      - jnp.sum(gammaln(v + 1.0), -1)
-                      + jnp.sum(v * jnp.log(self.probs + 1e-30), -1))
+        v = _t(value)
+        return paddle.lgamma(paddle.full_like(
+            paddle.sum(v, axis=-1), self.total_count + 1.0)) \
+            - paddle.sum(paddle.lgamma(v + 1.0), axis=-1) \
+            + paddle.sum(v * paddle.log(self.probs + 1e-30), axis=-1)
 
 
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
-        self.rate = _v(rate)
+        self.rate = _t(rate)
         super().__init__(self.rate.shape)
 
     @property
     def mean(self):
-        return Tensor(1.0 / self.rate)
+        return 1.0 / self.rate
 
     @property
     def variance(self):
-        return Tensor(self.rate ** -2)
+        return 1.0 / (self.rate * self.rate)
 
-    def sample(self, shape=()):
-        key = rnd.split_key()
-        e = jax.random.exponential(key, _shape(shape) + self.batch_shape)
-        return Tensor(e / self.rate)
+    def rsample(self, shape=()):
+        e = _noise(lambda k, s: jax.random.exponential(k, s),
+                   _shape(shape) + self.batch_shape)
+        return e / self.rate
 
     def log_prob(self, value):
-        v = _v(value)
-        return Tensor(jnp.log(self.rate) - self.rate * v)
+        v = _t(value)
+        return paddle.log(self.rate) - self.rate * v
 
     def entropy(self):
-        return Tensor(1.0 - jnp.log(self.rate))
+        return 1.0 - paddle.log(self.rate)
 
 
 class Gamma(Distribution):
     def __init__(self, concentration, rate, name=None):
-        self.concentration = _v(concentration)
-        self.rate = _v(rate)
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
         super().__init__(jnp.broadcast_shapes(self.concentration.shape,
                                               self.rate.shape))
 
     @property
     def mean(self):
-        return Tensor(self.concentration / self.rate)
+        return self.concentration / self.rate
 
     @property
     def variance(self):
-        return Tensor(self.concentration / self.rate ** 2)
+        return self.concentration / (self.rate * self.rate)
 
     def sample(self, shape=()):
         key = rnd.split_key()
-        g = jax.random.gamma(key, self.concentration,
+        g = jax.random.gamma(key, self.concentration.value,
                              _shape(shape) + self.batch_shape)
-        return Tensor(g / self.rate)
+        return Tensor(g) / self.rate.detach()
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _v(value)
+        v = _t(value)
         a, b = self.concentration, self.rate
-        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
-                      - gammaln(a))
+        return a * paddle.log(b) + (a - 1.0) * paddle.log(v) - b * v \
+            - paddle.lgamma(a)
 
     def entropy(self):
-        from jax.scipy.special import digamma, gammaln
         a, b = self.concentration, self.rate
-        return Tensor(a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a))
+        return a - paddle.log(b) + paddle.lgamma(a) \
+            + (1.0 - a) * paddle.digamma(a)
+
+
+def _betaln(a, b):
+    return paddle.lgamma(a) + paddle.lgamma(b) - paddle.lgamma(a + b)
 
 
 class Beta(Distribution):
     def __init__(self, alpha, beta, name=None):
-        self.alpha = _v(alpha)
-        self.beta = _v(beta)
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
         super().__init__(jnp.broadcast_shapes(self.alpha.shape,
                                               self.beta.shape))
 
     @property
     def mean(self):
-        return Tensor(self.alpha / (self.alpha + self.beta))
+        return self.alpha / (self.alpha + self.beta)
 
     @property
     def variance(self):
         s = self.alpha + self.beta
-        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+        return self.alpha * self.beta / (s * s * (s + 1.0))
 
     def sample(self, shape=()):
         key = rnd.split_key()
-        return Tensor(jax.random.beta(key, self.alpha, self.beta,
+        return Tensor(jax.random.beta(key, self.alpha.value, self.beta.value,
                                       _shape(shape) + self.batch_shape))
 
     def log_prob(self, value):
-        from jax.scipy.special import betaln
-        v = _v(value)
-        return Tensor((self.alpha - 1) * jnp.log(v)
-                      + (self.beta - 1) * jnp.log1p(-v)
-                      - betaln(self.alpha, self.beta))
+        v = _t(value)
+        return (self.alpha - 1.0) * paddle.log(v) \
+            + (self.beta - 1.0) * paddle.log1p(-v) \
+            - _betaln(self.alpha, self.beta)
 
     def entropy(self):
-        from jax.scipy.special import betaln, digamma
         a, b = self.alpha, self.beta
-        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
-                      - (b - 1) * digamma(b)
-                      + (a + b - 2) * digamma(a + b))
+        return _betaln(a, b) - (a - 1.0) * paddle.digamma(a) \
+            - (b - 1.0) * paddle.digamma(b) \
+            + (a + b - 2.0) * paddle.digamma(a + b)
 
 
 class Dirichlet(Distribution):
     def __init__(self, concentration, name=None):
-        self.concentration = _v(concentration)
+        self.concentration = _t(concentration)
         super().__init__(self.concentration.shape[:-1],
                          self.concentration.shape[-1:])
 
     @property
     def mean(self):
-        return Tensor(self.concentration
-                      / jnp.sum(self.concentration, -1, keepdims=True))
+        return self.concentration / paddle.sum(self.concentration, axis=-1,
+                                               keepdim=True)
 
     def sample(self, shape=()):
         key = rnd.split_key()
-        return Tensor(jax.random.dirichlet(key, self.concentration,
+        return Tensor(jax.random.dirichlet(key, self.concentration.value,
                                            _shape(shape) + self.batch_shape))
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _v(value)
+        v = _t(value)
         a = self.concentration
-        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
-                      + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+        return paddle.sum((a - 1.0) * paddle.log(v), axis=-1) \
+            + paddle.lgamma(paddle.sum(a, axis=-1)) \
+            - paddle.sum(paddle.lgamma(a), axis=-1)
 
 
 class Laplace(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = _v(loc)
-        self.scale = _v(scale)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+        return self.loc
 
     @property
     def variance(self):
-        return Tensor(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+        return 2.0 * self.scale * self.scale
 
-    def sample(self, shape=()):
-        key = rnd.split_key()
-        u = jax.random.uniform(key, _shape(shape) + self.batch_shape,
-                               minval=-0.5, maxval=0.5)
-        return Tensor(self.loc - self.scale * jnp.sign(u)
-                      * jnp.log1p(-2 * jnp.abs(u)))
+    def rsample(self, shape=()):
+        u = _noise(lambda k, s: jax.random.uniform(k, s, minval=-0.5,
+                                                   maxval=0.5),
+                   _shape(shape) + self.batch_shape)
+        return self.loc - self.scale * paddle.sign(u) \
+            * paddle.log1p(-2.0 * paddle.abs(u))
 
     def log_prob(self, value):
-        v = _v(value)
-        return Tensor(-jnp.abs(v - self.loc) / self.scale
-                      - jnp.log(2 * self.scale))
+        v = _t(value)
+        return -paddle.abs(v - self.loc) / self.scale \
+            - paddle.log(2.0 * self.scale)
 
     def entropy(self):
-        return Tensor(1 + jnp.log(2 * self.scale))
+        return 1.0 + paddle.log(2.0 * self.scale)
 
 
 class Geometric(Distribution):
     def __init__(self, probs, name=None):
-        self.probs = _v(probs)
+        self.probs = _t(probs)
         super().__init__(self.probs.shape)
 
     @property
     def mean(self):
-        return Tensor(1.0 / self.probs)
+        return 1.0 / self.probs
 
     def sample(self, shape=()):
-        key = rnd.split_key()
-        u = jax.random.uniform(key, _shape(shape) + self.batch_shape,
-                               minval=1e-7, maxval=1.0)
-        return Tensor(jnp.ceil(jnp.log(u) / jnp.log1p(-self.probs)))
+        u = _noise(lambda k, s: jax.random.uniform(k, s, minval=1e-7,
+                                                   maxval=1.0),
+                   _shape(shape) + self.batch_shape)
+        return paddle.ceil(paddle.log(u) / paddle.log1p(-self.probs.detach()))
 
     def log_prob(self, value):
-        v = _v(value)
-        return Tensor((v - 1) * jnp.log1p(-self.probs) + jnp.log(self.probs))
+        v = _t(value)
+        return (v - 1.0) * paddle.log1p(-self.probs) + paddle.log(self.probs)
 
 
 # -- KL registry -------------------------------------------------------------
@@ -459,32 +475,32 @@ def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
 
 @register_kl(Normal, Normal)
 def _kl_normal(p, q):
-    var_ratio = (p.scale / q.scale) ** 2
-    t1 = ((p.loc - q.loc) / q.scale) ** 2
-    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    var_ratio = (p.scale / q.scale) * (p.scale / q.scale)
+    d = (p.loc - q.loc) / q.scale
+    return 0.5 * (var_ratio + d * d - 1.0 - paddle.log(var_ratio))
 
 
 @register_kl(Categorical, Categorical)
 def _kl_categorical(p, q):
-    logp = jax.nn.log_softmax(p.logits, -1)
-    logq = jax.nn.log_softmax(q.logits, -1)
-    return Tensor(jnp.sum(p.probs * (logp - logq), -1))
+    logp = F.log_softmax(p.logits, axis=-1)
+    logq = F.log_softmax(q.logits, axis=-1)
+    return paddle.sum(p.probs * (logp - logq), axis=-1)
 
 
 @register_kl(Bernoulli, Bernoulli)
 def _kl_bernoulli(p, q):
     eps = 1e-12
     a, b = p.probs, q.probs
-    return Tensor(a * (jnp.log(a + eps) - jnp.log(b + eps))
-                  + (1 - a) * (jnp.log(1 - a + eps) - jnp.log(1 - b + eps)))
+    return a * (paddle.log(a + eps) - paddle.log(b + eps)) \
+        + (1.0 - a) * (paddle.log(1.0 - a + eps) - paddle.log(1.0 - b + eps))
 
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
-    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    return paddle.log((q.high - q.low) / (p.high - p.low))
 
 
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
     r = q.rate / p.rate
-    return Tensor(jnp.log(1 / r) + r - 1)
+    return paddle.log(1.0 / r) + r - 1.0

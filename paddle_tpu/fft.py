@@ -1,0 +1,91 @@
+"""paddle_tpu.fft — spectral ops (python/paddle/fft.py analog).
+
+The reference routes to phi fft kernels backed by pocketfft/cuFFT; on TPU
+XLA's FFT HLO does the work, so these are thin taped wrappers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk(name, fn, n_arg="n"):
+    @register_op(f"fft_{name}", ref="python/paddle/fft.py (capability analog)")
+    def op(x, n=None, axis=-1, norm="backward"):
+        return fn(x, n, axis, norm)
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", lambda x, n, a, norm: jnp.fft.fft(x, n, a, norm))
+ifft = _mk("ifft", lambda x, n, a, norm: jnp.fft.ifft(x, n, a, norm))
+rfft = _mk("rfft", lambda x, n, a, norm: jnp.fft.rfft(x, n, a, norm))
+irfft = _mk("irfft", lambda x, n, a, norm: jnp.fft.irfft(x, n, a, norm))
+hfft = _mk("hfft", lambda x, n, a, norm: jnp.fft.hfft(x, n, a, norm))
+ihfft = _mk("ihfft", lambda x, n, a, norm: jnp.fft.ihfft(x, n, a, norm))
+
+
+@register_op("fft_fft2")
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s, axes, norm)
+
+
+@register_op("fft_ifft2")
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s, axes, norm)
+
+
+@register_op("fft_rfft2")
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s, axes, norm)
+
+
+@register_op("fft_irfft2")
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s, axes, norm)
+
+
+@register_op("fft_fftn")
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s, axes, norm)
+
+
+@register_op("fft_ifftn")
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s, axes, norm)
+
+
+@register_op("fft_rfftn")
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s, axes, norm)
+
+
+@register_op("fft_irfftn")
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from paddle_tpu.framework.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from paddle_tpu.framework.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+@register_op("fft_fftshift")
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes)
+
+
+@register_op("fft_ifftshift")
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes)

@@ -120,7 +120,8 @@ class ModelCheckpoint(Callback):
 
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
-                 min_delta=0, baseline=None, save_best_model=True):
+                 min_delta=0, baseline=None, save_best_model=True,
+                 save_dir=None):
         self.monitor = monitor
         self.patience = patience
         self.min_delta = abs(min_delta)
@@ -130,6 +131,9 @@ class EarlyStopping(Callback):
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
         self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+        self.best_state_dict = None
 
     def on_train_begin(self, logs=None):
         self.wait = 0
@@ -151,6 +155,13 @@ class EarlyStopping(Callback):
         if self._better(cur):
             self.best = cur
             self.wait = 0
+            if self.save_best_model:
+                # in-memory snapshot; also persisted when save_dir is set
+                self.best_state_dict = {
+                    k: v.numpy().copy()
+                    for k, v in self.model.network.state_dict().items()}
+                if self.save_dir:
+                    self.model.save(os.path.join(self.save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:

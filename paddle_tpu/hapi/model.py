@@ -21,10 +21,20 @@ from paddle_tpu.metric import Metric
 __all__ = ["Model"]
 
 
-def _to_loader(data, batch_size, shuffle):
+def _to_loader(data, batch_size, shuffle, drop_last=False, num_workers=0):
     if data is None or isinstance(data, DataLoader):
         return data
-    return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      drop_last=drop_last, num_workers=num_workers)
+
+
+def _update_metric(m, out, labels):
+    """Unpack compute() results into update() (hapi's metric protocol)."""
+    res = m.compute(out, *labels)
+    if isinstance(res, (list, tuple)):
+        m.update(*res)
+    else:
+        m.update(res)
 
 
 class Model:
@@ -73,7 +83,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        loader = _to_loader(train_data, batch_size, shuffle)
+        loader = _to_loader(train_data, batch_size, shuffle, drop_last,
+                            num_workers)
         eval_loader = _to_loader(eval_data, batch_size, False)
         cbks = CallbackList(callbacks)
         cbks.append(ProgBarLogger(log_freq, verbose=verbose))
@@ -102,7 +113,7 @@ class Model:
                 epoch_losses.append(loss)
                 logs = {"loss": loss}
                 for m in self._metrics:
-                    m.update(m.compute(out, *y))
+                    _update_metric(m, out, y)
                     logs[m.name()] = m.accumulate()
                 cbks.on_train_batch_end(step, logs)
             logs = {"loss": float(np.mean(epoch_losses))}
@@ -138,7 +149,7 @@ class Model:
             if loss is not None:
                 losses.append(loss)
             for m in self._metrics:
-                m.update(m.compute(out, *y))
+                _update_metric(m, out, y)
             cbks.on_eval_batch_end(step)
         logs = {}
         if losses:

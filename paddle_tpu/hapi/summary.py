@@ -1,33 +1,75 @@
-"""paddle.summary analog (python/paddle/hapi/model_summary.py)."""
+"""paddle.summary analog (python/paddle/hapi/model_summary.py): per-layer
+output shapes via a real forward pass with hooks when input_size given."""
 
 from __future__ import annotations
+
+from typing import Optional
 
 import numpy as np
 
 import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
 
 __all__ = ["summary"]
 
 
+def _param_count(sub):
+    own = [p for p in sub._parameters.values() if p is not None]
+    n = int(sum(int(np.prod(p.shape)) for p in own))
+    t = int(sum(int(np.prod(p.shape)) for p in own if not p.stop_gradient))
+    return n, t
+
+
 def summary(net, input_size=None, dtypes=None, input=None):
+    shapes = {}
+    hooks = []
+    if input_size is not None or input is not None:
+        def make_hook(name):
+            def hook(layer, inputs, outputs):
+                out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+                if isinstance(out, Tensor):
+                    shapes[name] = list(out.shape)
+            return hook
+
+        for name, sub in net.named_sublayers(include_self=True):
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+        try:
+            if input is not None:
+                x = input
+            else:
+                sizes = (input_size if isinstance(input_size, (list, tuple))
+                         and isinstance(input_size[0], (list, tuple))
+                         else [input_size])
+                dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                    [dtypes or "float32"] * len(sizes)
+                x = [paddle.zeros(list(s), dtype=d)
+                     for s, d in zip(sizes, dts)]
+            was_training = net.training
+            net.eval()
+            with paddle.no_grad():
+                net(*x) if isinstance(x, list) else net(x)
+            if was_training:
+                net.train()
+        finally:
+            for h in hooks:
+                h.remove()
+
     rows = []
     total_params = 0
     trainable_params = 0
     for name, sub in net.named_sublayers(include_self=True):
-        own = [p for p in sub._parameters.values() if p is not None]
-        n = int(sum(int(np.prod(p.shape)) for p in own))
-        t = int(sum(int(np.prod(p.shape)) for p in own if not p.stop_gradient))
-        if n or name == "":
-            rows.append((name or type(net).__name__,
-                         type(sub).__name__, n))
+        n, t = _param_count(sub)
         total_params += n
         trainable_params += t
+        if n or name in shapes or name == "":
+            rows.append((name or type(net).__name__, type(sub).__name__,
+                         str(shapes.get(name, "-")), n))
     width = max((len(r[0]) for r in rows), default=10) + 2
-    print(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
-    print("-" * (width + 36))
-    for name, tname, n in rows:
-        print(f"{name:<{width}}{tname:<24}{n:>12,}")
-    print("-" * (width + 36))
+    print(f"{'Layer':<{width}}{'Type':<22}{'Output Shape':<20}{'Params':>12}")
+    print("-" * (width + 54))
+    for name, tname, shape, n in rows:
+        print(f"{name:<{width}}{tname:<22}{shape:<20}{n:>12,}")
+    print("-" * (width + 54))
     print(f"Total params: {total_params:,}")
     print(f"Trainable params: {trainable_params:,}")
     return {"total_params": total_params, "trainable_params": trainable_params}

@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — experimental surfaces (python/paddle/incubate/).
+
+Carried subpackages: nn.functional fused ops, asp (2:4 structured
+sparsity), distributed MoE layer, LookAhead/ModelAverage optimizers,
+autograd jvp/vjp forward-mode.
+"""
+
+from paddle_tpu.incubate import asp, autograd, nn  # noqa: F401
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,88 @@
+"""ASP — 2:4 structured sparsity (python/paddle/incubate/asp/ analog).
+
+calculate_density / prune_model (magnitude-based 2:4 mask) + the
+`decorate` optimizer wrapper that re-applies masks after each step
+(asp.py OptimizerWithSparsityGuarantee analog).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["calculate_density", "check_sparsity", "create_mask",
+           "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_EXCLUDED: set = set()
+_MASKS: Dict[int, jnp.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x.value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / arr.size
+
+
+def create_mask(weight, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-magnitude of every m consecutive elements along
+    the input dim (dim 0 of our (in, out) Linear layout)."""
+    arr = np.asarray(weight.value if isinstance(weight, Tensor) else weight)
+    if arr.ndim != 2 or arr.shape[0] % m != 0:
+        return np.ones_like(arr)
+    a = np.abs(arr).reshape(arr.shape[0] // m, m, arr.shape[1])
+    order = np.argsort(-a, axis=1)
+    mask = np.zeros_like(a)
+    np.put_along_axis(mask, order[:, :n, :], 1.0, axis=1)
+    return mask.reshape(arr.shape)
+
+
+def check_sparsity(arr, n: int = 2, m: int = 4) -> bool:
+    a = np.asarray(arr.value if isinstance(arr, Tensor) else arr)
+    if a.ndim != 2 or a.shape[0] % m != 0:
+        return False
+    nz = (a.reshape(a.shape[0] // m, m, a.shape[1]) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def set_excluded_layers(model, layer_names):
+    _EXCLUDED.update(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    _EXCLUDED.clear()
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo="mask_1d",
+                with_mask: bool = True):
+    """Apply 2:4 masks to every eligible Linear weight in place."""
+    masks = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if name in _EXCLUDED:
+            continue
+        w = sub._parameters.get("weight")
+        if w is None or len(w.shape) != 2 or w.shape[0] % m != 0:
+            continue
+        mask = create_mask(w, n, m)
+        w._set_value(w.value * jnp.asarray(mask))
+        masks[id(w)] = jnp.asarray(mask)
+        _MASKS[id(w)] = jnp.asarray(mask)
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-mask pruned weights after each update."""
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        for p in optimizer._params():
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._set_value(p.value * mask)
+
+    optimizer.step = step
+    return optimizer

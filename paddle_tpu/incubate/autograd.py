@@ -1,0 +1,70 @@
+"""incubate.autograd — forward-mode AD (incubate/autograd/primx.py
+capability analog): jvp/vjp as jax transforms over taped functions."""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.autograd import tape
+
+__all__ = ["jvp", "vjp", "forward_grad", "enable_prim", "disable_prim",
+           "prim_enabled"]
+
+_PRIM = False
+
+
+def enable_prim():
+    global _PRIM
+    _PRIM = True
+
+
+def disable_prim():
+    global _PRIM
+    _PRIM = False
+
+
+def prim_enabled() -> bool:
+    return _PRIM
+
+
+def _pure(fn):
+    def wrapped(*vals):
+        with tape.no_grad():
+            out = fn(*[Tensor(v) for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else o for o in out)
+        return out.value if isinstance(out, Tensor) else out
+    return wrapped
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = tuple(x.value if isinstance(x, Tensor) else x for x in xs)
+    if v is None:
+        import jax.numpy as jnp
+        tangents = tuple(jnp.ones_like(val) for val in vals)
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(t.value if isinstance(t, Tensor) else t for t in v)
+    out, tang = jax.jvp(_pure(func), vals, tangents)
+    wrap = lambda o: tuple(Tensor(x) for x in o) if isinstance(o, tuple) else Tensor(o)
+    return wrap(out), wrap(tang)
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = tuple(x.value if isinstance(x, Tensor) else x for x in xs)
+    out, vjp_fn = jax.vjp(_pure(func), *vals)
+    if v is None:
+        import jax.numpy as jnp
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        cot = v.value if isinstance(v, Tensor) else v
+    grads = vjp_fn(cot)
+    wrap = lambda o: tuple(Tensor(x) for x in o) if isinstance(o, tuple) else Tensor(o)
+    return wrap(out), wrap(grads)
+
+
+forward_grad = jvp

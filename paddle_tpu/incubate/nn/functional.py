@@ -1,0 +1,137 @@
+"""incubate.nn.functional — fused op surface.
+
+Analog of python/paddle/incubate/nn/functional/ (fused_transformer.py,
+fused_rotary_position_embedding, fused_rms_norm...): on TPU most "fused"
+ops are XLA fusions of the stock ops; the ones with real custom kernels
+route to ops/pallas. Kept as explicit functions for reference-API parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
+           "fused_layer_norm", "fused_dropout_add", "fused_linear",
+           "fused_linear_activation", "fused_feedforward",
+           "fused_multi_head_attention", "swiglu"]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """fused_rope analog; cos/sin: (S, D/2) tables (models.llama._rope_op)."""
+    from paddle_tpu.ops.registry import op_api
+    rope = op_api("rope")
+    if cos is None or sin is None:
+        raise ValueError("pass cos/sin tables")
+    outs = [rope(q, cos, sin)]
+    if k is not None:
+        outs.append(rope(k, cos, sin))
+    if v is not None:
+        outs.append(v)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1):
+    return F.layer_norm(x, x.shape[begin_norm_axis:], weight=norm_weight,
+                        bias=norm_bias, epsilon=epsilon)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    w = weight.t() if transpose_weight else weight
+    return F.linear(x, w, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    out = paddle.matmul(x.t() if trans_x else x, y.t() if trans_y else y)
+    if bias is not None:
+        out = out + bias
+    return getattr(F, activation)(out) if activation != "none" else out
+
+
+@register_op("swiglu")
+def swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    import jax
+    return jax.nn.silu(x) * y
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, **kw):
+    """fused_feedforward op analog (phi fusion/fused_feedforward): one XLA
+    fusion region instead of a monolithic kernel."""
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln_epsilon)
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, num_heads=None, **kw):
+    """fused_attention op analog over the flash-attention path."""
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    B, S, H = h.shape
+    # qkv_weight: (3, num_heads, head_dim, H) in the reference op
+    qw = qkv_weight.reshape([3, -1, H])
+    qkv = paddle.matmul(h, qw.transpose([2, 0, 1]).reshape([H, -1]))
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape([-1])
+    nh = num_heads or (qkv.shape[-1] // 3 // 64)
+    hd = qkv.shape[-1] // 3 // nh
+    qkv = qkv.reshape([B, S, 3, nh, hd])
+    q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate,
+                                         training=training)
+    out = out.reshape([B, S, nh * hd])
+    out = paddle.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out

@@ -1,0 +1,74 @@
+"""MoE layer (incubate/distributed/models/moe/moe_layer.py analog).
+
+Top-k gating + capacity-padded expert dispatch; under an 'ep' mesh axis
+the dispatch/combine compile to the all-to-all exchange the reference does
+with global_scatter/global_gather (MoEScatter:99). Experts are dense
+layers; a Shard(0)-over-ep placement on the stacked expert params gives
+expert parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import OpDef, apply_op
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(nn.Layer):
+    def __init__(self, d_model: int, experts: List[nn.Layer],
+                 gate: Optional[nn.Layer] = None, top_k: int = 2,
+                 capacity_factor: float = 1.25, group=None,
+                 recompute_interval: int = 0):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = nn.LayerList(experts)
+        self.n_experts = len(experts)
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = gate or nn.Linear(d_model, self.n_experts, bias_attr=False)
+        self.aux_loss = None
+
+    def forward(self, x):
+        B, S, H = x.shape
+        tokens = x.reshape([B * S, H])
+        logits = self.gate(tokens)                      # (T, E)
+        probs = paddle.nn.functional.softmax(logits, axis=-1)
+
+        # load-balancing aux loss (GShard style), kept on self for trainers
+        from paddle_tpu.ops.registry import as_value
+        me = paddle.mean(probs, axis=0)
+        # fraction of tokens whose top-1 is expert e
+        top1 = paddle.argmax(probs, axis=-1)
+        ce = paddle.mean(
+            paddle.nn.functional.one_hot(top1, self.n_experts).astype("float32"),
+            axis=0)
+        self.aux_loss = paddle.sum(me * ce) * self.n_experts
+
+        T = B * S
+        capacity = int(self.capacity_factor * T * self.top_k / self.n_experts)
+        capacity = max(capacity, self.top_k)
+
+        out = paddle.zeros_like(tokens)
+        from paddle_tpu.distributed.moe_utils import combine_tokens, dispatch_tokens
+        for k in range(self.top_k):
+            kth = paddle.argsort(logits, axis=-1, descending=True)[:, k]
+            gatev = paddle.sum(
+                probs * paddle.nn.functional.one_hot(
+                    kth, self.n_experts).astype(probs.dtype), axis=-1)
+            buf, slot, keep = dispatch_tokens(tokens, kth, self.n_experts,
+                                              capacity)
+            expert_out = []
+            for e, expert in enumerate(self.experts):
+                expert_out.append(expert(Tensor(buf.value[e])))
+            stacked = Tensor(jnp.stack([eo.value for eo in expert_out]))
+            combined = combine_tokens(stacked, slot, keep)
+            out = out + combined * gatev.unsqueeze(-1)
+        return out.reshape([B, S, H])

@@ -1,0 +1,69 @@
+"""LookAhead / ModelAverage (python/paddle/incubate/optimizer/ analog)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps of the inner optimizer, then slow-weights interpolation."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._counter = 0
+
+    def step(self):
+        # slow weights snapshot BEFORE the first fast update
+        if not self._slow:
+            for p in self.inner_optimizer._params():
+                self._slow[id(p)] = p.value
+        self.inner_optimizer.step()
+        self._counter += 1
+        if self._counter % self.k == 0:
+            for p in self.inner_optimizer._params():
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p.value - slow)
+                self._slow[id(p)] = slow
+                p._set_value(slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_optimizer"], item)
+
+
+class ModelAverage:
+    """Running average of params; apply()/restore() swap averaged weights
+    in for evaluation (incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        self._params = list(parameters or [])
+        self._sums = {id(p): jnp.zeros_like(p.value) for p in self._params}
+        self._counts = {id(p): 0 for p in self._params}
+        self._backup = {}
+
+    def step(self):
+        for p in self._params:
+            self._sums[id(p)] = self._sums[id(p)] + p.value
+            self._counts[id(p)] += 1
+
+    def apply(self, executor=None, need_restore: bool = True):
+        for p in self._params:
+            n = max(self._counts[id(p)], 1)
+            self._backup[id(p)] = p.value
+            p._set_value(self._sums[id(p)] / n)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._set_value(self._backup.pop(id(p)))

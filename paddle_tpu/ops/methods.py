@@ -222,3 +222,10 @@ def monkey_patch_tensor() -> None:
         return manipulation.transpose(self, list(range(self.ndim))[::-1])
 
     Tensor.T = T
+
+    def t(self):
+        if self.ndim > 2:
+            raise ValueError("t() expects a tensor with <= 2 dimensions")
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    Tensor.t = t

@@ -1,0 +1,16 @@
+"""paddle_tpu.profiler — profiling + timeline export.
+
+Redesign of the reference's profiler stack (N27 paddle/fluid/platform/
+profiler/ + P13 python/paddle/profiler/): host-side RecordEvent ring
+buffer + device-side tracing. On TPU the device tracer is XLA's own
+profiler (jax.profiler -> TensorBoard/perfetto trace of HLO ops); the
+host events and step/MFU accounting are ours, merged into one
+chrome-trace JSON (chrometracing_logger.cc analog).
+"""
+
+from paddle_tpu.profiler.profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    export_chrome_tracing, make_scheduler,
+)
+from paddle_tpu.profiler.statistic import SortedKeys, summary  # noqa: F401
+from paddle_tpu.profiler.timer import Benchmark, benchmark  # noqa: F401

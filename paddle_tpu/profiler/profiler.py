@@ -1,0 +1,234 @@
+"""Profiler core (python/paddle/profiler/profiler.py:346 analog)."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, List, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "export_chrome_tracing", "make_scheduler"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _HostEventRecorder:
+    """Ring buffer of host events (host_event_recorder.h analog)."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def record(self, name: str, start_ns: int, end_ns: int, tid: int):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append({"name": name, "ts": start_ns / 1000.0,
+                                "dur": (end_ns - start_ns) / 1000.0,
+                                "ph": "X", "pid": os.getpid(), "tid": tid,
+                                "cat": "host"})
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+_RECORDER = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User/framework scope marker (event_tracing.h RecordEvent analog).
+    Context manager AND begin/end object."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+
+    def end(self):
+        if self._start is not None:
+            _RECORDER.record(self.name, self._start, time.perf_counter_ns(),
+                             threading.get_ident() & 0xFFFF)
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-state scheduler (profiler.py make_scheduler parity)."""
+    period = closed + ready + record
+
+    def sched(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback factory (profiler.py:215 analog)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        prof._export(path)
+        return path
+
+    return handler
+
+
+class Profiler:
+    """Collects host RecordEvents (+ optional XLA device trace) between
+    start/stop; exports a chrome trace and summary tables."""
+
+    def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, record_shapes=False, profile_memory=False,
+                 with_flops=False, timer_only=False):
+        if isinstance(scheduler, tuple):
+            lo, hi = scheduler
+            scheduler = make_scheduler(closed=max(lo, 0), ready=0,
+                                       record=hi - lo, repeat=1)
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.targets = list(targets or [ProfilerTarget.CPU, ProfilerTarget.TPU])
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._events: List[dict] = []
+        self._step_times: List[float] = []
+        self._last_step_t = None
+        self._xla_dir = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._state = (self._scheduler(self._step) if self._scheduler
+                       else ProfilerState.RECORD)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._begin_record()
+        self._last_step_t = time.perf_counter()
+
+    def _begin_record(self):
+        _RECORDER.enabled = True
+        if not self._timer_only and ProfilerTarget.TPU in self.targets:
+            import jax
+            try:
+                self._xla_dir = os.path.join(
+                    os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/pt_prof"),
+                    f"xla_{int(time.time())}")
+                jax.profiler.start_trace(self._xla_dir)
+            except Exception:
+                self._xla_dir = None
+
+    def _end_record(self):
+        _RECORDER.enabled = False
+        self._events.extend(_RECORDER.drain())
+        if self._xla_dir is not None:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._xla_dir = None
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._end_record()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._events.append({"name": f"ProfileStep#{self._step}",
+                             "ts": now * 1e6, "dur": 0, "ph": "i",
+                             "pid": os.getpid(), "tid": 0, "cat": "step"})
+        prev = self._state
+        self._step += 1
+        if self._scheduler is not None:
+            new = self._scheduler(self._step)
+            if prev == ProfilerState.CLOSED and new != ProfilerState.CLOSED:
+                pass
+            if (prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+                    and new in (ProfilerState.CLOSED, ProfilerState.READY)):
+                self._end_record()
+                if (prev == ProfilerState.RECORD_AND_RETURN
+                        and self._on_trace_ready is not None):
+                    self._on_trace_ready(self)
+            if (prev in (ProfilerState.CLOSED, ProfilerState.READY)
+                    and new in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN)):
+                self._begin_record()
+            self._state = new
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- outputs ------------------------------------------------------------
+    def _export(self, path: str):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def export_chrome_tracing(self, path: str):
+        self._export(path)
+
+    def export(self, path: str, format: str = "json"):
+        self._export(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        from paddle_tpu.profiler.statistic import summary as _summary
+        return _summary(self._events, self._step_times, time_unit=time_unit)
+
+    @property
+    def step_times(self):
+        return list(self._step_times)

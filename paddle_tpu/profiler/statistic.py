@@ -1,0 +1,46 @@
+"""Summary tables (python/paddle/profiler/profiler_statistic.py analog)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from enum import Enum
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["SortedKeys", "summary"]
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+
+
+def summary(events: List[dict], step_times: List[float],
+            time_unit: str = "ms") -> str:
+    unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+    per_name: Dict[str, list] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X":
+            per_name[e["name"]].append(e["dur"] / 1e6)  # us -> s
+    lines = []
+    if step_times:
+        st = np.asarray(step_times)
+        lines.append(f"steps: {len(st)}  avg: {st.mean() * unit:.3f}{time_unit}"
+                     f"  p50: {np.median(st) * unit:.3f}{time_unit}"
+                     f"  max: {st.max() * unit:.3f}{time_unit}")
+    header = f"{'Event':<40}{'Calls':>8}{'Total':>12}{'Avg':>12}{'Max':>12}"
+    lines.append(header)
+    lines.append("-" * len(header))
+    rows = sorted(per_name.items(), key=lambda kv: -sum(kv[1]))
+    for name, durs in rows:
+        d = np.asarray(durs)
+        lines.append(f"{name[:39]:<40}{len(d):>8}"
+                     f"{d.sum() * unit:>11.3f}{time_unit}"
+                     f"{d.mean() * unit:>11.3f}{time_unit}"
+                     f"{d.max() * unit:>11.3f}{time_unit}")
+    out = "\n".join(lines)
+    print(out)
+    return out

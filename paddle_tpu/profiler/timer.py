@@ -1,0 +1,61 @@
+"""Throughput timer (python/paddle/profiler/timer.py `Benchmark` analog):
+ips / step-time / MFU reporting used by hapi callbacks and bench.py."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._times = []
+        self._samples = []
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._times.append(now - self._t0)
+            self._samples.append(num_samples or 0)
+        self._t0 = now
+
+    def end(self):
+        self._t0 = None
+
+    @property
+    def step_time(self) -> float:
+        return float(np.median(self._times)) if self._times else 0.0
+
+    @property
+    def ips(self) -> float:
+        if not self._times:
+            return 0.0
+        tot_t = sum(self._times)
+        tot_s = sum(self._samples)
+        return tot_s / tot_t if tot_t > 0 else 0.0
+
+    def mfu(self, flops_per_step: float, peak_flops: float) -> float:
+        st = self.step_time
+        return flops_per_step / (st * peak_flops) if st > 0 else 0.0
+
+    def report(self, unit: str = "samples") -> str:
+        return (f"avg ips: {self.ips:.1f} {unit}/s, "
+                f"median step: {self.step_time * 1e3:.2f} ms")
+
+
+_GLOBAL = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _GLOBAL

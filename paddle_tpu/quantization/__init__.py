@@ -1,0 +1,143 @@
+"""paddle_tpu.quantization — PTQ/QAT framework.
+
+Analog of python/paddle/quantization/ (quantize.py, observers, QAT layer
+wrappers): observers watch activations/weights during calibration, PTQ
+replaces Linear/Conv with quant-simulating layers, QAT uses fake-quant
+(straight-through estimator) during training. Int8 matmuls on TPU run as
+int8 MXU ops via XLA when dtypes allow; the simulation path keeps f32
+compute with quantize/dequantize rounding (the reference's
+QuantizeLinear/DequantizeLinear semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "quantize",
+           "dequantize", "fake_quantize", "QuantedLinear"]
+
+
+@register_op("quantize_linear")
+def quantize(x, scale, zero_point=0, bit_length: int = 8):
+    qmax = 2 ** (bit_length - 1) - 1
+    return jnp.clip(jnp.round(x / scale) + zero_point, -qmax - 1, qmax)
+
+
+@register_op("dequantize_linear")
+def dequantize(x, scale, zero_point=0, bit_length: int = 8):
+    return (x - zero_point) * scale
+
+
+@register_op("fake_quantize")
+def fake_quantize(x, scale, bit_length: int = 8):
+    """Quantize-dequantize with straight-through gradient."""
+    qmax = 2 ** (bit_length - 1) - 1
+
+    @jax.custom_vjp
+    def ste(v):
+        return jnp.clip(jnp.round(v / scale), -qmax - 1, qmax) * scale
+
+    def fwd(v):
+        return ste(v), None
+
+    def bwd(_, g):
+        return (g,)
+
+    ste.defvjp(fwd, bwd)
+    return ste(x)
+
+
+class AbsmaxObserver:
+    """abs-max range observer (quantization/observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        import numpy as np
+        v = float(np.max(np.abs(np.asarray(
+            x.value if isinstance(x, Tensor) else x))))
+        self._absmax = max(self._absmax, v)
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return max(self._absmax, 1e-8) / qmax
+
+
+class QuantConfig:
+    """quantization/config.py analog: which layers get which quanter."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or (lambda: AbsmaxObserver())
+        self.weight = weight or (lambda: AbsmaxObserver())
+        self._layer_types = (nn.Linear,)
+
+    def add_layer_config(self, layer_types, activation=None, weight=None):
+        self._layer_types = tuple(layer_types)
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quantized weight+activation (QAT/PTQ simulation)."""
+
+    def __init__(self, linear: nn.Linear, w_scale: float, a_observer,
+                 bits: int = 8):
+        super().__init__()
+        self.inner = linear
+        self.w_scale = w_scale
+        self.a_observer = a_observer
+        self.bits = bits
+        self.calibrating = True
+
+    def forward(self, x):
+        if self.calibrating:
+            self.a_observer.observe(x)
+            a_scale = self.a_observer.scale()
+        else:
+            a_scale = self.a_observer.scale()
+        xq = fake_quantize(x, a_scale, self.bits)
+        wq = fake_quantize(self.inner.weight, self.w_scale, self.bits)
+        import paddle_tpu.nn.functional as F
+        return F.linear(xq, wq, self.inner.bias)
+
+
+def _swap_quanted(model: nn.Layer, config: QuantConfig):
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, config._layer_types):
+            obs = config.weight()
+            obs.observe(sub.weight)
+            model._sub_layers[name] = QuantedLinear(sub, obs.scale(),
+                                                    config.activation())
+        else:
+            _swap_quanted(sub, config)
+
+
+class PTQ:
+    """Post-training quantization driver (quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: nn.Layer, inplace: bool = False):
+        import copy
+        m = model if inplace else copy.deepcopy(model)
+        _swap_quanted(m, self.config)
+        return m
+
+    def convert(self, model: nn.Layer, inplace: bool = True):
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, QuantedLinear):
+                sub.calibrating = False
+        return model
+
+
+class QAT(PTQ):
+    """Quant-aware training: same wrappers, calibration stays live so the
+    STE fake-quant trains through (quantization/qat.py)."""

@@ -1,0 +1,133 @@
+"""paddle_tpu.signal — STFT/iSTFT (python/paddle/signal.py analog).
+
+Layout parity with the reference: ``frame(..., axis=-1)`` returns
+(..., frame_length, num_frames); ``axis=0`` returns
+(num_frames, frame_length, ...). stft returns (..., n_fft//2+1, frames)
+for onesided input, matching paddle.signal.stft.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _frames_last(x, frame_length: int, hop_length: int):
+    """(..., T) -> (..., num_frames, frame_length)."""
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])
+    return x[..., idx]
+
+
+@register_op("frame", ref="python/paddle/signal.py frame")
+def frame(x, frame_length: int, hop_length: int, axis: int = -1):
+    if axis in (-1, x.ndim - 1):
+        f = _frames_last(x, frame_length, hop_length)
+        return jnp.swapaxes(f, -1, -2)     # (..., frame_length, num_frames)
+    if axis == 0:
+        f = _frames_last(jnp.moveaxis(x, 0, -1), frame_length, hop_length)
+        # (..., num, fl) -> (num, fl, ...)
+        return jnp.moveaxis(f, (-2, -1), (0, 1))
+    raise ValueError("frame: axis must be 0 or -1")
+
+
+@register_op("overlap_add", ref="python/paddle/signal.py overlap_add")
+def overlap_add(x, hop_length: int, axis: int = -1):
+    if axis in (-1, x.ndim - 1):
+        frames = jnp.swapaxes(x, -1, -2)   # (..., num, fl)
+    elif axis == 0:
+        frames = jnp.moveaxis(x, (0, 1), (-2, -1))
+    else:
+        raise ValueError("overlap_add: axis must be 0 or -1")
+    *batch, num, flen = frames.shape
+    out_len = (num - 1) * hop_length + flen
+    out = jnp.zeros((*batch, out_len), frames.dtype)
+    for i in range(num):
+        out = out.at[..., i * hop_length:i * hop_length + flen].add(
+            frames[..., i, :])
+    if axis == 0 and x.ndim > 2:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def _window_arr(window, win_length):
+    if window is None:
+        return jnp.ones((win_length,), jnp.float32)
+    return window.value if isinstance(window, Tensor) else jnp.asarray(window)
+
+
+@register_op("stft", ref="python/paddle/signal.py stft")
+def _stft_op(x, n_fft, hop_length, win_length, window, center, pad_mode,
+             normalized, onesided):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = _frames_last(x, n_fft, hop_length)      # (..., num, n_fft)
+    w = _window_arr(window, win_length)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    spec = jnp.fft.rfft(frames * w, axis=-1) if onesided else \
+        jnp.fft.fft(frames * w, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    return jnp.swapaxes(spec, -1, -2)  # (..., freq, num_frames)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    return _stft_op(x, n_fft, hop_length, win_length, window, center,
+                    pad_mode, normalized, onesided)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    spec = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    spec = jnp.swapaxes(spec, -1, -2)      # (..., frames, freq)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1).real)
+    w = _window_arr(window, win_length)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def _ola(fr):  # (..., num, fl) -> (..., T)
+        *batch, num, flen = fr.shape
+        out_len = (num - 1) * hop_length + flen
+        out = jnp.zeros((*batch, out_len), fr.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length:i * hop_length + flen].add(
+                fr[..., i, :])
+        return out
+
+    sig = _ola(frames * w)
+    wsq = _ola(jnp.broadcast_to(w * w, frames.shape))
+    sig = sig / jnp.maximum(wsq, 1e-10)
+    if center:
+        sig = sig[..., n_fft // 2:]
+        if length is not None:
+            sig = sig[..., :length]
+        else:
+            sig = sig[..., :sig.shape[-1] - n_fft // 2]
+    elif length is not None:
+        sig = sig[..., :length]
+    return Tensor(sig)

@@ -1,0 +1,160 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors (python/paddle/sparse/ analog).
+
+Built on jax.experimental.sparse BCOO/BCSR: sparse tensors stay jax
+pytrees, matmul lowers to XLA gather/scatter (TPU has no sparse MXU path,
+so like the reference's cuSPARSE fallback this is bandwidth-bound — the
+structured 2:4 path lives in incubate.asp).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import OpDef, apply_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "subtract", "multiply", "matmul", "masked_matmul",
+           "relu", "to_dense", "to_sparse_coo", "coalesce", "nnz", "transpose"]
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose _value is a BCOO array; dense ops densify explicitly."""
+
+    @property
+    def indices_t(self):
+        return Tensor(jnp.asarray(self._value.indices).T)
+
+    def indices(self):
+        return self.indices_t
+
+    def values(self):
+        return Tensor(self._value.data)
+
+    def to_dense(self):
+        return Tensor(self._value.todense())
+
+    def nnz(self):
+        return int(self._value.nse)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True) -> SparseCooTensor:
+    idx = jnp.asarray(indices.value if isinstance(indices, Tensor) else indices)
+    vals = jnp.asarray(values.value if isinstance(values, Tensor) else values,
+                       dtype=dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in jnp.max(idx, axis=1))
+    mat = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    t = SparseCooTensor(0.0, stop_gradient=stop_gradient)
+    t._value = mat
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """CSR input surface; stored as BCOO internally (one generation of
+    sparse kernels — reference keeps separate Coo/Csr kernel sets)."""
+    import numpy as np
+    crows = np.asarray(crows.value if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.value if isinstance(cols, Tensor) else cols)
+    vals = np.asarray(values.value if isinstance(values, Tensor) else values)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, cols])
+    return sparse_coo_tensor(idx, vals, shape, dtype=dtype,
+                             stop_gradient=stop_gradient)
+
+
+def _sp(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(_sp(x).shape) == tuple(_sp(y).shape)
+
+
+def _wrap_sparse(mat) -> SparseCooTensor:
+    t = SparseCooTensor(0.0)
+    t._value = mat
+    return t
+
+
+def add(x, y):
+    r = _sp(x) + _sp(y)
+    return _wrap_sparse(r) if isinstance(r, jsparse.BCOO) else Tensor(r)
+
+
+def subtract(x, y):
+    r = _sp(x) + (-1.0) * _sp(y)
+    return _wrap_sparse(r) if isinstance(r, jsparse.BCOO) else Tensor(r)
+
+
+def multiply(x, y):
+    xm = _sp(x)
+    if isinstance(xm, jsparse.BCOO):
+        ym = _sp(y)
+        yd = ym.todense() if isinstance(ym, jsparse.BCOO) else ym
+        picked = yd[tuple(xm.indices.T)]
+        return _wrap_sparse(jsparse.BCOO((xm.data * picked, xm.indices),
+                                         shape=xm.shape))
+    return Tensor(xm * _sp(y))
+
+
+def matmul(x, y):
+    """sparse @ dense (phi sparse matmul kernel analog); differentiable."""
+    xm, ym = _sp(x), _sp(y)
+
+    def impl(dense):
+        return xm @ dense
+
+    if isinstance(ym, jsparse.BCOO):
+        return _wrap_sparse(xm @ ym)
+    if isinstance(y, Tensor):
+        opdef = OpDef("sparse_matmul", impl)
+        return apply_op(opdef, (y,), {})
+    return Tensor(xm @ jnp.asarray(ym))
+
+
+def masked_matmul(x, y, mask):
+    """(dense @ dense) sampled at mask's sparsity (SDDMM)."""
+    xd, yd, mm = _sp(x), _sp(y), _sp(mask)
+    idx = mm.indices
+    rows = xd[idx[:, 0]]
+    cols = yd[:, idx[:, 1]].T
+    vals = jnp.sum(rows * cols, axis=-1)
+    return _wrap_sparse(jsparse.BCOO((vals, idx), shape=mm.shape))
+
+
+def relu(x):
+    m = _sp(x)
+    return _wrap_sparse(jsparse.BCOO((jnp.maximum(m.data, 0), m.indices),
+                                     shape=m.shape))
+
+
+def to_dense(x):
+    return Tensor(_sp(x).todense())
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    return _wrap_sparse(jsparse.BCOO.fromdense(_sp(x)))
+
+
+def coalesce(x):
+    return _wrap_sparse(_sp(x).sum_duplicates())
+
+
+def nnz(x) -> int:
+    return int(_sp(x).nse)
+
+
+def transpose(x, perm):
+    return _wrap_sparse(_sp(x).transpose(tuple(perm)))

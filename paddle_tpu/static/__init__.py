@@ -1,0 +1,189 @@
+"""paddle_tpu.static — static-graph compatibility namespace.
+
+Analog of python/paddle/static/ (P10). TPU-native reality: "static mode"
+IS tracing + XLA compilation, so Program/Executor here are thin recorders
+over the jit machinery — `Program` captures a traced function, `Executor`
+compiles and runs it, `save/load_inference_model` round-trips a traced
+function + weights (serving export, SURVEY M10).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "data", "Executor",
+           "save_inference_model", "load_inference_model", "gradients",
+           "name_scope"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (shape with None dims, dtype, name)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def example(self):
+        shape = tuple(1 if s in (None, -1) else s for s in self.shape)
+        import jax.numpy as jnp
+        return Tensor(jnp.zeros(shape, dtype=self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, name={self.name!r})"
+
+
+class Program:
+    """Holds a traced callable + its input specs (ProgramDesc stand-in)."""
+
+    def __init__(self):
+        self.fn = None
+        self.input_specs: List[InputSpec] = []
+        self._feed_order: List[str] = []
+
+    def clone(self, for_test: bool = False):
+        p = Program()
+        p.fn = self.fn
+        p.input_specs = list(self.input_specs)
+        p._feed_order = list(self._feed_order)
+        return p
+
+    def __repr__(self):
+        return f"Program(inputs={[s.name for s in self.input_specs]})"
+
+
+_MAIN = Program()
+_STARTUP = Program()
+
+
+def default_main_program() -> Program:
+    return _MAIN
+
+
+def default_startup_program() -> Program:
+    return _STARTUP
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _MAIN, _STARTUP
+        self._prev = (_MAIN, _STARTUP)
+        _MAIN = self.main
+        if self.startup is not None:
+            _STARTUP = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        global _MAIN, _STARTUP
+        _MAIN, _STARTUP = self._prev
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> InputSpec:
+    spec = InputSpec(shape, dtype, name)
+    _MAIN.input_specs.append(spec)
+    _MAIN._feed_order.append(name)
+    return spec
+
+
+class Executor:
+    """paddle.static.Executor parity over jit (executor.py:1174 analog)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program or _MAIN
+        if program.fn is None:
+            raise ValueError("Program has no traced function; use "
+                             "paddle.jit.to_static or load_inference_model")
+        feed = feed or {}
+        args = [Tensor(np.asarray(feed[n])) for n in program._feed_order]
+        out = program.fn(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                    for o in outs]
+        return list(outs)
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """static gradients API -> tape grad (base/backward.py append_backward
+    capability analog, computed by transform instead of transpiler)."""
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    loss = ts[0]
+    for t in ts[1:]:
+        loss = loss + paddle.sum(t)
+    return paddle.grad(loss, xs, retain_graph=True, allow_unused=True)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program: Optional[Program] = None, **kwargs) -> None:
+    """Export a traced layer/function + weights (static/io.py analog)."""
+    program = program or _MAIN
+    layer = kwargs.get("layer")
+    fn = kwargs.get("fn") or program.fn
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    state = {}
+    if layer is not None:
+        state = {k: v.numpy() for k, v in layer.state_dict().items()}
+        fn = layer
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"specs": [(s.shape, s.dtype, s.name)
+                               for s in (feed_vars or [])],
+                     "has_layer": layer is not None}, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    if fn is not None and layer is None:
+        import warnings
+        warnings.warn("save_inference_model without layer saves specs+weights "
+                      "only; pass layer= for a loadable module")
+
+
+def load_inference_model(path_prefix: str, executor=None, model_cls=None,
+                         **kwargs):
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    if model_cls is not None:
+        net = model_cls()
+        net.set_state_dict({k: Tensor(v) for k, v in state.items()})
+        net.eval()
+        prog = Program()
+        prog.fn = paddle.jit.to_static(net)
+        specs = [InputSpec(s, d, n) for s, d, n in meta["specs"]]
+        prog.input_specs = specs
+        prog._feed_order = [s.name for s in specs]
+        return prog, [s.name for s in specs], []
+    return meta, state

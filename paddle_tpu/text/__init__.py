@@ -1,0 +1,62 @@
+"""paddle_tpu.text (python/paddle/text/ analog): viterbi decode + dataset
+stubs (datasets require downloads; no egress here)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+@register_op("viterbi_decode")
+def _viterbi(potentials, transition, lengths, include_bos_eos_tag=True):
+    """CRF viterbi decode (phi viterbi_decode kernel analog): scan over
+    time with lax.scan, backtrace with gathered argmax pointers."""
+    B, T, N = potentials.shape
+    start = potentials[:, 0]
+    if include_bos_eos_tag:
+        start = start + transition[-2][None, :N]  # BOS row convention
+
+    def step(carry, emit):
+        score = carry                        # (B, N)
+        cand = score[:, :, None] + transition[None, :N, :N] + emit[:, None, :]
+        best = jnp.max(cand, axis=1)
+        ptr = jnp.argmax(cand, axis=1)
+        return best, ptr
+
+    scores, ptrs = jax.lax.scan(step, start,
+                                jnp.swapaxes(potentials[:, 1:], 0, 1))
+    if include_bos_eos_tag:
+        scores = scores + transition[:N, -1][None, :]
+    last = jnp.argmax(scores, axis=-1)
+    best_score = jnp.max(scores, axis=-1)
+
+    def back(carry, ptr):
+        nxt = carry
+        prev = jnp.take_along_axis(ptr, nxt[:, None], axis=1)[:, 0]
+        return prev, nxt
+
+    _, path_rev = jax.lax.scan(back, last, ptrs, reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1), last[:, None]],
+                           axis=1)
+    return best_score, path
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -190,6 +190,8 @@ class Pad:
         p = self.padding
         if isinstance(p, int):
             p = (p, p, p, p)
+        elif len(p) == 2:  # (horizontal, vertical) paddle form
+            p = (p[0], p[1], p[0], p[1])
         pad = [(p[1], p[3]), (p[0], p[2])]
         if arr.ndim == 3:
             pad.append((0, 0))

@@ -1,0 +1,286 @@
+"""Aux subsystem tests: profiler, static, device, sparse, quantization,
+incubate, fft/signal, audio, text."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_profiler_records_and_exports(tmp_path):
+    import paddle_tpu.profiler as profiler
+    p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                          timer_only=False)
+    p.targets = [profiler.ProfilerTarget.CPU]  # skip XLA trace in tests
+    with p:
+        for i in range(3):
+            with profiler.RecordEvent("train_step"):
+                x = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+                (x @ x).numpy()
+            p.step()
+    out = tmp_path / "trace.json"
+    p.export_chrome_tracing(str(out))
+    import json
+    trace = json.loads(out.read_text())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert names.count("train_step") == 3
+    s = p.summary()
+    assert "train_step" in s
+
+
+def test_profiler_scheduler_states():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(4)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+
+
+def test_static_executor_roundtrip(tmp_path):
+    import paddle_tpu.static as static
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4])
+        # trace a function via jit
+        net = nn.Linear(4, 2)
+        prog.fn = paddle.jit.to_static(net)
+    exe = static.Executor()
+    out = exe.run(prog, feed={"x": np.ones((3, 4), np.float32)},
+                  fetch_list=["y"])
+    assert out[0].shape == (3, 2)
+
+
+def test_device_namespace():
+    import paddle_tpu.device as device
+    assert device.device_count() >= 1
+    assert isinstance(device.cuda.max_memory_allocated(), int)
+    ev1, ev2 = device.Event(), device.Event()
+    ev1.record()
+    ev2.record()
+    assert ev1.elapsed_time(ev2) >= 0
+    assert isinstance(device.cuda.get_device_name(), str)
+
+
+def test_sparse_coo_matmul_and_ops():
+    import paddle_tpu.sparse as sparse
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, (3, 3))
+    assert s.nnz() == 3
+    dense = s.to_dense().numpy()
+    assert dense[0, 1] == 1.0 and dense[2, 2] == 3.0
+    y = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(out.numpy(), dense, rtol=1e-6)
+    paddle.sum(out).backward()
+    assert y.grad is not None
+    r = sparse.relu(sparse.add(s, s))
+    np.testing.assert_allclose(r.to_dense().numpy(), 2 * dense)
+    csr = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 0, 2], vals, (3, 3))
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+
+
+def test_quantization_ptq_flow():
+    from paddle_tpu.quantization import PTQ, QuantConfig
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = PTQ(QuantConfig())
+    qnet = q.quantize(net)
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    ref = net(x).numpy()
+    for _ in range(3):
+        qout = qnet(x)  # calibration passes
+    q.convert(qnet)
+    qout = qnet(x).numpy()
+    assert qout.shape == ref.shape
+    # int8 simulation should stay close on well-scaled data
+    assert np.abs(qout - ref).max() < 0.2 * np.abs(ref).max() + 0.1
+
+
+def test_asp_24_sparsity():
+    from paddle_tpu.incubate import asp
+    net = nn.Linear(8, 6)
+    asp.prune_model(net)
+    assert asp.check_sparsity(net.weight)
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    opt = asp.decorate(opt)
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    loss = paddle.sum(net(x) ** 2)
+    loss.backward()
+    opt.step()
+    assert asp.check_sparsity(net.weight)  # mask survives the update
+
+
+def test_moe_layer_forward_and_aux_loss():
+    from paddle_tpu.incubate.nn import MoELayer
+    experts = [nn.Linear(16, 16) for _ in range(4)]
+    moe = MoELayer(d_model=16, experts=experts, top_k=2)
+    x = paddle.to_tensor(np.random.rand(2, 8, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = moe(x)
+    assert out.shape == (2, 8, 16)
+    assert float(moe.aux_loss.numpy()) > 0
+    paddle.sum(out).backward()
+    assert any(p.grad is not None for p in moe.gate.parameters())
+
+
+def test_lookahead_and_model_average():
+    from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+    p = paddle.framework.tensor.Parameter(np.array([1.0], np.float32))
+    inner = paddle.optimizer.SGD(0.1, parameters=[p])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    for _ in range(2):
+        p.grad = paddle.to_tensor(np.array([1.0], np.float32))
+        la.step()
+    # after 2 steps: fast = 0.8; slow = 1 + 0.5*(0.8-1) = 0.9
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+
+    p2 = paddle.framework.tensor.Parameter(np.array([2.0], np.float32))
+    ma = ModelAverage(parameters=[p2])
+    ma.step()
+    p2._set_value(np.array([4.0], np.float32))
+    ma.step()
+    ma.apply()
+    np.testing.assert_allclose(p2.numpy(), [3.0], rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(p2.numpy(), [4.0], rtol=1e-6)
+
+
+def test_fft_roundtrip_and_grad():
+    import paddle_tpu.fft as fft
+    x = paddle.to_tensor(np.random.rand(16).astype(np.float32),
+                         stop_gradient=False)
+    X = fft.fft(x)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(back.numpy().real, x.numpy(), atol=1e-5)
+    y = paddle.sum(paddle.abs(fft.rfft(x)) ** 2)
+    y.backward()
+    assert x.grad is not None
+
+
+def test_stft_istft_roundtrip():
+    from paddle_tpu.signal import istft, stft
+    from paddle_tpu.audio.functional import get_window
+    sig = np.sin(np.linspace(0, 40 * np.pi, 1024)).astype(np.float32)
+    x = paddle.to_tensor(sig[None])
+    w = get_window("hann", 256)
+    S = stft(x, n_fft=256, hop_length=64, window=w)
+    assert S.shape[1] == 129  # onesided bins
+    rec = istft(S, n_fft=256, hop_length=64, window=w, length=1024)
+    np.testing.assert_allclose(rec.numpy()[0], sig, atol=1e-3)
+
+
+def test_audio_features():
+    from paddle_tpu.audio import LogMelSpectrogram, MFCC
+    sig = paddle.to_tensor(
+        np.random.randn(1, 2048).astype(np.float32))
+    mel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=32)(sig)
+    assert mel.shape[1] == 32
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_mels=32, n_fft=512)(sig)
+    assert mfcc.shape[1] == 13
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import ViterbiDecoder
+    # 2 tags; transition strongly prefers staying
+    trans = np.array([[2.0, -2.0], [-2.0, 2.0]], np.float32)
+    full = np.full((4, 4), -10.0, np.float32)
+    full[:2, :2] = trans
+    full[-2, :2] = 0.0  # BOS
+    full[:2, -1] = 0.0  # EOS
+    pots = np.zeros((1, 5, 2), np.float32)
+    pots[0, 0, 0] = 3.0  # start in tag 0
+    dec = ViterbiDecoder(paddle.to_tensor(full).value)
+    score, path = dec(paddle.to_tensor(pots).value)
+    assert list(np.asarray(path.numpy())[0]) == [0, 0, 0, 0, 0]
+
+
+def test_incubate_fused_functional():
+    from paddle_tpu.incubate.nn import functional as IF
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    out = IF.swiglu(x)
+    assert out.shape == (2, 4)
+    w = paddle.to_tensor(np.ones((8,), np.float32))
+    r = IF.fused_rms_norm(x, w)
+    assert r.shape == x.shape
+
+
+def test_incubate_jvp():
+    from paddle_tpu.incubate.autograd import jvp
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    out, tang = jvp(lambda t: t * t, x)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    np.testing.assert_allclose(tang.numpy(), [4.0])  # d(x^2)=2x * v(=1)
+
+
+def test_hapi_metrics_precision_recall():
+    """Review r4: metrics without custom compute must work in fit/evaluate."""
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Precision, Recall
+    X = np.random.rand(16, 4).astype(np.float32)
+    y = np.random.randint(0, 2, (16, 1)).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    net = nn.Sequential(nn.Linear(4, 1), nn.Sigmoid())
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.BCELoss(), metrics=[Precision(), Recall()])
+    logs = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "precision" in logs and "recall" in logs
+
+
+def test_distribution_grads_flow():
+    """Review r4: log_prob/rsample must be differentiable wrt params."""
+    import paddle_tpu.distribution as D
+    mu = paddle.to_tensor(np.array([0.5], np.float32), stop_gradient=False)
+    sigma = paddle.to_tensor(np.array([1.5], np.float32), stop_gradient=False)
+    d = D.Normal(mu, sigma)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    nll = -d.log_prob(x)
+    nll.backward()
+    assert mu.grad is not None and sigma.grad is not None
+    # d(-logp)/dmu = -(x-mu)/sigma^2 = -0.5/2.25
+    np.testing.assert_allclose(mu.grad.numpy(), [-0.5 / 2.25], rtol=1e-5)
+    # rsample pathwise gradient
+    mu.clear_grad()
+    paddle.seed(3)
+    s = d.rsample((4,))
+    paddle.sum(s).backward()
+    np.testing.assert_allclose(mu.grad.numpy(), [4.0], rtol=1e-6)
+
+
+def test_summary_output_shapes(capsys):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    info = paddle.summary(net, (2, 4))
+    out = capsys.readouterr().out
+    assert "[2, 8]" in out and "[2, 2]" in out
+    assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_pad_two_tuple():
+    from paddle_tpu.vision import transforms as T
+    img = np.zeros((4, 6, 3), np.uint8)
+    out = T.Pad((2, 3))(img)
+    assert out.shape == (4 + 6, 6 + 4, 3)
+
+
+def test_early_stopping_saves_best():
+    from paddle_tpu.hapi import EarlyStopping, Model
+    from paddle_tpu.io import TensorDataset
+    X = np.random.rand(8, 4).astype(np.float32)
+    y = np.random.randint(0, 2, 8).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(0.0, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+    model.fit(ds, eval_data=ds, epochs=5, batch_size=8, verbose=0,
+              callbacks=[es])
+    assert es.best_state_dict is not None
+    assert "weight" in es.best_state_dict
