@@ -29,16 +29,20 @@ def main():
     n_dev = len(jax.devices())
     on_tpu = jax.devices()[0].platform == "tpu"
 
-    # ~134M-param Llama (GPT2-small scale); float32 for now (bf16 policy is
-    # upcoming perf work — MFU below is vs the bf16 peak, i.e. conservative)
+    # ~134M-param Llama (GPT2-small scale), bf16 params + f32 Adam moments
     cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
                       num_hidden_layers=12, num_attention_heads=12,
-                      num_key_value_heads=12, max_position_embeddings=1024)
+                      num_key_value_heads=12, max_position_embeddings=1024,
+                      dtype="bfloat16" if on_tpu else "float32")
     B, S = (8, 1024) if on_tpu else (2, 128)
     steps = 20 if on_tpu else 3
 
     mesh = init_mesh((1, 1, n_dev) if n_dev > 1 else (1, 1, 1), ("dp", "sep", "mp"))
     model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        import jax.numpy as jnp
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
     plan = llama_tp_plan(model, mesh)
 
@@ -67,7 +71,18 @@ def main():
     n_params = model.num_params()
     flops_per_step = model.flops_per_token(S) * B * S
     achieved = flops_per_step / step_time
-    peak = {"tpu": 459e12, "cpu": 1e12}.get(jax.devices()[0].platform, 1e12)
+    kind = str(jax.devices()[0].device_kind).lower()
+    # bf16 peak per chip by device kind (MFU is vs bf16 peak)
+    if "v5 lite" in kind or "v5e" in kind:
+        peak = 197e12
+    elif "v5p" in kind or "v5" in kind:
+        peak = 459e12
+    elif "v4" in kind:
+        peak = 275e12
+    elif jax.devices()[0].platform == "tpu":
+        peak = 197e12
+    else:
+        peak = 1e12
     print(f"step_time={step_time*1e3:.1f}ms params={n_params/1e6:.1f}M "
           f"MFU~{achieved/ (peak*n_dev) *100:.1f}% (peak={peak/1e12:.0f}TF/chip)",
           file=sys.stderr)

@@ -120,6 +120,9 @@ define_flag("eager_jit_ops", True, "cache-and-jit each eager op call (vs. raw di
 define_flag("benchmark", False, "print per-step timing")
 define_flag("log_level", 0, "verbosity level for framework logging (VLOG analog)")
 define_flag("use_fused_attention", True, "use Pallas flash attention when available")
+define_flag("flash_attention_min_seq", 2048,
+            "min KV seq length to route through the Pallas flash kernel "
+            "(below this XLA's fused sdpa wins; measured on v5e)")
 define_flag("use_ring_attention", True,
             "use ring (context-parallel) attention when the mesh has a sep>1 axis")
 define_flag("default_dtype", "float32", "default floating point dtype")

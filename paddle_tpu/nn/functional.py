@@ -777,7 +777,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True):
     """python/paddle/nn/functional/flash_attention.py:scaled_dot_product_attention
     analog. Layout (batch, seq, heads, head_dim)."""
-    use_flash = flags.use_fused_attention and attn_mask is None and dropout_p == 0.0
+    use_flash = (flags.use_fused_attention and attn_mask is None
+                 and dropout_p == 0.0
+                 and key.shape[1] >= flags.flash_attention_min_seq)
     if use_flash:
         try:
             from paddle_tpu.ops.pallas import flash_attention as fa

@@ -72,12 +72,17 @@ class ShardedTrainer:
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  mesh: ProcessMesh, plan: Optional[Dict[str, Sequence]] = None,
-                 data_spec: Optional[P] = None, donate: bool = True):
+                 data_spec: Optional[P] = None, donate: bool = True,
+                 amp_dtype: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.plan = plan or {}
+        # bf16-native AMP: params stay f32 (master weights), MXU ops run in
+        # amp_dtype via the auto_cast dispatch hook (no loss scaling needed
+        # for bf16 on TPU — SURVEY §7.1 AMP row)
+        self.amp_dtype = amp_dtype
         self.data_spec = data_spec if data_spec is not None else sharded_data_spec(mesh)
         self._step = None
 
@@ -156,7 +161,13 @@ class ShardedTrainer:
                             if n in full:
                                 originals.append((t, t._value))
                                 t._value = full[n]
-                        loss = loss_fn(model, *[Tensor(b) for b in batch])
+                        if self.amp_dtype:
+                            from paddle_tpu.amp import auto_cast
+                            with auto_cast(dtype=self.amp_dtype):
+                                loss = loss_fn(model,
+                                               *[Tensor(b) for b in batch])
+                        else:
+                            loss = loss_fn(model, *[Tensor(b) for b in batch])
                     finally:
                         for t, v in originals:
                             t._value = v

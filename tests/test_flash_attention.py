@@ -68,9 +68,10 @@ def test_grads_match_reference(causal):
 
 def test_wired_into_functional():
     """nn.functional.scaled_dot_product_attention uses the kernel when
-    shapes allow (FLAGS use_fused_attention)."""
+    shapes allow (FLAGS use_fused_attention + flash_attention_min_seq)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
+    paddle.set_flags({"flash_attention_min_seq": 64})
     rng = np.random.default_rng(2)
     q = paddle.to_tensor(rng.normal(size=(1, 128, 2, 32)).astype(np.float32),
                          stop_gradient=False)
