@@ -50,7 +50,7 @@ def __getattr__(name):
     if name in ("distributed", "profiler", "vision", "incubate", "models",
                 "static", "hapi", "device", "distribution", "sparse",
                 "quantization", "text", "audio", "fft", "signal", "onnx",
-                "linalg"):
+                "linalg", "geometric", "hub", "inference", "native"):
         mod = _lazy(name)
         globals()[name] = mod
         return mod

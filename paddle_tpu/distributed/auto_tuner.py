@@ -1,0 +1,124 @@
+"""Auto-tuner: search hybrid-parallel configs by short measured trials.
+
+Redesign of python/paddle/distributed/auto_tuner/ (tuner.py:21, search.py,
+prune.py, recorder.py): grid/heuristic candidate generation over
+{dp, mp, pp, sep, micro-batch, recompute}, pruning by divisibility and
+memory estimates, then measured trials (the reference launches real
+subprocesses; single-controller TPU just compiles + times each config on
+the live mesh).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "Candidate", "default_candidates", "prune_by_memory"]
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sep: int = 1
+    micro_batches: int = 1
+    use_recompute: bool = False
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def world(self) -> int:
+        return self.dp * self.mp * self.pp * self.sep
+
+    def key(self):
+        return (self.dp, self.mp, self.pp, self.sep, self.micro_batches,
+                self.use_recompute)
+
+    def __repr__(self):
+        t = self.metrics.get("tokens_per_sec")
+        perf = f", tokens/s={t:.0f}" if t else ""
+        return (f"Candidate(dp={self.dp}, mp={self.mp}, pp={self.pp}, "
+                f"sep={self.sep}, mb={self.micro_batches}, "
+                f"rc={self.use_recompute}{perf})")
+
+
+def default_candidates(n_devices: int, num_layers: int, batch_size: int,
+                       heads: int) -> List[Candidate]:
+    """Divisibility-pruned grid (search.py all_candidates + prune.py rules)."""
+    out = []
+    degrees = [1, 2, 4, 8, 16, 32]
+    for dp, mp, pp, sep in itertools.product(degrees, repeat=4):
+        if dp * mp * pp * sep != n_devices:
+            continue
+        if pp > 1 and num_layers % pp:
+            continue
+        if mp > 1 and heads % mp:
+            continue
+        if dp > 1 and batch_size % dp:
+            continue
+        for mb in (1, 2, 4):
+            if batch_size % (dp * mb):
+                continue
+            for rc in (False, True):
+                out.append(Candidate(dp, mp, pp, sep, mb, rc))
+    return out
+
+
+def prune_by_memory(cands: List[Candidate], param_bytes: int,
+                    hbm_bytes: int = 16 << 30,
+                    optimizer_multiplier: float = 3.0) -> List[Candidate]:
+    """memory_cost_model.py analog: params+grads+opt must fit per chip."""
+    keep = []
+    for c in cands:
+        shard = c.mp * c.pp  # param-sharding degrees
+        per_chip = param_bytes * (1 + optimizer_multiplier) / max(shard, 1)
+        if per_chip < hbm_bytes * 0.9:
+            keep.append(c)
+    return keep
+
+
+class AutoTuner:
+    """Measured-trial loop (tuner.py + recorder.py analog).
+
+    run_trial(candidate) -> tokens_per_sec (caller builds the trainer for
+    the candidate's mesh and times a few steps; exceptions mark the
+    candidate infeasible).
+    """
+
+    def __init__(self, candidates: List[Candidate],
+                 run_trial: Callable[[Candidate], float],
+                 max_trials: Optional[int] = None, warmup_steps: int = 1):
+        self.candidates = list(candidates)
+        self.run_trial = run_trial
+        self.max_trials = max_trials
+        self.history: List[Candidate] = []
+
+    def tune(self, verbose: bool = True) -> Optional[Candidate]:
+        best = None
+        trials = self.candidates[: self.max_trials] if self.max_trials \
+            else self.candidates
+        for cand in trials:
+            t0 = time.time()
+            try:
+                tps = float(self.run_trial(cand))
+                cand.metrics["tokens_per_sec"] = tps
+                cand.metrics["trial_s"] = time.time() - t0
+            except Exception as e:  # infeasible config (OOM/shape) — record
+                cand.metrics["error"] = repr(e)
+                self.history.append(cand)
+                if verbose:
+                    print(f"[auto_tuner] {cand} failed: {e!r}")
+                continue
+            self.history.append(cand)
+            if verbose:
+                print(f"[auto_tuner] {cand}")
+            if best is None or tps > best.metrics["tokens_per_sec"]:
+                best = cand
+        return best
+
+    def sorted_history(self) -> List[Candidate]:
+        return sorted(
+            (c for c in self.history if "tokens_per_sec" in c.metrics),
+            key=lambda c: -c.metrics["tokens_per_sec"])

@@ -1,0 +1,106 @@
+"""Elastic training manager.
+
+Redesign of python/paddle/distributed/fleet/elastic/manager.py
+(ElasticManager:124): the reference registers nodes in etcd with TTL
+heartbeats and relaunches on membership change. TPU-native form: the
+native TCPStore plays the etcd role (no external dependency), nodes
+register with heartbeats, the manager watches membership within an
+``np="min:max"`` range and signals scale events so the launcher restarts
+training from the latest distributed checkpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store, node_id: str, np_range: str = "1:1",
+                 heartbeat_s: float = 5.0, ttl_s: float = 15.0,
+                 on_scale: Optional[Callable[[List[str]], None]] = None):
+        self.store = store
+        self.node_id = node_id
+        lo, _, hi = np_range.partition(":")
+        self.np_min = int(lo)
+        self.np_max = int(hi or lo)
+        self.heartbeat_s = heartbeat_s
+        self.ttl_s = ttl_s
+        self.on_scale = on_scale
+        self._stop = threading.Event()
+        self._members: List[str] = []
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registry (manager.py:217 heartbeat analog over TCPStore) ----------
+    def _beat(self):
+        self.store.set(f"__elastic__/node/{self.node_id}",
+                       str(time.time()).encode())
+
+    def _alive_nodes(self) -> List[str]:
+        now = time.time()
+        alive = []
+        idx = self.store.get("__elastic__/index")
+        known = (idx.decode().split(",") if idx else [])
+        if self.node_id not in known:
+            known.append(self.node_id)
+            self.store.set("__elastic__/index", ",".join(sorted(known)))
+        for nid in known:
+            v = self.store.get(f"__elastic__/node/{nid}")
+            if v is not None and now - float(v) < self.ttl_s:
+                alive.append(nid)
+        return sorted(alive)
+
+    def start(self):
+        self._beat()
+        self._members = self._alive_nodes()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            self._beat()
+            members = self._alive_nodes()
+            if members != self._members:
+                old, self._members = self._members, members
+                if self.on_scale is not None:
+                    self.on_scale(members)
+
+    @property
+    def members(self) -> List[str]:
+        return list(self._members)
+
+    def status(self) -> str:
+        n = len(self._members)
+        if n < self.np_min:
+            return ElasticStatus.HOLD     # wait for quorum
+        return ElasticStatus.RESTART if self._scale_pending() else "ok"
+
+    def _scale_pending(self) -> bool:
+        return self._alive_nodes() != self._members
+
+    def endpoints_env(self) -> dict:
+        """Rewritten PADDLE_* env for the relaunch (manager.py endpoint
+        rewrite analog)."""
+        members = self._members
+        return {
+            "PADDLE_TRAINERS_NUM": str(len(members)),
+            "PADDLE_TRAINER_ID": str(members.index(self.node_id)
+                                     if self.node_id in members else 0),
+        }
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -1,0 +1,82 @@
+"""Comm/step watchdog — hang and failure detection.
+
+Redesign of the reference's CommTaskManager (phi/core/distributed/
+comm_task_manager.cc:67: background threads scanning outstanding NCCL
+tasks for timeout, storing errors to the global store). TPU form: XLA
+collectives cannot be introspected mid-flight, so the observable unit is
+the *step* — a heartbeat thread checks that train steps keep completing
+within a timeout, publishes failures to the TCPStore so other hosts see
+them (§5.3), and triggers a user callback (checkpoint + exit for the
+elastic restart path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["StepWatchdog"]
+
+
+class StepWatchdog:
+    def __init__(self, timeout_s: float = 1800.0,
+                 on_timeout: Optional[Callable[[float], None]] = None,
+                 store=None, rank: int = 0, poll_s: float = 5.0):
+        self.timeout_s = timeout_s
+        self.on_timeout = on_timeout
+        self.store = store
+        self.rank = rank
+        self.poll_s = poll_s
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        """Call once per completed train step."""
+        self._last_beat = time.monotonic()
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_s):
+            stale = time.monotonic() - self._last_beat
+            if stale > self.timeout_s and not self._fired:
+                self._fired = True
+                if self.store is not None:
+                    try:
+                        self.store.set(f"__watchdog__/rank{self.rank}",
+                                       f"step_timeout:{stale:.0f}s")
+                    except Exception:
+                        pass
+                if self.on_timeout is not None:
+                    self.on_timeout(stale)
+
+    def peer_failures(self) -> dict:
+        """Check the store for failures other ranks published."""
+        if self.store is None:
+            return {}
+        out = {}
+        import jax
+        for r in range(jax.process_count()):
+            v = self.store.get(f"__watchdog__/rank{r}")
+            if v:
+                out[r] = v.decode() if isinstance(v, bytes) else v
+        return out
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,86 @@
+"""paddle_tpu.geometric — graph message passing (python/paddle/geometric/).
+
+send_u_recv / send_ue_recv / segment_* as jax segment ops (XLA scatter);
+the reference's fused GPU kernels (graph_send_recv) map to
+jax.ops.segment_sum-style reductions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_REDUCE = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # built on sum
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment(vals, seg_ids, n, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(vals, seg_ids, n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(seg_ids, vals.dtype), seg_ids, n)
+        return s / jnp.maximum(cnt, 1.0)[..., None] if vals.ndim > 1 else \
+            s / jnp.maximum(cnt, 1.0)
+    return _REDUCE[pool](vals, seg_ids, n)
+
+
+@register_op("send_u_recv", ref="python/paddle/geometric/message_passing/send_recv.py")
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None):
+    n = int(out_size) if out_size is not None else x.shape[0]
+    gathered = x[src_index]
+    return _segment(gathered, dst_index, n, reduce_op)
+
+
+@register_op("send_ue_recv")
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None):
+    n = int(out_size) if out_size is not None else x.shape[0]
+    m = x[src_index]
+    if message_op == "add":
+        m = m + y
+    elif message_op == "mul":
+        m = m * y
+    else:
+        raise ValueError(f"message_op {message_op!r}")
+    return _segment(m, dst_index, n, reduce_op)
+
+
+@register_op("send_uv")
+def send_uv(x, y, src_index, dst_index, message_op: str = "add"):
+    a = x[src_index]
+    b = y[dst_index]
+    return a + b if message_op == "add" else a * b
+
+
+@register_op("segment_sum")
+def segment_sum(data, segment_ids):
+    n = int(jnp.max(segment_ids)) + 1 if segment_ids.shape[0] else 0
+    return jax.ops.segment_sum(data, segment_ids, n)
+
+
+@register_op("segment_mean")
+def segment_mean(data, segment_ids):
+    n = int(jnp.max(segment_ids)) + 1 if segment_ids.shape[0] else 0
+    return _segment(data, segment_ids, n, "mean")
+
+
+@register_op("segment_max")
+def segment_max(data, segment_ids):
+    n = int(jnp.max(segment_ids)) + 1 if segment_ids.shape[0] else 0
+    return jax.ops.segment_max(data, segment_ids, n)
+
+
+@register_op("segment_min")
+def segment_min(data, segment_ids):
+    n = int(jnp.max(segment_ids)) + 1 if segment_ids.shape[0] else 0
+    return jax.ops.segment_min(data, segment_ids, n)

@@ -1,0 +1,50 @@
+"""paddle_tpu.hub (python/paddle/hub.py analog).
+
+torch-hub-like loader. Network egress is unavailable in this environment,
+so `source` must be a local directory containing ``hubconf.py``; the
+github form raises with a clear message.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source == "local":
+        return _load_hubconf(repo_dir)
+    raise RuntimeError("hub: only source='local' is supported (no network "
+                       "egress); clone the repo and pass its path")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False) -> List[str]:  # noqa: A001
+    mod = _resolve(repo_dir, source)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",  # noqa: A001
+         force_reload: bool = False) -> str:
+    mod = _resolve(repo_dir, source)
+    return getattr(mod, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, *args, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    mod = _resolve(repo_dir, source)
+    return getattr(mod, model)(*args, **kwargs)

@@ -1,0 +1,116 @@
+"""paddle_tpu.inference — deployment predictor.
+
+Analog of the reference's AnalysisPredictor stack (paddle/fluid/inference/
+api/analysis_predictor.h + paddle_infer python API): load an exported
+model, "IR optimization" = XLA compilation with static shapes + buffer
+donation, cloned-scope concurrency = one compiled executable shared by
+threads (jax executables are thread-safe).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """paddle_infer.Config analog (api/paddle_analysis_config.h)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu"
+        self._memory_optim = True
+        self._layer = None
+
+    def set_model(self, model_path: str, params_path: Optional[str] = None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+    def set_layer(self, layer):
+        """TPU-native path: predict directly from an nn.Layer or a
+        jit.load'd TranslatedLayer."""
+        self._layer = layer
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_use_gpu(self, *a, **k):
+        self._device = "tpu"
+
+
+class _IOHandle:
+    def __init__(self, predictor, name):
+        self._p = predictor
+        self.name = name
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._p._feeds[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._p._results[self.name]
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self.config = config
+        if config._layer is not None:
+            self._layer = config._layer
+        elif config.model_path is not None:
+            self._layer = paddle.jit.load(config.model_path)
+        else:
+            raise ValueError("Config needs set_model(path) or set_layer(layer)")
+        if hasattr(self._layer, "eval"):
+            self._layer.eval()
+        self._static = paddle.jit.to_static(self._layer)
+        self._feeds: Dict[str, np.ndarray] = {}
+        self._results: Dict[str, np.ndarray] = {}
+        self._input_names: List[str] = ["x"]
+        self._output_names: List[str] = ["out"]
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        if name not in self._input_names:
+            self._input_names.append(name)
+        return _IOHandle(self, name)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(self, name)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        if inputs is not None:
+            args = [Tensor(np.asarray(a)) for a in inputs]
+        else:
+            args = [Tensor(self._feeds[n]) for n in self._input_names
+                    if n in self._feeds]
+        with paddle.no_grad():
+            out = self._static(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out_{i}" for i in range(len(outs))] \
+            if len(outs) > 1 else ["out"]
+        self._results = {n: o.numpy() for n, o in zip(self._output_names, outs)}
+        if inputs is not None:
+            return [self._results[n] for n in self._output_names]
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -218,6 +218,34 @@ class ShardedTrainer:
         self.optimizer._step_count += 1
         return Tensor(loss)
 
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self) -> dict:
+        """Model params + optimizer state as Tensors (dist-checkpoint
+        ready: each carries its mesh/placements)."""
+        out = {}
+        for n in self.state_names:
+            out[f"model.{n}"] = self._tensors[n]
+        for n in self.trainable:
+            for k, v in self.opt_state[n].items():
+                t = Tensor(v)
+                t._process_mesh = self.mesh
+                out[f"opt.{n}.{k}"] = t
+        return out
+
+    def save(self, path: str) -> None:
+        from paddle_tpu.distributed import checkpoint as ckpt
+        ckpt.save_state_dict(self.state_dict(), path)
+
+    def load(self, path: str) -> None:
+        from paddle_tpu.distributed import checkpoint as ckpt
+        sd = self.state_dict()
+        ckpt.load_state_dict(sd, path)
+        for n in self.trainable:
+            for k in self.opt_state[n]:
+                new_v = sd[f"opt.{n}.{k}"].value
+                self.opt_state[n][k] = jax.device_put(
+                    new_v, self.opt_shardings[n][k])
+
     def compile_lowered(self, *batch_shapes_dtypes):
         """AOT-lower the step (for dryrun/compile checks without execution)."""
         import numpy as np

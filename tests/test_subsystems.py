@@ -284,3 +284,99 @@ def test_early_stopping_saves_best():
               callbacks=[es])
     assert es.best_state_dict is not None
     assert "weight" in es.best_state_dict
+
+
+def test_auto_tuner_selects_best():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, default_candidates
+    cands = default_candidates(n_devices=8, num_layers=4, batch_size=8, heads=4)
+    assert all(c.world == 8 for c in cands)
+
+    def fake_trial(c):
+        if c.pp > 2:
+            raise RuntimeError("oom")
+        return 1000.0 * c.dp + 10 * c.mp  # prefer dp
+
+    tuner = AutoTuner(cands, fake_trial)
+    best = tuner.tune(verbose=False)
+    assert best is not None and best.dp >= 2
+    assert tuner.sorted_history()[0].metrics["tokens_per_sec"] == best.metrics["tokens_per_sec"]
+
+
+def test_watchdog_fires_and_publishes():
+    import time
+    from paddle_tpu.distributed.watchdog import StepWatchdog
+    from paddle_tpu.native import TCPStore
+    store = TCPStore(is_master=True, world_size=1)
+    fired = []
+    wd = StepWatchdog(timeout_s=0.3, poll_s=0.1, store=store, rank=0,
+                      on_timeout=lambda stale: fired.append(stale))
+    with wd:
+        time.sleep(0.8)
+    assert fired, "watchdog did not fire"
+    assert store.get("__watchdog__/rank0") is not None
+    assert wd.peer_failures() == {0: store.get("__watchdog__/rank0").decode()}
+
+
+def test_elastic_membership_and_scale_event():
+    import time
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.native import TCPStore
+    store = TCPStore(is_master=True, world_size=1)
+    events = []
+    m1 = ElasticManager(store, "node-a", np_range="1:3", heartbeat_s=0.1,
+                        ttl_s=1.0, on_scale=lambda mm: events.append(mm))
+    m1.start()
+    time.sleep(0.2)
+    assert m1.members == ["node-a"]
+    m2 = ElasticManager(store, "node-b", np_range="1:3", heartbeat_s=0.1,
+                        ttl_s=1.0)
+    m2.start()
+    time.sleep(0.5)
+    assert sorted(m1.members) == ["node-a", "node-b"]
+    assert events and events[-1] == ["node-a", "node-b"]
+    env = m2.endpoints_env()
+    assert env["PADDLE_TRAINERS_NUM"] == "2"
+    assert env["PADDLE_TRAINER_ID"] == "1"
+    m1.stop(); m2.stop()
+
+
+def test_geometric_send_u_recv():
+    import paddle_tpu.geometric as G
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[1.0], [4.0], [2.0]])
+    mx = G.send_u_recv(x, src, dst, reduce_op="max")
+    np.testing.assert_allclose(mx.numpy(), [[1.0], [3.0], [2.0]])
+
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    net = nn.Sequential(nn.Linear(4, 2))
+    cfg = Config()
+    cfg.set_layer(net)
+    pred = create_predictor(cfg)
+    x = np.random.rand(3, 4).astype(np.float32)
+    out = pred.run([x])
+    np.testing.assert_allclose(out[0], net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5)
+    # handle-style API
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    np.testing.assert_allclose(pred.get_output_handle("out").copy_to_cpu(),
+                               out[0], rtol=1e-6)
+
+
+def test_hub_local(tmp_path):
+    import paddle_tpu.hub as hub
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(n=3):\n"
+        "    'a tiny model'\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(n, n)\n")
+    assert "tiny" in hub.list(str(tmp_path))
+    assert "tiny model" in hub.help(str(tmp_path), "tiny")
+    layer = hub.load(str(tmp_path), "tiny", 5)
+    assert layer.weight.shape == (5, 5)
