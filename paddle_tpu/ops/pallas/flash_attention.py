@@ -51,8 +51,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _visible():  # causal: process only k blocks not fully masked
-        q = q_ref[0].astype(jnp.float32)          # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)          # (BK, D)
+        q = q_ref[0]                              # (BQ, D) io dtype (bf16 ok)
+        k = k_ref[0]                              # (BK, D)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -67,8 +67,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                    # (BQ, BK)
         l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0].astype(jnp.float32)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        v = v_ref[0]
+        # p in io dtype for the MXU (f32 accumulate keeps precision)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -144,10 +145,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _visible():
-        q = q_ref[0].astype(jnp.float32)            # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)            # (BK, D)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)          # (BQ, D)
+        q = q_ref[0]                                # (BQ, D) io dtype
+        k = k_ref[0]                                # (BK, D)
+        v = v_ref[0]
+        do = do_ref[0]                              # (BQ, D)
         lse = lse_ref[0]                            # (BQ, 1)
         delta = delta_ref[0]                        # (BQ, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -157,15 +158,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             mask = (qi * block_q + rows) >= (ki * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)                        # (BQ, BK)
+        p = jnp.exp(s - lse)                        # (BQ, BK) f32
+        pc = p.astype(do.dtype)
         # dv += p^T do
-        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_scr[:] += jax.lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale               # (BQ, BK)
+        ds = (p * (dp - delta) * scale)             # (BQ, BK) f32
+        dsc = ds.astype(q.dtype)
         # dk += ds^T q
-        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk_scr[:] += jax.lax.dot_general(dsc, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
     if causal:
@@ -192,10 +195,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _visible():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -208,7 +211,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
