@@ -1,6 +1,14 @@
-"""paddle_tpu.models — reference model families (flagship: Llama)."""
+"""paddle_tpu.models — reference model families (flagship: Llama).
+
+Coverage of the BASELINE.md configs: Llama (TP/PP/CP hybrid trainers),
+GPT (fused-qkv causal LM), BERT (MLM pretraining), diffusion UNet
+(SD-style), plus vision CNNs in paddle_tpu.vision.models.
+"""
 
 from paddle_tpu.models.llama import (  # noqa: F401
     LLAMA_7B_CONFIG, TINY_CONFIG, LlamaConfig, LlamaForCausalLM, LlamaModel,
     llama_tp_plan,
 )
+from paddle_tpu.models.gpt import GPT_TINY, GPTConfig, GPTForCausalLM  # noqa: F401
+from paddle_tpu.models.bert import BERT_TINY, BertConfig, BertForMaskedLM  # noqa: F401
+from paddle_tpu.models.unet import UNET_TINY, UNet2DConditionModel, UNetConfig  # noqa: F401

@@ -1,0 +1,79 @@
+"""BERT-style encoder + MLM head (BASELINE's BERT-base MLM pretraining
+config; built on nn.TransformerEncoder, the reference's
+python/paddle/nn/layer/transformer.py blocks)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["BertConfig", "BertForMaskedLM", "BERT_TINY"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.1
+
+
+BERT_TINY = BertConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=128,
+                       max_position_embeddings=64, dropout=0.0)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        S = input_ids.shape[1]
+        pos = paddle.arange(S, dtype="int64").unsqueeze(0)
+        e = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            e = e + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(e))
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        layer = nn.TransformerEncoderLayer(
+            d_model=config.hidden_size, nhead=config.num_attention_heads,
+            dim_feedforward=config.intermediate_size,
+            dropout=config.dropout, activation="gelu",
+            normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, config.num_hidden_layers)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_ln = nn.LayerNorm(config.hidden_size,
+                                         epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        h = self.encoder(h, src_mask=attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(h)))
+        return paddle.matmul(h, self.embeddings.word_embeddings.weight.t())
+
+    def loss(self, input_ids, labels, ignore_index: int = -100, **kw):
+        logits = self(input_ids, **kw)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]),
+                               ignore_index=ignore_index)

@@ -1,0 +1,87 @@
+"""GPT-2-style causal LM (capability analog of the reference's GPT configs
+in test/auto_parallel/hybrid_strategy + PaddleNLP GPT): LayerNorm (not
+RMSNorm), learned positional embeddings, fused-qkv MHA, GELU MLP."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTForCausalLM", "GPT_TINY"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.1
+
+
+GPT_TINY = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=128, dropout=0.0)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.c_attn = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.c_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp_fc = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.mlp_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.n_head = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+
+    def forward(self, x):
+        B, S, H = x.shape
+        qkv = self.c_attn(self.ln_1(x)).reshape([B, S, 3, self.n_head,
+                                                 self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                           training=self.training)
+        a = self.c_proj(a.reshape([B, S, H]))
+        x = x + self.drop(a)
+        m = self.mlp_proj(F.gelu(self.mlp_fc(self.ln_2(x))))
+        return x + self.drop(m)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.drop = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        pos = paddle.arange(S, dtype="int64").unsqueeze(0)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.h:
+            x = blk(x)
+        x = self.ln_f(x)
+        return paddle.matmul(x, self.wte.weight.t())  # tied head
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))

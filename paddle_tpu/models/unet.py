@@ -1,0 +1,207 @@
+"""Diffusion UNet (Stable-Diffusion-2.1-UNet capability analog,
+BASELINE's SD config): timestep-conditioned residual blocks,
+self+cross-attention at low resolutions, skip connections. Sized by
+`model_channels`; the flash-attention path serves the attention blocks.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["UNetConfig", "UNet2DConditionModel", "UNET_TINY"]
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    model_channels: int = 320
+    channel_mult: Tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    attention_levels: Tuple[int, ...] = (1, 2, 3)
+    num_heads: int = 8
+    context_dim: Optional[int] = 1024
+    groups: int = 32
+
+
+UNET_TINY = UNetConfig(in_channels=4, out_channels=4, model_channels=32,
+                       channel_mult=(1, 2), num_res_blocks=1,
+                       attention_levels=(1,), num_heads=4, context_dim=32,
+                       groups=8)
+
+
+def timestep_embedding(t, dim: int):
+    """Sinusoidal timestep embedding (SD convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+    tv = t.value if isinstance(t, Tensor) else jnp.asarray(t)
+    args = tv.astype(jnp.float32)[:, None] * freqs[None]
+    return Tensor(jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1))
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, in_c, out_c, time_c, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(min(groups, in_c), in_c)
+        self.conv1 = nn.Conv2D(in_c, out_c, 3, padding=1)
+        self.time_proj = nn.Linear(time_c, out_c)
+        self.norm2 = nn.GroupNorm(min(groups, out_c), out_c)
+        self.conv2 = nn.Conv2D(out_c, out_c, 3, padding=1)
+        self.skip = (nn.Conv2D(in_c, out_c, 1) if in_c != out_c
+                     else nn.Identity())
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_proj(F.silu(temb)).unsqueeze(-1).unsqueeze(-1)
+        h = self.conv2(F.silu(self.norm2(h)))
+        return h + self.skip(x)
+
+
+class AttentionBlock(nn.Layer):
+    """Self-attention + optional cross-attention on (B, C, H, W) maps."""
+
+    def __init__(self, channels, num_heads, context_dim, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(min(groups, channels), channels)
+        self.num_heads = num_heads
+        self.head_dim = channels // num_heads
+        self.to_qkv = nn.Linear(channels, 3 * channels, bias_attr=False)
+        self.proj = nn.Linear(channels, channels)
+        self.context_dim = context_dim
+        if context_dim is not None:
+            self.to_q2 = nn.Linear(channels, channels, bias_attr=False)
+            self.to_kv2 = nn.Linear(context_dim, 2 * channels, bias_attr=False)
+            self.proj2 = nn.Linear(channels, channels)
+
+    def _attend(self, q, k, v, B, L, C):
+        q = q.reshape([B, -1, self.num_heads, self.head_dim])
+        k = k.reshape([B, -1, self.num_heads, self.head_dim])
+        v = v.reshape([B, -1, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=False,
+                                             training=self.training)
+        return out.reshape([B, L, C])
+
+    def forward(self, x, context=None):
+        B, C, H, W = x.shape
+        L = H * W
+        h = self.norm(x).reshape([B, C, L]).transpose([0, 2, 1])
+        qkv = self.to_qkv(h)
+        q, k, v = paddle.chunk(qkv, 3, axis=-1)
+        h = h + self.proj(self._attend(q, k, v, B, L, C))
+        if context is not None and self.context_dim is not None:
+            q2 = self.to_q2(h)
+            kv = self.to_kv2(context)
+            k2, v2 = paddle.chunk(kv, 2, axis=-1)
+            h = h + self.proj2(self._attend(q2, k2, v2, B, L, C))
+        return x + h.transpose([0, 2, 1]).reshape([B, C, H, W])
+
+
+class Downsample(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.op = nn.Conv2D(c, c, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2D(c, c, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNet2DConditionModel(nn.Layer):
+    def __init__(self, config: UNetConfig = UNET_TINY):
+        super().__init__()
+        cfg = self.config = config
+        ch = cfg.model_channels
+        time_c = ch * 4
+        self.time_mlp = nn.Sequential(nn.Linear(ch, time_c), nn.Silu(),
+                                      nn.Linear(time_c, time_c))
+        self.conv_in = nn.Conv2D(cfg.in_channels, ch, 3, padding=1)
+
+        self.down_blocks = nn.LayerList()
+        self.downsamplers = nn.LayerList()
+        chans = [ch]
+        cur = ch
+        for lvl, mult in enumerate(cfg.channel_mult):
+            out_c = ch * mult
+            blocks = nn.LayerList()
+            for _ in range(cfg.num_res_blocks):
+                entry = nn.LayerList([ResBlock(cur, out_c, time_c, cfg.groups)])
+                if lvl in cfg.attention_levels:
+                    entry.append(AttentionBlock(out_c, cfg.num_heads,
+                                                cfg.context_dim, cfg.groups))
+                blocks.append(entry)
+                cur = out_c
+                chans.append(cur)
+            self.down_blocks.append(blocks)
+            if lvl != len(cfg.channel_mult) - 1:
+                self.downsamplers.append(Downsample(cur))
+                chans.append(cur)
+            else:
+                self.downsamplers.append(nn.Identity())
+
+        self.mid_block1 = ResBlock(cur, cur, time_c, cfg.groups)
+        self.mid_attn = AttentionBlock(cur, cfg.num_heads, cfg.context_dim,
+                                       cfg.groups)
+        self.mid_block2 = ResBlock(cur, cur, time_c, cfg.groups)
+
+        self.up_blocks = nn.LayerList()
+        self.upsamplers = nn.LayerList()
+        for lvl, mult in reversed(list(enumerate(cfg.channel_mult))):
+            out_c = ch * mult
+            blocks = nn.LayerList()
+            for _ in range(cfg.num_res_blocks + 1):
+                skip_c = chans.pop()
+                entry = nn.LayerList([ResBlock(cur + skip_c, out_c, time_c,
+                                               cfg.groups)])
+                if lvl in cfg.attention_levels:
+                    entry.append(AttentionBlock(out_c, cfg.num_heads,
+                                                cfg.context_dim, cfg.groups))
+                blocks.append(entry)
+                cur = out_c
+            self.up_blocks.append(blocks)
+            self.upsamplers.append(Upsample(cur) if lvl else nn.Identity())
+
+        self.norm_out = nn.GroupNorm(min(cfg.groups, cur), cur)
+        self.conv_out = nn.Conv2D(cur, cfg.out_channels, 3, padding=1)
+
+    def forward(self, x, timesteps, encoder_hidden_states=None):
+        cfg = self.config
+        temb = self.time_mlp(timestep_embedding(timesteps, cfg.model_channels))
+        h = self.conv_in(x)
+        skips = [h]
+        for lvl, blocks in enumerate(self.down_blocks):
+            for entry in blocks:
+                h = entry[0](h, temb)
+                if len(entry) > 1:
+                    h = entry[1](h, encoder_hidden_states)
+                skips.append(h)
+            if lvl != len(cfg.channel_mult) - 1:
+                h = self.downsamplers[lvl](h)
+                skips.append(h)
+        h = self.mid_block2(self.mid_attn(self.mid_block1(h, temb),
+                                          encoder_hidden_states), temb)
+        for i, blocks in enumerate(self.up_blocks):
+            for entry in blocks:
+                h = paddle.concat([h, skips.pop()], axis=1)
+                h = entry[0](h, temb)
+                if len(entry) > 1:
+                    h = entry[1](h, encoder_hidden_states)
+            h = self.upsamplers[i](h)
+        return self.conv_out(F.silu(self.norm_out(h)))
