@@ -19,11 +19,13 @@ from paddle_tpu.parallel import (  # noqa: F401  (semi-auto API, D11)
     get_mesh, init_mesh, reshard, shard_layer, shard_tensor, unshard,
 )
 from paddle_tpu.distributed.collective import (  # noqa: F401
-    Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
-    alltoall, barrier, broadcast, destroy_process_group, gather, get_group,
-    irecv, isend, new_group, recv, reduce, reduce_scatter, scatter, send,
-    stack_for_group, unstack_from_group,
+    Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
+    all_to_all, alltoall, barrier, batch_isend_irecv, broadcast,
+    destroy_process_group, gather, get_group, irecv, isend, new_group, recv,
+    reduce, reduce_scatter, scatter, send, stack_for_group,
+    unstack_from_group,
 )
+from paddle_tpu.distributed.spawn import spawn  # noqa: F401
 from paddle_tpu.distributed.parallel import (  # noqa: F401
     DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
     is_initialized,

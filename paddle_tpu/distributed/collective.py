@@ -497,3 +497,27 @@ def isend(tensor, dst=0, group=None):
 
 def irecv(tensor=None, src=0, group=None):
     return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    """communication/batch_isend_irecv.py P2POp analog."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op  # isend / irecv callables
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Run a batch of P2POps; sends are enqueued first so each recv pairs
+    FIFO (the reference coalesces these into one NCCL group call — here
+    each pair compiles to one collective-permute)."""
+    tasks = []
+    for op in p2p_op_list:
+        if op.op is isend or op.op is send:
+            tasks.append(isend(op.tensor, op.peer, op.group))
+    for op in p2p_op_list:
+        if op.op is irecv or op.op is recv:
+            tasks.append(irecv(op.tensor, op.peer, op.group))
+    return tasks

@@ -1,0 +1,50 @@
+"""paddle.distributed.spawn analog (python/paddle/distributed/spawn.py).
+
+Single-controller note: one process already drives every local TPU chip,
+so per-device worker processes are NOT how local parallelism works here
+(use the mesh). spawn remains for multi-host-style integration tests and
+CPU-side workers: it forks `nprocs` python processes running
+func(rank, *args) with PADDLE_* env set, and joins them.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Tuple
+
+__all__ = ["spawn"]
+
+
+def _worker(func, rank, nprocs, args, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(rank, *args)
+
+
+def spawn(func, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    env = {k: v for k, v in os.environ.items() if k.startswith("PADDLE_")}
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Context:
+        processes = procs
+
+        def join(self, timeout: Optional[float] = None):
+            for p in procs:
+                p.join(timeout)
+            bad = [p.exitcode for p in procs if p.exitcode]
+            if bad:
+                raise RuntimeError(f"spawn workers failed with codes {bad}")
+
+    ctx_obj = Context()
+    if join:
+        ctx_obj.join()
+    return ctx_obj

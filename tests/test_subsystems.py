@@ -326,12 +326,13 @@ def test_elastic_membership_and_scale_event():
     m1 = ElasticManager(store, "node-a", np_range="1:3", heartbeat_s=0.1,
                         ttl_s=1.0, on_scale=lambda mm: events.append(mm))
     m1.start()
-    time.sleep(0.2)
     assert m1.members == ["node-a"]
     m2 = ElasticManager(store, "node-b", np_range="1:3", heartbeat_s=0.1,
-                        ttl_s=1.0)
+                        ttl_s=5.0)
     m2.start()
-    time.sleep(0.5)
+    deadline = time.time() + 15
+    while sorted(m1.members) != ["node-a", "node-b"] and time.time() < deadline:
+        time.sleep(0.1)
     assert sorted(m1.members) == ["node-a", "node-b"]
     assert events and events[-1] == ["node-a", "node-b"]
     env = m2.endpoints_env()
