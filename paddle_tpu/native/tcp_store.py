@@ -4,6 +4,7 @@ barrier built on add/wait, tcp_store.h:121)."""
 from __future__ import annotations
 
 import ctypes
+import threading
 import time
 from typing import Optional
 
@@ -44,40 +45,64 @@ class TCPStore:
         if fd < 0:
             raise ConnectionError(f"TCPStore connect {host}:{port} failed")
         self._fd = fd
+        # One request/response in flight per connection: serialise all client
+        # calls so a store shared across threads (elastic heartbeats, comm
+        # watchdog) cannot interleave frames on the socket.
+        self._lock = threading.Lock()
 
     # -- kv -----------------------------------------------------------------
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
-        if self._lib.ts_set(self._fd, key.encode(), data, len(data)) != 0:
+        with self._lock:
+            rc = self._lib.ts_set(self._fd, key.encode(), data, len(data))
+        if rc != 0:
             raise IOError("TCPStore set failed")
 
     def get(self, key: str) -> Optional[bytes]:
         buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.ts_get(self._fd, key.encode(), buf, len(buf))
+        with self._lock:
+            n = self._lib.ts_get(self._fd, key.encode(), buf, len(buf))
         if n == -1:
             return None
         if n < 0:
             raise IOError("TCPStore get io error")
+        if n > len(buf):
+            raise IOError(f"TCPStore get({key!r}): value of {n} bytes "
+                          f"exceeds {len(buf)}-byte client buffer")
         return buf.raw[:n]
 
     def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
-        t = int((timeout if timeout is not None else self.timeout) * 1000)
+        # Poll with short native waits rather than one long blocking wait so
+        # the connection lock is never held for more than ~50ms at a time
+        # (other threads' set/get/add stay live while we wait).
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else self.timeout)
         buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.ts_wait(self._fd, key.encode(), t, buf, len(buf))
-        if n == -1:
-            raise TimeoutError(f"TCPStore wait({key!r}) timed out")
-        if n < 0:
-            raise IOError("TCPStore wait io error")
-        return buf.raw[:n]
+        while True:
+            with self._lock:
+                n = self._lib.ts_wait(self._fd, key.encode(), 50, buf, len(buf))
+            if n >= 0:
+                if n > len(buf):
+                    raise IOError(f"TCPStore wait({key!r}): value of {n} bytes "
+                                  f"exceeds {len(buf)}-byte client buffer")
+                return buf.raw[:n]
+            if n != -1:
+                raise IOError("TCPStore wait io error")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"TCPStore wait({key!r}) timed out")
 
     def add(self, key: str, delta: int = 1) -> int:
-        r = self._lib.ts_add(self._fd, key.encode(), delta)
+        with self._lock:
+            r = self._lib.ts_add(self._fd, key.encode(), delta)
         if r == -(2 ** 63):
             raise IOError("TCPStore add io error")
         return int(r)
 
     def delete_key(self, key: str) -> None:
-        self._lib.ts_delete(self._fd, key.encode())
+        with self._lock:
+            rc = self._lib.ts_delete(self._fd, key.encode())
+        if rc != 0:
+            raise IOError("TCPStore delete failed")
 
     # -- barrier (store-based, parallel.py init barrier analog) -------------
     def barrier(self, name: str = "default", timeout: Optional[float] = None):
