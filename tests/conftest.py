@@ -5,14 +5,27 @@ devices (SURVEY §4e); our analog is XLA's forced host-platform device count.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: outer env may point at a TPU
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_cpu_platform  # noqa: E402
+
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+_force_cpu_platform(8)  # outer env may point at a TPU
 
-import jax  # noqa: E402
+import pytest  # noqa: E402
 
-# the axon TPU plugin ignores JAX_PLATFORMS; the config knob wins
-jax.config.update("jax_platforms", "cpu")
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="run tests marked slow (model training etc.)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow: pass --runslow to include")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

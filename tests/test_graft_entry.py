@@ -1,0 +1,54 @@
+"""Driver-identical invocation of the __graft_entry__ entry points.
+
+Round-1 failure mode: the driver called dryrun_multichip(8) directly (no
+__main__ block, no conftest) in a process whose jax would initialize on the
+real TPU, and crashed. These tests exercise exactly those call shapes:
+
+- test_dryrun_multichip_direct: plain `import __graft_entry__;
+  dryrun_multichip(8)` (the driver's call).
+- test_dryrun_multichip_wrong_backend: a subprocess first initializes jax on
+  the default 1-device host platform (simulating "wrong backend already
+  live"), then calls dryrun_multichip(8) — must succeed via the re-exec path.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entry_compiles():
+    import jax
+
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_direct():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_wrong_backend():
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "assert len(jax.devices()) == 1; "  # backend live, too small
+        "import sys; sys.path.insert(0, %r); "
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+        % REPO
+    )
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip ok" in proc.stdout
