@@ -120,9 +120,12 @@ define_flag("eager_jit_ops", True, "cache-and-jit each eager op call (vs. raw di
 define_flag("benchmark", False, "print per-step timing")
 define_flag("log_level", 0, "verbosity level for framework logging (VLOG analog)")
 define_flag("use_fused_attention", True, "use Pallas flash attention when available")
-define_flag("flash_attention_min_seq", 2048,
+define_flag("flash_attention_min_seq", 1024,
             "min KV seq length to route through the Pallas flash kernel "
-            "(below this XLA's fused sdpa wins; measured on v5e)")
+            "(below this XLA's fused sdpa wins; at/above it the adaptive "
+            "single-block/512-block schedule wins — measured on v5e: "
+            "S=512 sdpa 3.6ms vs flash 4.5ms, S=1024 sdpa 9.8ms vs "
+            "flash 6.8ms fwd+bwd per layer, and sdpa OOMs at S=2048)")
 define_flag("use_ring_attention", True,
             "use ring (context-parallel) attention when the mesh has a sep>1 axis")
 define_flag("default_dtype", "float32", "default floating point dtype")

@@ -262,13 +262,14 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
     rhs_spec = "OI" + "DHW"[3 - n:]
     out_spec = lhs_spec
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
-    pet = jnp.float32 if jnp.dtype(x.dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) else None
+    # No preferred_element_type here: jax's conv transpose rule (unlike
+    # dot_general's) can't differentiate through a widened output dtype —
+    # the f32 cotangent meets the bf16 weight and conv rejects mixed
+    # dtypes. The TPU MXU accumulates bf16 convs in f32 internally anyway.
     out = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad_arg,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=pet)
-    if pet is not None:
-        out = out.astype(x.dtype)
+        feature_group_count=groups)
     if bias is not None:
         c_axis = lhs_spec.index("C")
         shape = [1] * out.ndim
@@ -306,28 +307,34 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     # paddle transpose-conv weight layout: (in_c, out_c//groups, *k)
     rhs_spec = "IO" + "DHW"[3 - n:]
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, lhs_spec))
+    op_ = _norm_tuple(output_padding, n) if output_padding else (0,) * n
     if isinstance(padding, str):
+        if any(op_):
+            raise ValueError("conv_transpose: output_padding requires "
+                             "explicit (numeric) padding, got "
+                             f"padding={padding!r}")
         pad_arg = padding.upper()
     else:
         p = _conv_padding(padding, n, weight.shape[2:], dilation)
-        # conv_transpose padding semantics: invert forward-conv padding
+        # conv_transpose padding semantics: invert forward-conv padding.
+        # output_padding extends the high side of the dilated-input conv, so
+        # the extra rows/cols hold real gradient-of-conv values (matching
+        # paddle/torch), not zeros.
         k = weight.shape[2:]
         pad_arg = [
             (dilation[i] * (k[i] - 1) - p[i][0],
-             dilation[i] * (k[i] - 1) - p[i][1])
+             dilation[i] * (k[i] - 1) - p[i][1] + op_[i])
             for i in range(n)
         ]
+    # transposed conv = gradient-of-conv: dilate the input by `stride` and
+    # convolve with the spatially-flipped kernel (weight layout (I, O, *k)
+    # already has x's channels as the contracting dim)
+    spatial = tuple(range(2, 2 + n))
+    weight = jnp.flip(weight, axis=spatial)
     out = lax.conv_general_dilated(
         x, weight, window_strides=(1,) * n, padding=pad_arg,
         lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups, transpose_kernel=False)
-    if output_padding:
-        op_ = _norm_tuple(output_padding, n)
-        spatial_axes = [lhs_spec.index(c) for c in "DHW"[3 - n:]]
-        pads = [(0, 0)] * out.ndim
-        for ax, o in zip(spatial_axes, op_):
-            pads[ax] = (0, o)
-        out = jnp.pad(out, pads)
+        feature_group_count=groups)
     if bias is not None:
         c_axis = lhs_spec.index("C")
         shape = [1] * out.ndim

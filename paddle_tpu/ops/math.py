@@ -169,15 +169,33 @@ def cumprod(x, dim=None):
 _export("cumprod")
 
 
-@register_op("cummax", differentiable=False)
+def _cum_extreme(x, axis, is_max):
+    """Running max/min with the index of the extremum (paddle cummax/cummin
+    parity: returns (values, indices)); differentiable in the values."""
+    ax = axis % x.ndim
+    shape = [1] * x.ndim
+    shape[ax] = x.shape[ax]
+    idx = jnp.broadcast_to(
+        jnp.reshape(jnp.arange(x.shape[ax]), shape), x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv >= av) if is_max else (bv <= av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    return lax.associative_scan(combine, (x, idx), axis=ax)
+
+
+@register_op("cummax", n_outputs=2)
 def cummax(x, axis=-1):
-    return lax.associative_scan(jnp.maximum, x, axis=axis)
+    return _cum_extreme(x, axis, is_max=True)
 _export("cummax")
 
 
-@register_op("cummin", differentiable=False)
+@register_op("cummin", n_outputs=2)
 def cummin(x, axis=-1):
-    return lax.associative_scan(jnp.minimum, x, axis=axis)
+    return _cum_extreme(x, axis, is_max=False)
 _export("cummin")
 
 

@@ -310,9 +310,36 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _divisor_block(s: int, target: int) -> int:
+    """Largest divisor of s that is <= target (so any seq length that the
+    old fixed-128 default handled still divides cleanly)."""
+    b = min(target, s)
+    while s % b:
+        b -= 1
+    return b
+
+
+def _auto_blocks(sq: int, sk: int):
+    """Pick block sizes for the v5e VMEM budget: big blocks amortize grid
+    overhead and keep the online-softmax VPU work per MXU op low. Up to
+    1024×1024 the whole S×S f32 score tile (4MB) + accumulators fit VMEM,
+    so short sequences run single-block (no online-softmax recurrence at
+    all); longer sequences tile at <=512 (measured fastest at S>=2048).
+    block_k is additionally capped at 1024 so the K/V tiles stay inside
+    VMEM for skewed shapes (short query, very long KV)."""
+    if sq * sk <= 1024 * 1024 and sk <= 1024:
+        return sq, sk
+    bq, bk = _divisor_block(sq, 512), _divisor_block(sk, 512)
+    if bq % 8 or bk % 8:
+        # sublane-unfriendly tiling (odd seq len) — refuse so the routing
+        # layer falls back to XLA sdpa instead of a degenerate grid
+        raise ValueError(f"flash_attention: no TPU-friendly block tiling "
+                         f"for seq ({sq},{sk})")
+    return bq, bk
+
+
 def flash_attention_fn(q, k, v, causal: bool = False, scale=None,
-                       block_q: int = DEFAULT_BLOCK_Q,
-                       block_k: int = DEFAULT_BLOCK_K):
+                       block_q: int = None, block_k: int = None):
     """Pure-jax flash attention on paddle layout (B, S, H, D).
 
     Falls back to unblocked shapes by shrinking blocks; requires S to be a
@@ -321,8 +348,10 @@ def flash_attention_fn(q, k, v, causal: bool = False, scale=None,
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    if block_q is None or block_k is None:
+        abq, abk = _auto_blocks(sq, sk)
+    block_q = min(block_q, sq) if block_q else abq
+    block_k = min(block_k, sk) if block_k else abk
     if sq % block_q or sk % block_k:
         raise ValueError(f"flash_attention: seq ({sq},{sk}) not divisible by "
                          f"blocks ({block_q},{block_k})")
