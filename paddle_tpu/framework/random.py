@@ -19,18 +19,38 @@ import numpy as np
 __all__ = ["Generator", "seed", "default_generator", "get_rng_state", "set_rng_state", "split_key"]
 
 
+def _tracing() -> bool:
+    try:
+        from jax._src import core as _core
+
+        return not _core.trace_state_clean()
+    except Exception:
+        return False
+
+
 class Generator:
-    """Splittable PRNG stream backed by a jax.random key."""
+    """Splittable PRNG stream backed by a jax.random key.
+
+    Trace-safe: inside a jit trace, keys are derived by fold_in on a host
+    counter and the stored key is NEVER replaced with a traced value (a
+    traced key would poison every later trace — UnexpectedTracerError).
+    Inside one compiled program the derived keys are constants, so repeated
+    executions reuse the same stream; compiled training steps that need
+    fresh randomness per step thread a traced key via push_trace_key
+    (to_static and ShardedTrainer both do).
+    """
 
     def __init__(self, seed_: int = 0):
         self._seed = seed_
         self._key = jax.random.key(seed_)
+        self._draws = 0
         self._lock = threading.Lock()
 
     def manual_seed(self, seed_: int) -> "Generator":
         with self._lock:
             self._seed = seed_
             self._key = jax.random.key(seed_)
+            self._draws = 0
         return self
 
     def initial_seed(self) -> int:
@@ -39,8 +59,15 @@ class Generator:
     def split(self, num: int = 1):
         """Return `num` fresh subkeys, advancing the stream."""
         with self._lock:
+            if _tracing():
+                self._draws += 1
+                base = jax.random.fold_in(self._key, self._draws)
+                if num == 1:
+                    return [base]
+                return [jax.random.fold_in(base, i) for i in range(num)]
             keys = jax.random.split(self._key, num + 1)
             self._key = keys[0]
+            self._draws = 0
             return list(keys[1:]) if num > 1 else [keys[1]]
 
     def get_state(self):

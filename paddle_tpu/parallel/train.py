@@ -145,17 +145,23 @@ class ShardedTrainer:
         state_names, trainable = self.state_names, self.trainable
         wd = getattr(opt, "_weight_decay", 0.0) or 0.0
 
-        def step(params, buffers, opt_state, lr, *batch):
+        def step(params, buffers, opt_state, lr, seed, *batch):
             def compute_loss(train_params):
                 full = dict(buffers)
                 full.update(train_params)
                 from paddle_tpu.autograd import tape
+                from paddle_tpu.framework import random as rnd
                 with tape.no_grad():
                     # swap param values for traced ones; loss_fn drives forward
                     state = dict(model.state_dict())
                     for n, b in model.named_buffers():
                         state.setdefault(n, b)
                     originals = []
+                    # per-step traced RNG key: dropout & co. draw fresh
+                    # randomness every executed step instead of baking the
+                    # trace-time key in as a constant (mpu/random.py
+                    # RNGStatesTracker analog)
+                    rnd.push_trace_key(jax.random.key(seed))
                     try:
                         for n, t in state.items():
                             if n in full:
@@ -169,6 +175,7 @@ class ShardedTrainer:
                         else:
                             loss = loss_fn(model, *[Tensor(b) for b in batch])
                     finally:
+                        rnd.pop_trace_key()
                         for t, v in originals:
                             t._value = v
                 return loss._value if isinstance(loss, Tensor) else loss
@@ -188,6 +195,7 @@ class ShardedTrainer:
             {n: self.shardings[n] for n in trainable},
             {n: self.shardings[n] for n in state_names if n not in trainable},
             self.opt_shardings,
+            NamedSharding(self.mesh.jax_mesh, P()),
             NamedSharding(self.mesh.jax_mesh, P()),
         ) + tuple(NamedSharding(self.mesh.jax_mesh, self.data_spec)
                   for _ in range(n_batch))
@@ -211,7 +219,9 @@ class ShardedTrainer:
         buffers = {n: self._tensors[n]._value for n in self.state_names
                    if n not in self.trainable}
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
-        new_params, new_opt, loss = self._step(params, buffers, self.opt_state, lr, *vals)
+        seed = jnp.asarray(self.optimizer._step_count, dtype=jnp.uint32)
+        new_params, new_opt, loss = self._step(params, buffers, self.opt_state,
+                                               lr, seed, *vals)
         for n in self.trainable:
             self._tensors[n]._set_value(new_params[n])
         self.opt_state = new_opt
@@ -256,4 +266,6 @@ class ShardedTrainer:
         buffers = {n: self._tensors[n]._value for n in self.state_names
                    if n not in self.trainable}
         lr = jnp.asarray(0.0, dtype=jnp.float32)
-        return self._step.lower(params, buffers, self.opt_state, lr, *vals)
+        seed = jnp.asarray(0, dtype=jnp.uint32)
+        return self._step.lower(params, buffers, self.opt_state, lr, seed,
+                                *vals)
