@@ -72,15 +72,28 @@ class TCPStore:
         return buf.raw[:n]
 
     def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
-        # Poll with short native waits rather than one long blocking wait so
-        # the connection lock is never held for more than ~50ms at a time
-        # (other threads' set/get/add stay live while we wait).
+        """Block until ``key`` exists and return its value.
+
+        Polls with short native waits rather than one long blocking wait so
+        the connection lock is never held long (other threads' set/get/add
+        stay live while we wait). Poll interval backs off 50ms -> 250ms to
+        cut steady-state chatter during long waits.
+
+        Caveat: polling leaves windows with no server-side waiter
+        registered, so a key that is set and then deleted *between polls*
+        is missed. Keys waited on must persist until every waiter has seen
+        them (the barrier's 'go' key does).
+        """
         deadline = time.monotonic() + (timeout if timeout is not None
                                        else self.timeout)
         buf = ctypes.create_string_buffer(1 << 20)
+        poll_ms = 50
         while True:
+            remaining = deadline - time.monotonic()
+            native_ms = max(0, min(poll_ms, int(remaining * 1000)))
             with self._lock:
-                n = self._lib.ts_wait(self._fd, key.encode(), 50, buf, len(buf))
+                n = self._lib.ts_wait(self._fd, key.encode(), native_ms,
+                                      buf, len(buf))
             if n >= 0:
                 if n > len(buf):
                     raise IOError(f"TCPStore wait({key!r}): value of {n} bytes "
@@ -90,6 +103,7 @@ class TCPStore:
                 raise IOError("TCPStore wait io error")
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+            poll_ms = min(poll_ms * 2, 250)
 
     def add(self, key: str, delta: int = 1) -> int:
         with self._lock:

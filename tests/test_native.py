@@ -45,6 +45,27 @@ def test_store_wait_timeout():
         s.wait("never", timeout=0.2)
 
 
+def test_store_wait_zero_timeout_immediate():
+    s = TCPStore(is_master=True, world_size=1)
+    s.set("present", b"v")
+    # zero timeout = one immediate check, no ~50ms poll overshoot
+    t0 = time.monotonic()
+    assert s.wait("present", timeout=0) == b"v"
+    with pytest.raises(TimeoutError):
+        s.wait("absent", timeout=0)
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_store_wait_timeout_no_overshoot():
+    s = TCPStore(is_master=True, world_size=1)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        s.wait("never2", timeout=0.3)
+    # deadline is checked before each poll and remaining time bounds the
+    # native wait, so overshoot stays well under one poll interval
+    assert time.monotonic() - t0 < 0.3 + 0.3
+
+
 def _worker_barrier(host, port, world, idx, q):
     st = TCPStore(host=host, port=port, world_size=world)
     st.barrier("b1", timeout=60)
