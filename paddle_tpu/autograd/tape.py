@@ -79,17 +79,34 @@ class GradNode:
 
     ``vjp_fn(cotangents_for_outputs) -> cotangents_for_inputs`` where inputs
     are the flat list of differentiable input tensors recorded in ``inputs``.
+
+    ``closure`` (optional) is the pure forward fn of the primal values; when
+    present, ``create_graph=True`` re-linearizes through it so second-order
+    gradients see the full dependence of the vjp on BOTH primals and
+    cotangents (GeneralGrad analog, paddle/fluid/eager/general_grad.h).
+
+    ``hooks`` maps output slot -> list of gradient hooks, run on that slot's
+    fully-accumulated cotangent before it enters the vjp
+    (GradNodeBase::RegisterGradientHook analog, grad_node_info.h:197).
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals", "__weakref__")
+    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals",
+                 "closure", "hooks", "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int,
-                 out_avals: Sequence[Tuple[tuple, Any]]):
+                 out_avals: Sequence[Tuple[tuple, Any]], closure: Optional[Callable] = None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)  # list[Tensor]
         self.n_outputs = n_outputs
         self.out_avals = list(out_avals)  # [(shape, dtype)] per output
+        self.closure = closure
+        self.hooks: Optional[Dict[int, List[Callable]]] = None
+
+    def add_hook(self, out_index: int, fn: Callable):
+        if self.hooks is None:
+            self.hooks = {}
+        self.hooks.setdefault(out_index, []).append(fn)
 
     def __repr__(self):
         return f"GradNode<{self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs}>"
@@ -124,22 +141,97 @@ def _topo_from(roots: Sequence[GradNode]) -> Dict[GradNode, int]:
     return indeg
 
 
+def _taped_vjp(node: GradNode, cotangents: Sequence[Any]) -> List[Any]:
+    """Fire `node` as a NEW taped op over (primals, cotangents) so the
+    returned input-gradients carry grad nodes of their own (create_graph).
+
+    Re-linearizing through ``node.closure`` (not reusing ``node.vjp_fn``,
+    which closes over the primals as constants) is what makes second-order
+    terms like d(dy/dx)/dtheta correct — the vjp output depends on both the
+    cotangent AND the primal inputs.
+    """
+    from paddle_tpu.framework.tensor import Tensor
+
+    if node.closure is None:
+        raise NotImplementedError(
+            f"create_graph=True through {node.name}: this node records no "
+            "re-differentiable forward closure (PyLayer backward is opaque "
+            "to the tape)")
+    n_in = len(node.inputs)
+    multi = node.n_outputs > 1
+
+    def vjp_closure(*vals):
+        primals, cts = vals[:n_in], vals[n_in:]
+        _, fvjp = jax.vjp(node.closure, *primals)
+        gs = fvjp(tuple(cts) if multi else cts[0])
+        # single-input nodes return a bare array so the walk's
+        # n_outputs==1 cotangent convention round-trips through jax.vjp
+        return gs[0] if len(gs) == 1 else tuple(gs)
+
+    in_tensors = list(node.inputs) + [
+        c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True)
+        for c in cotangents]
+    values = [t._value for t in in_tensors]
+    out_vals, vjp_fn = jax.vjp(vjp_closure, *values)
+    out_list = list(out_vals) if isinstance(out_vals, (tuple, list)) else [out_vals]
+    avals = [(tuple(v.shape), getattr(v, "dtype", None)) for v in out_list]
+    new_node = GradNode(f"grad_{node.name}", vjp_fn, in_tensors,
+                        len(out_list), avals, closure=vjp_closure)
+    outs: List[Any] = []
+    for i, v in enumerate(out_list):
+        if getattr(v, "dtype", None) == jax.dtypes.float0:
+            outs.append(None)  # non-differentiable input slot
+            continue
+        t = Tensor(v, stop_gradient=False)
+        t._grad_node = new_node
+        t._out_index = i
+        outs.append(t)
+    return outs
+
+
+def _apply_hooks(hooks: List[Callable], g):
+    """Run slot hooks in registration order; each may return a replacement
+    gradient (Tensor or array) or None to keep the current one."""
+    from paddle_tpu.framework.tensor import Tensor
+
+    is_tensor = isinstance(g, Tensor)
+    cur = g if is_tensor else Tensor(g, stop_gradient=True)
+    for fn in hooks:
+        new = fn(cur)
+        if new is not None:
+            cur = new if isinstance(new, Tensor) else Tensor(new, stop_gradient=True)
+    return cur if is_tensor else cur._value
+
+
 def _run_backward(
     tensors: Sequence[Any],
     grad_tensors: Optional[Sequence[Any]],
     retain_graph: bool,
     accumulate_into_grad: bool,
     wanted: Optional[Dict[int, Any]] = None,
+    create_graph: bool = False,
 ) -> Dict[int, Any]:
     """Core topological backward walk (RunBackward analog, backward.cc:105).
 
     Returns {id(tensor): cotangent} for leaves (and for `wanted` tensors).
+    With ``create_graph`` the walk operates on Tensors and records every vjp
+    as a fresh taped op, so the results are differentiable again.
     """
     from paddle_tpu.framework.tensor import Tensor  # local import, avoids cycle
 
     roots: List[GradNode] = []
     buffers: Dict[GradNode, List[Any]] = {}  # GradTensorHolder analog
     results: Dict[int, Any] = {}
+    leaf_objs: Dict[int, Any] = {}  # id -> leaf Tensor (for deferred hooks)
+
+    def as_grad(g):
+        if create_graph:
+            return g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+        return g.value if isinstance(g, Tensor) else g
+
+    def land_on_leaf(t, g):
+        results[id(t)] = _accumulate(results.get(id(t)), as_grad(g))
+        leaf_objs.setdefault(id(t), t)
 
     grad_tensors = grad_tensors or [None] * len(tensors)
     for t, g in zip(tensors, grad_tensors):
@@ -149,13 +241,12 @@ def _run_backward(
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape}")
             g = jnp.ones(t.shape, t.dtype)
-        elif isinstance(g, Tensor):
-            g = g.value
+        g = as_grad(g)
         node = t._grad_node
         if node is None:
             # root is a leaf tensor
             if not t.stop_gradient:
-                results[id(t)] = _accumulate(results.get(id(t)), g)
+                land_on_leaf(t, g)
             continue
         if node not in buffers:
             roots.append(node)  # dedupe: two outputs of one op share a node
@@ -165,40 +256,56 @@ def _run_backward(
     indeg = _topo_from(roots)
     ready = deque(n for n in indeg if indeg[n] == 0 and n in buffers)
 
+    def zeros_for(shape, dtype):
+        if dtype == jax.dtypes.float0:
+            import numpy as _np
+            z = _np.zeros(shape, jax.dtypes.float0)
+        else:
+            z = jnp.zeros(shape, dtype)
+        return Tensor(z, stop_gradient=True) if create_graph else z
+
     while ready:
         node = ready.popleft()
         buf = buffers.pop(node, None)
         if buf is not None:
             # fill missing output cotangents with zeros
-            cotangents = tuple(
-                jnp.zeros(shape, dtype) if g is None else g
+            cotangents = [
+                zeros_for(shape, dtype) if g is None else g
                 for g, (shape, dtype) in zip(buf, node.out_avals)
-            )
-            if node.vjp_fn is None:
-                raise RuntimeError(
-                    f"grad node {node.name} was already released; pass "
-                    "retain_graph=True to backward() to allow a second backward pass")
-            in_grads = node.vjp_fn(cotangents if node.n_outputs > 1 else cotangents[0])
-            if not isinstance(in_grads, (tuple, list)):
-                in_grads = (in_grads,)
-            if not retain_graph:
-                node.vjp_fn = None  # free residuals eagerly
+            ]
+            if node.hooks:
+                for idx, fns in node.hooks.items():
+                    cotangents[idx] = _apply_hooks(fns, cotangents[idx])
+            if create_graph:
+                in_grads = _taped_vjp(node, cotangents)
+            else:
+                if node.vjp_fn is None:
+                    raise RuntimeError(
+                        f"grad node {node.name} was already released; pass "
+                        "retain_graph=True to backward() to allow a second backward pass")
+                in_grads = node.vjp_fn(tuple(cotangents) if node.n_outputs > 1
+                                       else cotangents[0])
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                if not retain_graph:
+                    node.vjp_fn = None  # free residuals eagerly
             for t, g in zip(node.inputs, in_grads):
-                if g is None or getattr(g, "dtype", None) == jax.dtypes.float0:
+                if g is None:
                     continue  # non-differentiable (integer/bool) input
+                gv = g._value if isinstance(g, Tensor) else g
+                if getattr(gv, "dtype", None) == jax.dtypes.float0:
+                    continue
                 nxt = t._grad_node
                 if nxt is None:
                     if not t.stop_gradient:
-                        results[id(t)] = _accumulate(results.get(id(t)), g)
-                        if accumulate_into_grad:
-                            t._accumulate_grad(g)
+                        land_on_leaf(t, g)
                     elif wanted is not None and id(t) in wanted:
-                        results[id(t)] = _accumulate(results.get(id(t)), g)
+                        results[id(t)] = _accumulate(results.get(id(t)), as_grad(g))
                 else:
                     nbuf = buffers.setdefault(nxt, [None] * nxt.n_outputs)
-                    nbuf[t._out_index] = _accumulate(nbuf[t._out_index], g)
+                    nbuf[t._out_index] = _accumulate(nbuf[t._out_index], as_grad(g))
                     if wanted is not None and id(t) in wanted:
-                        results[id(t)] = _accumulate(results.get(id(t)), g)
+                        results[id(t)] = _accumulate(results.get(id(t)), as_grad(g))
         # always release dependency counts, even when this node received no
         # cotangents (e.g. all contributions were float0) — upstream nodes may
         # still hold real gradients from other paths
@@ -209,6 +316,16 @@ def _run_backward(
             indeg[nxt] -= 1
             if indeg[nxt] == 0:
                 ready.append(nxt)
+
+    # leaf hooks fire ONCE with the fully-accumulated gradient (the
+    # AccumulateGrad ordering: hooks run before .grad accumulation)
+    for tid, t in leaf_objs.items():
+        g = results[tid]
+        if getattr(t, "_hooks", None):
+            g = _apply_hooks(list(t._hooks.values()), g)
+            results[tid] = g
+        if accumulate_into_grad:
+            t._accumulate_grad(g._value if isinstance(g, Tensor) else g)
     return results
 
 
@@ -229,20 +346,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph: Optional[bool] = None
     """
     from paddle_tpu.framework.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported; use "
-            "paddle_tpu.incubate.autograd (jax.grad composition) for higher-order AD")
     single = not isinstance(inputs, (list, tuple))
     if single:
         inputs = [inputs]
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph
     wanted = {id(t): t for t in inputs}
     results = _run_backward(outputs, grad_outputs, retain_graph,
-                            accumulate_into_grad=False, wanted=wanted)
+                            accumulate_into_grad=False, wanted=wanted,
+                            create_graph=create_graph)
     out = []
     for t in inputs:
         g = results.get(id(t))
@@ -250,5 +364,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph: Optional[bool] = None
             raise ValueError(
                 "one of the inputs receives no gradient; pass allow_unused=True "
                 "to return None for it")
-        out.append(None if g is None else Tensor(g, stop_gradient=True))
+        if g is None:
+            out.append(None)
+        elif create_graph:
+            # graph-connected result: differentiating it reaches back into
+            # the original primals through the re-recorded vjp ops
+            out.append(g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True))
+        else:
+            out.append(Tensor(g._value if isinstance(g, Tensor) else g,
+                              stop_gradient=True))
     return out[0] if single else out

@@ -26,7 +26,8 @@ __all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
 class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
-        "name", "persistable", "_placements", "_process_mesh", "__weakref__",
+        "name", "persistable", "_placements", "_process_mesh", "_hooks",
+        "__weakref__",
     )
 
     # make numpy prefer our __r*__ ops over elementwise np ops
@@ -46,6 +47,7 @@ class Tensor:
         self.persistable = False
         self._placements = None
         self._process_mesh = None
+        self._hooks = None  # leaf gradient hooks (register_hook)
 
     # -- raw value access ---------------------------------------------------
     @property
@@ -140,7 +142,48 @@ class Tensor:
         return self._grad_node is None
 
     def register_hook(self, hook):
-        raise NotImplementedError("per-tensor grad hooks land with the hook pass")
+        """Register a gradient hook: ``hook(grad) -> new_grad | None``, run
+        when this tensor's gradient is computed during backward.
+
+        Leaf tensors fire once with the fully-accumulated gradient (before
+        it lands in ``.grad``); non-leaf tensors fire on the cotangent
+        before it enters the producing op's vjp, so a returned replacement
+        changes all upstream gradients. Returns a removable handle.
+        (tensor_patch_methods.py register_hook +
+        GradNodeBase::RegisterGradientHook, grad_node_info.h:197 analog.)
+        """
+        if self.stop_gradient and self._grad_node is None:
+            raise RuntimeError(
+                "cannot register a gradient hook on a tensor with "
+                "stop_gradient=True")
+
+        class _Handle:
+            def __init__(self, owner, key):
+                self._owner = owner
+                self._key = key
+
+            def remove(self):
+                o, k = self._owner, self._key
+                if isinstance(o, dict):
+                    o.pop(k, None)
+                elif k in o:  # list of entries; remove THIS registration only
+                    o.remove(k)
+
+        if self._grad_node is not None:
+            # non-leaf: hook lives on the producing node's output slot.
+            # Wrap in a unique entry so removing one handle never unhooks a
+            # second registration of the same callable.
+            entry = lambda g, _fn=hook: _fn(g)  # noqa: E731
+            self._grad_node.add_hook(self._out_index, entry)
+            slot = self._grad_node.hooks[self._out_index]
+            return _Handle(slot, entry)
+        if self._hooks is None:
+            self._hooks = {}
+        key = len(self._hooks)
+        while key in self._hooks:
+            key += 1
+        self._hooks[key] = hook
+        return _Handle(self._hooks, key)
 
     # -- mutation (optimizer fast path; breaks no autograd history) ---------
     def _set_value(self, new_value) -> None:
