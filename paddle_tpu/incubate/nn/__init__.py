@@ -1,4 +1,4 @@
 """incubate.nn — fused layers (incubate/nn/ analog)."""
 
 from paddle_tpu.incubate.nn import functional  # noqa: F401
-from paddle_tpu.incubate.nn.moe import MoELayer  # noqa: F401
+from paddle_tpu.incubate.nn.moe import MoELayer, MoEMLP  # noqa: F401

@@ -1,74 +1,202 @@
-"""MoE layer (incubate/distributed/models/moe/moe_layer.py analog).
+"""Mixture-of-Experts layers (incubate/distributed/models/moe/moe_layer.py analog).
 
-Top-k gating + capacity-padded expert dispatch; under an 'ep' mesh axis
-the dispatch/combine compile to the all-to-all exchange the reference does
-with global_scatter/global_gather (MoEScatter:99). Experts are dense
-layers; a Shard(0)-over-ep placement on the stacked expert params gives
-expert parallelism.
+TPU-native redesign of the reference's MoEScatter/MoEGather dispatch
+(moe_layer.py:99): instead of ragged per-expert token counts exchanged by
+NCCL all-to-all, tokens are placed into a dense capacity-padded
+``(n_experts, capacity, d)`` buffer with a single cumsum-position scatter,
+experts run as ONE batched einsum over stacked weights (an MXU-shaped
+grouped GEMM), and outputs gather straight back to token order. Every step
+is a registered tape op, so the layer trains eagerly AND traces under jit;
+with the stacked weights placed ``Shard(0)`` over an ``'ep'`` mesh axis the
+einsum compiles to the expert-parallel all-to-all exchange.
+
+``MoEMLP`` is the performance path (stacked expert FFN, no Python loop).
+``MoELayer`` keeps the reference's list-of-expert-Layers API for
+heterogeneous experts (same one-shot dispatch; per-expert calls remain a
+static loop over the capacity buffer).
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
-
-import jax
-import jax.numpy as jnp
+from typing import List, Optional
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
-from paddle_tpu.framework.tensor import Tensor
-from paddle_tpu.ops.registry import OpDef, apply_op
+import paddle_tpu.nn.functional as F
 
-__all__ = ["MoELayer"]
+__all__ = ["MoEMLP", "MoELayer"]
+
+
+def _one_shot_dispatch(tokens, probs, n_experts: int, top_k: int,
+                       capacity: int, normalize_topk: bool):
+    """Single top-k dispatch shared by both layers — no per-k argsort.
+
+    Returns (buf, slot, keep, gate) where
+      buf  (E*C, H)  capacity-padded expert buffers (flat),
+      slot (K*T,)    flat buffer slot per assignment (k-major order, so
+                     top-1 assignments win capacity over top-2),
+      keep (K*T,)    capacity mask,
+      gate (K*T, 1)  gate weight per assignment.
+    All are graph-connected Tensors (the tape/jit sees one scatter).
+    """
+    gatev, topi = paddle.topk(probs, top_k, axis=-1)      # (T, K) each
+    if normalize_topk and top_k > 1:
+        gatev = gatev / paddle.sum(gatev, axis=-1, keepdim=True)
+
+    # k-major flatten: assignment order (k=0 tokens..., k=1 tokens...)
+    e_flat = paddle.flatten(paddle.transpose(topi, [1, 0]))          # (K*T,)
+    gate_flat = paddle.flatten(paddle.transpose(gatev, [1, 0]))      # (K*T,)
+
+    # position bookkeeping in int32: a bf16 cumsum (AMP activations) cannot
+    # represent counts above 256 and silently collides capacity slots
+    onehot = F.one_hot(e_flat, n_experts).astype("int32")            # (KT, E)
+    # 0-based arrival position of each assignment inside its expert
+    pos = paddle.sum(paddle.cumsum(onehot, axis=0) * onehot,
+                     axis=-1) - 1                                    # (KT,)
+    keep = (pos < capacity).astype(tokens.dtype)                     # (KT,)
+    slot = e_flat.astype("int32") * capacity + paddle.clip(
+        pos, 0, capacity - 1)                                        # (KT,)
+
+    tokens_rep = paddle.tile(tokens, [top_k, 1])                     # (KT, H)
+    buf = paddle.scatter_nd_add(
+        paddle.zeros([n_experts * capacity, tokens.shape[1]], tokens.dtype),
+        paddle.unsqueeze(slot, -1),
+        tokens_rep * paddle.unsqueeze(keep, -1))
+    return buf, slot, keep, paddle.unsqueeze(gate_flat, -1)
+
+
+def _one_shot_combine(y_flat, slot, keep, gate, top_k: int, T: int):
+    """Gather per-assignment outputs back to token order and mix by gate."""
+    picked = paddle.gather(y_flat, slot)                             # (KT, H)
+    picked = picked * paddle.unsqueeze(keep, -1) * gate
+    per_k = paddle.reshape(picked, [top_k, T, y_flat.shape[-1]])
+    return paddle.sum(per_k, axis=0)                                 # (T, H)
+
+
+def _aux_loss(probs, top1, n_experts: int):
+    """GShard load-balancing loss: E * sum_e mean(p_e) * frac(top1 == e)."""
+    me = paddle.mean(probs, axis=0)
+    ce = paddle.mean(F.one_hot(top1, n_experts).astype("float32"), axis=0)
+    return paddle.sum(me * ce) * n_experts
+
+
+class MoEMLP(nn.Layer):
+    """Stacked-expert FFN: ``y = act(x @ w1 + b1) @ w2 + b2`` per expert,
+    run as one grouped einsum over weights ``(E, H, F)`` / ``(E, F, H)``.
+
+    Place ``w1/b1/w2/b2`` with ``Shard(0)`` over an ``'ep'`` mesh axis for
+    expert parallelism (``ep_plan()`` builds the placement dict). Matches
+    the reference's grouped dispatch capability
+    (incubate/distributed/models/moe/moe_layer.py:99) in the TPU-native
+    stacked form.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, n_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu", normalize_topk: bool = True,
+                 gate: Optional[nn.Layer] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.normalize_topk = normalize_topk
+        self.gate = gate or nn.Linear(d_model, n_experts, bias_attr=False)
+        bound = d_model ** -0.5
+        init = nn.initializer.Uniform(-bound, bound)
+        self.w1 = self.create_parameter([n_experts, d_model, d_hidden],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter([n_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([n_experts, d_hidden, d_model],
+                                        default_initializer=init)
+        self.b2 = self.create_parameter([n_experts, 1, d_model], is_bias=True)
+        self.aux_loss = None
+
+    def ep_plan(self, mesh, axis: str = "ep") -> dict:
+        """Param-name -> placements dict for ShardedTrainer: stacked expert
+        weights Shard(0) over `axis`, everything else replicated."""
+        from paddle_tpu.parallel import Replicate, Shard
+        idx = mesh.dim_names.index(axis)
+        plan = {}
+        for name, _ in self.named_parameters():
+            pls = [Replicate()] * mesh.ndim
+            if name.split(".")[-1] in ("w1", "b1", "w2", "b2"):
+                pls[idx] = Shard(0)
+            plan[name] = pls
+        return plan
+
+    def capacity(self, n_tokens: int) -> int:
+        c = int(self.capacity_factor * n_tokens * self.top_k / self.n_experts)
+        return max(c, self.top_k)
+
+    def forward(self, x):
+        B, S, H = x.shape
+        T = B * S
+        tokens = paddle.reshape(x, [T, H])
+        logits = self.gate(tokens)
+        probs = F.softmax(logits, axis=-1)
+        self.aux_loss = _aux_loss(probs, paddle.argmax(probs, axis=-1),
+                                  self.n_experts)
+
+        C = self.capacity(T)
+        buf, slot, keep, gate = _one_shot_dispatch(
+            tokens, probs, self.n_experts, self.top_k, C,
+            self.normalize_topk)
+
+        # grouped GEMMs over the expert axis — exactly the MXU-batched form
+        ebuf = paddle.reshape(buf, [self.n_experts, C, H])           # (E,C,H)
+        h = paddle.einsum("ech,ehf->ecf", ebuf, self.w1) + self.b1
+        h = getattr(F, self.activation)(h)
+        y = paddle.einsum("ecf,efh->ech", h, self.w2) + self.b2      # (E,C,H)
+        y_flat = paddle.reshape(y, [self.n_experts * C, H])
+
+        out = _one_shot_combine(y_flat, slot, keep, gate, self.top_k, T)
+        return paddle.reshape(out, [B, S, H])
 
 
 class MoELayer(nn.Layer):
+    """Reference-API MoE over a list of expert Layers (moe_layer.py analog).
+
+    Uses the same one-shot top-k dispatch as MoEMLP; expert calls are a
+    static loop over the dense capacity buffer (tape-recorded Tensor ops
+    throughout — traces under jit). For homogeneous FFN experts prefer
+    MoEMLP, whose stacked weights shard over 'ep'.
+    """
+
     def __init__(self, d_model: int, experts: List[nn.Layer],
                  gate: Optional[nn.Layer] = None, top_k: int = 2,
                  capacity_factor: float = 1.25, group=None,
-                 recompute_interval: int = 0):
+                 recompute_interval: int = 0, normalize_topk: bool = False):
         super().__init__()
         self.d_model = d_model
         self.experts = nn.LayerList(experts)
         self.n_experts = len(experts)
         self.top_k = top_k
         self.capacity_factor = capacity_factor
+        self.normalize_topk = normalize_topk
         self.gate = gate or nn.Linear(d_model, self.n_experts, bias_attr=False)
         self.aux_loss = None
 
     def forward(self, x):
         B, S, H = x.shape
-        tokens = x.reshape([B * S, H])
-        logits = self.gate(tokens)                      # (T, E)
-        probs = paddle.nn.functional.softmax(logits, axis=-1)
-
-        # load-balancing aux loss (GShard style), kept on self for trainers
-        from paddle_tpu.ops.registry import as_value
-        me = paddle.mean(probs, axis=0)
-        # fraction of tokens whose top-1 is expert e
-        top1 = paddle.argmax(probs, axis=-1)
-        ce = paddle.mean(
-            paddle.nn.functional.one_hot(top1, self.n_experts).astype("float32"),
-            axis=0)
-        self.aux_loss = paddle.sum(me * ce) * self.n_experts
-
         T = B * S
-        capacity = int(self.capacity_factor * T * self.top_k / self.n_experts)
-        capacity = max(capacity, self.top_k)
+        tokens = paddle.reshape(x, [T, H])
+        logits = self.gate(tokens)
+        probs = F.softmax(logits, axis=-1)
+        self.aux_loss = _aux_loss(probs, paddle.argmax(probs, axis=-1),
+                                  self.n_experts)
 
-        out = paddle.zeros_like(tokens)
-        from paddle_tpu.distributed.moe_utils import combine_tokens, dispatch_tokens
-        for k in range(self.top_k):
-            kth = paddle.argsort(logits, axis=-1, descending=True)[:, k]
-            gatev = paddle.sum(
-                probs * paddle.nn.functional.one_hot(
-                    kth, self.n_experts).astype(probs.dtype), axis=-1)
-            buf, slot, keep = dispatch_tokens(tokens, kth, self.n_experts,
-                                              capacity)
-            expert_out = []
-            for e, expert in enumerate(self.experts):
-                expert_out.append(expert(Tensor(buf.value[e])))
-            stacked = Tensor(jnp.stack([eo.value for eo in expert_out]))
-            combined = combine_tokens(stacked, slot, keep)
-            out = out + combined * gatev.unsqueeze(-1)
-        return out.reshape([B, S, H])
+        C = max(int(self.capacity_factor * T * self.top_k / self.n_experts),
+                self.top_k)
+        buf, slot, keep, gate = _one_shot_dispatch(
+            tokens, probs, self.n_experts, self.top_k, C,
+            self.normalize_topk)
+
+        ebuf = paddle.reshape(buf, [self.n_experts, C, H])
+        outs = [self.experts[e](ebuf[e]) for e in range(self.n_experts)]
+        y_flat = paddle.reshape(paddle.stack(outs), [self.n_experts * C, -1])
+
+        out = _one_shot_combine(y_flat, slot, keep, gate, self.top_k, T)
+        return paddle.reshape(out, [B, S, out.shape[-1]])

