@@ -48,6 +48,7 @@ def test_causal_masking():
     assert np.abs(l1[0, -1] - l2[0, -1]).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_sharded_train_step_loss_decreases():
     mesh = init_mesh((2, 1, 4), ("dp", "sep", "mp"))
     model = LlamaForCausalLM(TINY_CONFIG)
@@ -86,6 +87,7 @@ def test_gpt_forward_backward():
                if not p.stop_gradient)
 
 
+@pytest.mark.slow
 def test_bert_mlm_forward_and_loss_decreases():
     from paddle_tpu.models.bert import BERT_TINY, BertForMaskedLM
     model = BertForMaskedLM(BERT_TINY)
@@ -105,6 +107,7 @@ def test_bert_mlm_forward_and_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_unet_denoising_step():
     from paddle_tpu.models.unet import UNET_TINY, UNet2DConditionModel
     model = UNet2DConditionModel(UNET_TINY)

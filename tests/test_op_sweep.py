@@ -1,0 +1,605 @@
+"""Registry-wide op sweep.
+
+Analog of the reference's OpTest white-list sweep
+(test/legacy_test/op_test.py:418 + the per-op test files): every op in
+``paddle_tpu.ops.registry.OPS`` gets
+
+1. an eager dispatch run on generated inputs (finite outputs where float),
+2. a jit-parity check (same impl traced under jax.jit == eager), and
+3. for differentiable float ops, an analytic-vs-central-difference gradient
+   check through the tape.
+
+Ops that cannot be swept generically (data-dependent output shapes under
+jit, randomness, internal plumbing) carry an explicit skip reason; coverage
+is asserted >= 90% of the registry.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (registers all ops)
+import paddle_tpu.incubate  # noqa: F401  (registers fused/incubate ops too,
+#                                  regardless of test collection order)
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, op_api
+
+
+class S:
+    """Static (non-Tensor) positional argument."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+def f(*shape, lo=0.2, hi=0.9):
+    """float32 input maker over a safe domain."""
+    return lambda r: r.uniform(lo, hi, shape).astype(np.float32)
+
+
+def fneg(*shape, lo=-0.9, hi=0.9):
+    return lambda r: r.uniform(lo, hi, shape).astype(np.float32)
+
+
+def ii(*shape, lo=0, hi=4):
+    return lambda r: r.integers(lo, hi, shape).astype(np.int64)
+
+
+def bb(*shape):
+    return lambda r: (r.uniform(0, 1, shape) > 0.5)
+
+
+def spd(n):
+    def make(r):
+        a = r.uniform(0.2, 0.9, (n, n)).astype(np.float32)
+        return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+    return make
+
+
+def sym(n):
+    def make(r):
+        a = r.uniform(-0.9, 0.9, (n, n)).astype(np.float32)
+        return (a + a.T) / 2
+
+    return make
+
+
+def key0(_r):
+    import jax
+
+    return jax.random.PRNGKey(0)
+
+
+# spec fields: in (arg makers / S statics), kw, grad (list of float-input
+# indices to grad-check; [] = forward only), sel (output index for the grad
+# loss; None = sum all float outputs), jit (False = data-dependent shapes)
+def spec(in_, kw=None, grad=None, sel=None, jit=True, rtol=1e-2, atol=1e-3):
+    return dict(in_=in_, kw=kw or {}, grad=grad, sel=sel, jit=jit,
+                rtol=rtol, atol=atol)
+
+
+UN = lambda **k: spec([f(2, 3)], grad=[0], **k)  # noqa: E731
+UN0 = lambda **k: spec([f(2, 3)], grad=[], **k)  # noqa: E731 non-diff
+BIN = lambda **k: spec([f(2, 3), f(2, 3)], grad=[0, 1], **k)  # noqa: E731
+BIN0 = lambda **k: spec([f(2, 3), f(2, 3)], grad=[], **k)  # noqa: E731
+CMP = lambda: spec([f(2, 3), f(2, 3)], grad=[])  # noqa: E731
+LOGIC = lambda: spec([bb(2, 3), bb(2, 3)], grad=[])  # noqa: E731
+INTB = lambda: spec([ii(2, 3, lo=1, hi=7), ii(2, 3, lo=1, hi=7)], grad=[])  # noqa: E731
+RED = lambda **k: spec([f(2, 3)], grad=[0], **k)  # noqa: E731
+
+SPECS = {
+    # ---- unary elementwise ----
+    "abs": spec([f(2, 3, lo=0.3)], grad=[0]),
+    "acos": spec([fneg(2, 3, lo=-0.8, hi=0.8)], grad=[0]),
+    "acosh": spec([f(2, 3, lo=1.3, hi=2.5)], grad=[0]),
+    "angle": spec([f(2, 3)], grad=[]),
+    "asin": spec([fneg(2, 3, lo=-0.8, hi=0.8)], grad=[0]),
+    "asinh": UN(),
+    "assign": UN(),
+    "atan": UN(),
+    "atanh": spec([fneg(2, 3, lo=-0.8, hi=0.8)], grad=[0]),
+    "cast": spec([f(2, 3)], kw=dict(dtype="float64"), grad=[]),
+    "ceil": UN0(),
+    "celu": UN(),
+    "conj": UN(),
+    "cos": UN(),
+    "cosh": UN(),
+    "deg2rad": UN(),
+    "digamma": spec([f(2, 3, lo=0.5, hi=2.0)], grad=[0]),
+    "elu": UN(),
+    "erf": UN(),
+    "erfinv": spec([fneg(2, 3, lo=-0.7, hi=0.7)], grad=[0]),
+    "exp": UN(),
+    "expm1": UN(),
+    "floor": UN0(),
+    "frac": UN(),
+    "gelu": UN(),
+    "hardshrink": spec([f(2, 3, lo=0.6)], grad=[0]),
+    "hardsigmoid": UN(),
+    "hardswish": UN(),
+    "hardtanh": UN(),
+    "i0": UN(),
+    "imag": spec([f(2, 3)], grad=[]),
+    "leaky_relu": UN(),
+    "lgamma": spec([f(2, 3, lo=0.5, hi=2.0)], grad=[0]),
+    "log": UN(),
+    "log10": UN(),
+    "log1p": UN(),
+    "log2": UN(),
+    "log_sigmoid": UN(),
+    "logit": spec([f(2, 3, lo=0.25, hi=0.75)], grad=[0]),
+    "mish": UN(),
+    "multiply_scalar": spec([f(2, 3), S(2.5)], grad=[0]),
+    "nan_to_num": UN(),
+    "neg": UN(),
+    "rad2deg": UN(),
+    "real": UN(),
+    "reciprocal": UN(),
+    "relu": spec([f(2, 3, lo=0.3)], grad=[0]),
+    "relu6": spec([f(2, 3, lo=0.3)], grad=[0]),
+    "round": UN0(),
+    "rsqrt": UN(),
+    "scale": spec([f(2, 3)], kw=dict(scale=2.0, bias=1.0), grad=[0]),
+    "selu": UN(),
+    "sigmoid": UN(),
+    "sign": UN0(),
+    "silu": UN(),
+    "sin": UN(),
+    "sinh": UN(),
+    "softplus": UN(),
+    "softshrink": spec([f(2, 3, lo=0.6)], grad=[0]),
+    "softsign": UN(),
+    "sqrt": UN(),
+    "square": UN(),
+    "stanh": UN(),
+    "swish": UN(),
+    "tan": UN(),
+    "tanh": UN(),
+    "tanhshrink": UN(),
+    "trunc": UN0(),
+    # ---- binary elementwise ----
+    "add": BIN(),
+    "atan2": BIN(),
+    "copysign": spec([f(2, 3), fneg(2, 3)], grad=[]),
+    "divide": BIN(),
+    "dist": spec([f(2, 3), f(2, 3)], grad=[0, 1]),
+    "floor_divide": spec([f(2, 3, lo=1, hi=4), f(2, 3, lo=1, hi=2)], grad=[]),
+    "fmax": BIN(),
+    "fmin": BIN(),
+    "heaviside": BIN0(),
+    "hypot": BIN(),
+    "lerp": spec([f(2, 3), f(2, 3), f(2, 3)], grad=[0, 1, 2]),
+    "logaddexp": BIN(),
+    "maximum": BIN(),
+    "minimum": BIN(),
+    "mod": spec([f(2, 3, lo=1, hi=4), f(2, 3, lo=1, hi=2)], grad=[]),
+    "multiply": BIN(),
+    "nextafter": BIN0(),
+    "pow": spec([f(2, 3, lo=0.3), f(2, 3, lo=1, hi=2)], grad=[0, 1]),
+    "remainder": spec([f(2, 3, lo=1, hi=4), f(2, 3, lo=1, hi=2)], grad=[]),
+    "subtract": BIN(),
+    # ---- comparison / logical / bitwise ----
+    "allclose": CMP(),
+    "equal": CMP(),
+    "equal_all": CMP(),
+    "greater_equal": CMP(),
+    "greater_than": CMP(),
+    "isclose": CMP(),
+    "isfinite": spec([f(2, 3)], grad=[]),
+    "isinf": spec([f(2, 3)], grad=[]),
+    "isnan": spec([f(2, 3)], grad=[]),
+    "less_equal": CMP(),
+    "less_than": CMP(),
+    "not_equal": CMP(),
+    "logical_and": LOGIC(),
+    "logical_not": spec([bb(2, 3)], grad=[]),
+    "logical_or": LOGIC(),
+    "logical_xor": LOGIC(),
+    "bitwise_and": INTB(),
+    "bitwise_not": spec([ii(2, 3, lo=1, hi=7)], grad=[]),
+    "bitwise_or": INTB(),
+    "bitwise_xor": INTB(),
+    "gcd": INTB(),
+    "lcm": INTB(),
+    # ---- matmul family ----
+    "addmm": spec([f(2, 4), f(2, 3), f(3, 4)], grad=[0, 1, 2]),
+    "bmm": spec([f(2, 3, 4), f(2, 4, 5)], grad=[0, 1]),
+    "dot": spec([f(4), f(4)], grad=[0, 1]),
+    "einsum": spec([S("ij,jk->ik"), f(2, 3), f(3, 4)], grad=[0, 1]),
+    "inner": spec([f(2, 4), f(3, 4)], grad=[0, 1]),
+    "kron": spec([f(2, 2), f(3, 3)], grad=[0, 1]),
+    "linear": spec([f(2, 3), f(3, 4), f(4)], grad=[0, 1, 2]),
+    "matmul": spec([f(2, 3), f(3, 4)], grad=[0, 1]),
+    "mm": spec([f(2, 3), f(3, 4)], grad=[0, 1]),
+    "multi_dot": spec([[f(2, 3), f(3, 4), f(4, 2)]], grad=[0, 1, 2]),
+    "mv": spec([f(3, 4), f(4)], grad=[0, 1]),
+    "outer": spec([f(3), f(4)], grad=[0, 1]),
+    "tensordot": spec([f(2, 3, 4), f(3, 4, 5)], grad=[0, 1]),
+    "cross": spec([f(2, 3), f(2, 3)], grad=[0, 1]),
+    "t": spec([f(2, 3)], grad=[0]),
+    # ---- reductions ----
+    "all": spec([bb(2, 3)], grad=[]),
+    "amax": RED(),
+    "amin": RED(),
+    "any": spec([bb(2, 3)], grad=[]),
+    "argmax": spec([f(2, 3)], grad=[]),
+    "argmin": spec([f(2, 3)], grad=[]),
+    "count_nonzero": spec([f(2, 3)], grad=[]),
+    "cummax": spec([f(2, 3)], grad=[0], sel=0),
+    "cummin": spec([f(2, 3)], grad=[0], sel=0),
+    "cumprod": spec([f(2, 3)], kw=dict(dim=1), grad=[0]),
+    "cumsum": spec([f(2, 3)], kw=dict(axis=1), grad=[0]),
+    "logsumexp": RED(),
+    "max": RED(),
+    "mean": RED(),
+    "median": spec([f(5)], grad=[0]),
+    "min": RED(),
+    "mode": spec([ii(2, 5).__call__ and f(2, 5)], grad=[], sel=0),
+    "nanmean": RED(),
+    "nanmedian": spec([f(5)], grad=[]),
+    "nansum": RED(),
+    "norm": RED(),
+    "prod": RED(),
+    "quantile": spec([f(2, 3), S(0.5)], grad=[]),
+    "std": spec([f(2, 3)], grad=[0], atol=5e-3),
+    "sum": RED(),
+    "var": spec([f(2, 3)], grad=[0], atol=5e-3),
+    "trapezoid": spec([f(2, 5)], grad=[0]),
+    "diff": spec([f(2, 5)], grad=[0]),
+    "histogram": spec([f(10)], kw=dict(bins=4), grad=[]),
+    "bincount": spec([ii(8, lo=0, hi=5)], grad=[], jit=False),
+    "corrcoef": spec([f(3, 6)], grad=[]),
+    "cov": spec([f(3, 6)], grad=[0], rtol=3e-2),
+    # ---- sort / search / topk ----
+    "argsort": spec([f(2, 5)], grad=[]),
+    "sort": spec([f(2, 5)], grad=[0]),
+    "searchsorted": spec([lambda r: np.sort(r.uniform(0, 1, (6,))).astype(np.float32),
+                          f(3)], grad=[]),
+    "topk": spec([f(2, 5), S(2)], grad=[0], sel=0),
+    "kthvalue": None,  # not registered; placeholder guard
+    # ---- shape / indexing ----
+    "broadcast_to": spec([f(1, 3), S((2, 3))], grad=[0]),
+    "chunk": spec([f(4, 3), S(2)], grad=[0]),
+    "clip": spec([f(2, 3)], kw=dict(min=0.3, max=0.7), grad=[0]),
+    "concat": spec([[f(2, 3), f(2, 3)]], grad=[0, 1]),
+    "crop": spec([f(4, 4), S((2, 2)), S((1, 1))], grad=[0]),
+    "diag": spec([f(4)], grad=[0]),
+    "diag_embed": spec([f(2, 3)], grad=[0]),
+    "diagonal": spec([f(3, 3)], grad=[0]),
+    "expand": spec([f(1, 3), S((2, 3))], grad=[0]),
+    "expand_as": spec([f(1, 3), f(2, 3)], grad=[0]),
+    "flatten": spec([f(2, 3, 4)], grad=[0]),
+    "flip": spec([f(2, 3), S(0)], grad=[0]),
+    "gather": spec([f(4, 3), ii(2, lo=0, hi=4)], grad=[0]),
+    "gather_nd": spec([f(3, 4), ii(2, 2, lo=0, hi=3)], grad=[0]),
+    "index_add": spec([f(4, 3), ii(2, lo=0, hi=4), S(0), f(2, 3)], grad=[0, 1]),
+    "index_put": spec([f(4, 3), [ii(2, lo=0, hi=4)], f(2, 3)], grad=[0]),
+    "index_select": spec([f(4, 3), ii(2, lo=0, hi=4)], grad=[0]),
+    "masked_fill": spec([f(2, 3), bb(2, 3), S(0.0)], grad=[0]),
+    "masked_select": spec([f(2, 3), bb(2, 3)], grad=[], jit=False),
+    "moveaxis": spec([f(2, 3, 4), S(0), S(2)], grad=[0]),
+    "nonzero": spec([f(2, 3)], grad=[], jit=False),
+    "one_hot": spec([ii(2, 3, lo=0, hi=4), S(4)], grad=[]),
+    "pad": spec([f(1, 2, 4, 4), S([1, 1, 1, 1])], grad=[0]),
+    "put_along_axis": spec([f(3, 4), ii(3, 1, lo=0, hi=4), f(3, 1), S(1)],
+                           grad=[0]),
+    "repeat_interleave": spec([f(2, 3), S(2)], grad=[0]),
+    "reshape": spec([f(2, 6), S((3, 4))], grad=[0]),
+    "roll": spec([f(2, 3), S(1)], grad=[0]),
+    "rot90": spec([f(2, 3)], grad=[0]),
+    "scatter": spec([f(4, 3), ii(2, lo=0, hi=4), f(2, 3)], grad=[0, 1]),
+    "scatter_nd_add": spec([f(4, 3), ii(2, 1, lo=0, hi=4), f(2, 3)],
+                           grad=[0, 1]),
+    "sequence_mask": spec([ii(3, lo=1, hi=5)], kw=dict(maxlen=6), grad=[]),
+    "slice": spec([f(4, 5), S([0, 1]), S([1, 0]), S([3, 4])], grad=[0]),
+    "split": spec([f(4, 3), S(2)], grad=[0]),
+    "squeeze": spec([f(2, 1, 3)], grad=[0]),
+    "stack": spec([[f(2, 3), f(2, 3)]], grad=[0, 1]),
+    "strided_slice": spec([f(6, 5), S([0]), S([1]), S([6]), S([2])], grad=[0]),
+    "swapaxes": spec([f(2, 3, 4), S(0), S(2)], grad=[0]),
+    "take_along_axis": spec([f(3, 4), ii(3, 2, lo=0, hi=4), S(1)], grad=[0]),
+    "tile": spec([f(2, 3), S((2, 2))], grad=[0]),
+    "transpose": spec([f(2, 3)], grad=[0]),
+    "tril": spec([f(3, 3)], grad=[0]),
+    "triu": spec([f(3, 3)], grad=[0]),
+    "unbind": spec([f(3, 2)], grad=[0]),
+    "unfold": spec([f(1, 2, 6, 6), S(3)], grad=[0]),
+    "unique": spec([ii(8, lo=0, hi=5)], grad=[], jit=False),
+    "unsqueeze": spec([f(2, 3), S(1)], grad=[0]),
+    "unstack": spec([f(3, 2)], grad=[0]),
+    "where": spec([bb(2, 3), f(2, 3), f(2, 3)], grad=[0, 1]),
+    "as_complex": spec([f(2, 3, 2)], grad=[]),
+    "as_real": spec([lambda r: (r.uniform(0.2, 0.9, (2, 3))
+                                + 1j * r.uniform(0.2, 0.9, (2, 3))).astype(np.complex64)],
+                    grad=[]),
+    "label_smooth": spec([f(2, 4)], grad=[0]),
+    "normalize": spec([f(2, 4)], grad=[0]),
+    # ---- linalg ----
+    "cholesky": spec([spd(3)], grad=[0], rtol=3e-2),
+    "cholesky_solve": spec([f(3, 2), lambda r: np.linalg.cholesky(
+        spd(3)(r)).astype(np.float32)], grad=[0]),
+    "cond": spec([spd(3)], grad=[]),
+    "det": spec([spd(3)], grad=[0], rtol=3e-2),
+    "eig": spec([spd(3)], grad=[]),
+    "eigh": spec([sym(3)], grad=[]),
+    "eigvals": spec([spd(3)], grad=[]),
+    "eigvalsh": spec([sym(3)], grad=[]),
+    "inv": spec([spd(3)], grad=[0], rtol=3e-2),
+    "lstsq": spec([f(4, 3), f(4, 2)], grad=[]),
+    "lu": spec([spd(3)], grad=[]),
+    "matrix_power": spec([spd(3), S(2)], grad=[0], rtol=3e-2),
+    "matrix_rank": spec([spd(3)], grad=[]),
+    "pinv": spec([f(3, 4)], grad=[]),
+    "qr": spec([f(4, 3)], grad=[], sel=0),
+    "slogdet": spec([spd(3)], grad=[0], sel=1, rtol=3e-2),
+    "solve": spec([spd(3), f(3, 2)], grad=[0, 1], rtol=3e-2),
+    "svd": spec([f(4, 3)], grad=[], sel=1),
+    "triangular_solve": spec([lambda r: np.triu(
+        r.uniform(0.5, 1.5, (3, 3))).astype(np.float32), f(3, 2)], grad=[1]),
+    # ---- nn: conv / pool / norm / act ----
+    "conv1d": spec([f(1, 2, 8), f(3, 2, 3)], grad=[0, 1]),
+    "conv1d_transpose": spec([f(1, 2, 8), f(2, 3, 3)], grad=[0, 1]),
+    "conv2d": spec([f(1, 2, 6, 6), f(3, 2, 3, 3)], grad=[0, 1]),
+    "conv2d_transpose": spec([f(1, 2, 6, 6), f(2, 3, 3, 3)], grad=[0, 1]),
+    "conv3d": spec([f(1, 2, 4, 4, 4), f(3, 2, 2, 2, 2)], grad=[0, 1]),
+    "conv3d_transpose": spec([f(1, 2, 4, 4, 4), f(2, 3, 2, 2, 2)],
+                             grad=[0, 1]),
+    "avg_pool1d": spec([f(1, 2, 6), S(2)], grad=[0]),
+    "avg_pool2d": spec([f(1, 2, 6, 6), S(2)], grad=[0]),
+    "avg_pool3d": spec([f(1, 2, 4, 4, 4), S(2)], grad=[0]),
+    "max_pool1d": spec([f(1, 2, 6), S(2)], grad=[0]),
+    "max_pool2d": spec([f(1, 2, 6, 6), S(2)], grad=[0]),
+    "max_pool3d": spec([f(1, 2, 4, 4, 4), S(2)], grad=[0]),
+    "adaptive_avg_pool1d": spec([f(1, 2, 6), S(2)], grad=[0]),
+    "adaptive_avg_pool2d": spec([f(1, 2, 6, 6), S(2)], grad=[0]),
+    "adaptive_max_pool2d": spec([f(1, 2, 6, 6), S(2)], grad=[0]),
+    "batch_norm_infer": spec([f(2, 3, 4), f(3, lo=0.4, hi=0.6),
+                              f(3, lo=0.5, hi=1.0), f(3), f(3),
+                              S(1e-5), S(1)], grad=[0, 3, 4]),
+    "batch_norm_train": spec([f(2, 3, 4), f(3), f(3), S(1e-5), S(1)],
+                             grad=[0, 1, 2], sel=0, atol=8e-3, rtol=3e-2),
+    "group_norm": spec([f(2, 4, 3, 3), S(2), f(4), f(4)], grad=[0, 1, 2],
+                       atol=8e-3, rtol=3e-2),
+    "instance_norm": spec([f(2, 3, 4, 4), f(3), f(3)], grad=[0, 1, 2],
+                          atol=8e-3, rtol=3e-2),
+    "layer_norm": spec([f(2, 4), S((4,)), f(4), f(4)], grad=[0, 1, 2],
+                       atol=8e-3, rtol=3e-2),
+    "local_response_norm": spec([f(1, 4, 5, 5), S(3)], grad=[0]),
+    "rms_norm": spec([f(2, 4), f(4)], grad=[0, 1], atol=8e-3, rtol=3e-2),
+    "embedding": spec([ii(2, 3, lo=0, hi=5), f(5, 4)], grad=[0]),
+    "interpolate": spec([f(1, 2, 4, 4)], kw=dict(scale_factor=2.0), grad=[0]),
+    "glu": spec([f(2, 4)], grad=[0]),
+    "maxout": spec([f(1, 4, 3, 3), S(2)], grad=[0]),
+    "prelu": spec([f(1, 3, 4, 4, lo=-0.9, hi=0.9), f(3)], grad=[0, 1]),
+    "pixel_shuffle": spec([f(1, 4, 3, 3), S(2)], grad=[0]),
+    "pixel_unshuffle": spec([f(1, 1, 4, 4), S(2)], grad=[0]),
+    "temporal_shift": spec([f(4, 3, 2, 2), S(2)], grad=[0]),
+    "softmax": spec([f(2, 4)], grad=[0]),
+    "log_softmax": spec([f(2, 4)], grad=[0]),
+    "softmax_mask_fuse": spec([f(1, 1, 2, 4), fneg(1, 1, 2, 4, lo=0, hi=0)],
+                              grad=[0]),
+    "swiglu": spec([f(2, 4), f(2, 4)], grad=[0, 1]),
+    # ---- losses ----
+    "binary_cross_entropy": spec([f(2, 3, lo=0.2, hi=0.8),
+                                  f(2, 3, lo=0.2, hi=0.8)], grad=[0]),
+    "binary_cross_entropy_with_logits": spec([fneg(2, 3),
+                                              f(2, 3, lo=0.2, hi=0.8)],
+                                             grad=[0]),
+    "cosine_embedding_loss": spec([f(2, 4), f(2, 4),
+                                   lambda r: np.array([1, -1], np.int64)],
+                                  grad=[0, 1]),
+    "cosine_similarity": spec([f(2, 4), f(2, 4)], grad=[0, 1]),
+    "cross_entropy": spec([fneg(2, 4), ii(2, lo=0, hi=4)], grad=[0]),
+    "hinge_embedding_loss": spec([f(2, 3),
+                                  lambda r: np.array([[1, -1, 1],
+                                                      [-1, 1, -1]], np.int64)],
+                                 grad=[0]),
+    "kl_div": spec([fneg(2, 3, lo=-2, hi=-0.5), f(2, 3, lo=0.2, hi=0.8)],
+                   grad=[0]),
+    "l1_loss": BIN(),
+    "margin_ranking_loss": spec([f(2, 3), f(2, 3),
+                                 lambda r: np.ones((2, 3), np.float32)],
+                                grad=[0, 1]),
+    "mse_loss": BIN(),
+    "nll_loss": spec([fneg(2, 4, lo=-2, hi=-0.5), ii(2, lo=0, hi=4)],
+                     grad=[0]),
+    "pairwise_distance": spec([f(2, 4), f(2, 4)], grad=[0, 1]),
+    "sigmoid_focal_loss": spec([fneg(2, 3), bb(2, 3).__call__ and
+                                (lambda r: (r.uniform(0, 1, (2, 3)) > 0.5)
+                                 .astype(np.float32))], grad=[0]),
+    "smooth_l1_loss": BIN(),
+    "square_error_cost": BIN(),
+    "triplet_margin_loss": spec([f(2, 4), f(2, 4), f(2, 4)], grad=[0, 1, 2]),
+    # ---- attention / misc ----
+    "sdpa_ref": spec([f(1, 2, 4, 8), f(1, 2, 4, 8), f(1, 2, 4, 8)],
+                     grad=[0, 1, 2]),
+    # pallas kernel: forward sweep only (interpret mode on CPU); gradients
+    # have a dedicated parity suite in test_flash_attention.py
+    "flash_attention": spec([f(1, 4, 2, 8), f(1, 4, 2, 8), f(1, 4, 2, 8)],
+                            grad=[]),
+    "rope": spec([f(1, 4, 2, 8), f(4, 4), f(4, 4)], grad=[0]),
+    # ---- rnn scans ----
+    "rnn_scan_simple": spec([f(2, 3, 4), f(2, 5), f(5, 4), f(5, 5),
+                             f(5), f(5)], grad=[0, 2, 3]),
+    "rnn_scan_gru": spec([f(2, 3, 4), f(2, 5), f(15, 4), f(15, 5),
+                          f(15), f(15)], grad=[0, 2, 3], sel=0),
+    "rnn_scan_lstm": spec([f(2, 3, 4), f(2, 5), f(2, 5), f(20, 4), f(20, 5),
+                           f(20), f(20)], grad=[0, 3, 4], sel=0),
+}
+
+# randomness ops: forward-shape check only, with an explicit PRNG key
+RANDOM_OPS = {
+    "dropout_impl": ([f(2, 3)], dict(p=0.5, mode="upscale_in_train")),
+    "alpha_dropout_impl": ([f(2, 3)], dict(p=0.5)),
+    "rrelu_impl": ([fneg(2, 3)], dict(lower=0.1, upper=0.3)),
+    "gumbel_softmax_impl": ([f(2, 4)], {}),
+}
+
+SKIP = {
+    "getitem": "internal indexing plumbing; exercised via Tensor.__getitem__",
+    "setitem": "internal indexing plumbing; exercised via Tensor.__setitem__",
+    "ctc_loss": "not yet implemented (VERDICT missing #8)",
+}
+
+
+def _make_args(sp, rng):
+    args, tensors = [], []
+    for item in sp["in_"]:
+        if isinstance(item, S):
+            args.append(item.value)
+        elif isinstance(item, list):
+            group = []
+            for sub in item:
+                arr = np.asarray(sub(rng))
+                t = Tensor(arr, stop_gradient=not np.issubdtype(
+                    arr.dtype, np.floating))
+                group.append(t)
+                tensors.append(t)
+            args.append(group)
+        else:
+            arr = np.asarray(item(rng))
+            t = Tensor(arr, stop_gradient=not np.issubdtype(
+                arr.dtype, np.floating))
+            args.append(t)
+            tensors.append(t)
+    return args, tensors
+
+
+def _flatten_outs(out):
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _loss_value(out, sel):
+    outs = _flatten_outs(out)
+    if sel is not None:
+        outs = [outs[sel]]
+    total = 0.0
+    for o in outs:
+        a = np.asarray(o.numpy() if isinstance(o, Tensor) else o)
+        if np.issubdtype(a.dtype, np.floating):
+            total += float(np.sum(a.astype(np.float64)))
+    return total
+
+
+SWEPT = sorted(set(SPECS) & set(OPS))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SWEPT)
+def test_op_forward_and_grad(name):
+    sp = SPECS[name]
+    if sp is None:
+        pytest.skip("placeholder")
+    rng = np.random.default_rng(0)
+    api = op_api(name)
+    args, tensors = _make_args(sp, rng)
+    out = api(*args, **sp["kw"])
+
+    # 1. finite float outputs
+    for o in _flatten_outs(out):
+        if isinstance(o, Tensor):
+            a = o.numpy()
+            if np.issubdtype(a.dtype, np.floating):
+                assert np.all(np.isfinite(a)), f"{name}: non-finite output"
+
+    # 2. jit parity: trace the same impl, compare leaves
+    if sp["jit"]:
+        import jax
+
+        impl = OPS[name].impl
+        kw = sp["kw"]
+
+        def closure(*vals):
+            rebuilt, k = [], 0
+            for a in args:
+                if isinstance(a, Tensor):
+                    rebuilt.append(vals[k]); k += 1
+                elif isinstance(a, list) and a and isinstance(a[0], Tensor):
+                    rebuilt.append([vals[k + i] for i in range(len(a))])
+                    k += len(a)
+                else:
+                    rebuilt.append(a)
+            return impl(*rebuilt, **kw)
+
+        jout = jax.jit(closure)(*[t.value for t in tensors])
+        eager_leaves = [np.asarray(o.numpy()) for o in _flatten_outs(out)
+                        if isinstance(o, Tensor)]
+        jit_leaves = [np.asarray(v) for v in _flatten_outs(jout)]
+        assert len(eager_leaves) == len(jit_leaves), f"{name}: arity mismatch"
+        for e, j in zip(eager_leaves, jit_leaves):
+            if np.issubdtype(e.dtype, np.floating):
+                np.testing.assert_allclose(e, j, rtol=1e-5, atol=1e-6,
+                                           err_msg=f"{name}: jit parity")
+            else:
+                assert np.array_equal(e, j), f"{name}: jit parity (exact)"
+
+    # 3. numeric grad vs tape
+    wrt = sp["grad"]
+    if not wrt:
+        return
+    float_tensors = [t for t in tensors if not t.stop_gradient]
+    args2, tensors2 = _make_args(sp, np.random.default_rng(0))
+    out2 = api(*args2, **sp["kw"])
+    outs2 = _flatten_outs(out2)
+    sel = sp["sel"]
+    picked = [outs2[sel]] if sel is not None else [
+        o for o in outs2 if isinstance(o, Tensor)
+        and np.issubdtype(o.numpy().dtype, np.floating)]
+    loss = None
+    for o in picked:
+        term = o.sum()
+        loss = term if loss is None else loss + term
+    loss.backward()
+    floats2 = [t for t in tensors2 if not t.stop_gradient]
+    assert len(floats2) == len(float_tensors)
+    eps = 1e-3
+    for i in wrt:
+        t = floats2[i]
+        assert t.grad is not None, f"{name}: no grad for float input {i}"
+        analytic = t.grad.numpy().astype(np.float64)
+        base = t.numpy().astype(np.float64)
+        numeric = np.zeros_like(base)
+        it = np.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            import jax.numpy as jnp
+
+            vals = {}
+            for sign in (+1, -1):
+                pert = base.copy()
+                pert[idx] += sign * eps
+                t._value = jnp.asarray(pert.astype(np.float32))
+                with __import__("paddle_tpu").autograd.tape.no_grad():
+                    o = api(*args2, **sp["kw"])
+                vals[sign] = _loss_value(o, sel)
+            t._value = jnp.asarray(base.astype(np.float32))
+            numeric[idx] = (vals[1] - vals[-1]) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=sp["rtol"], atol=sp["atol"],
+            err_msg=f"{name}: grad mismatch wrt float input {i}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(set(RANDOM_OPS) & set(OPS)))
+def test_random_op_forward(name):
+    import jax
+
+    makers, kw = RANDOM_OPS[name]
+    rng = np.random.default_rng(0)
+    arrs = [m(rng) for m in makers]
+    impl = OPS[name].impl
+    out = impl(arrs[0], jax.random.PRNGKey(0), **kw)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    assert np.all(np.isfinite(np.asarray(outs[0])))
+    assert np.asarray(outs[0]).shape == arrs[0].shape
+
+
+def test_sweep_coverage():
+    covered = (set(SPECS) | set(RANDOM_OPS) | set(SKIP)) & set(OPS)
+    missing = sorted(set(OPS) - covered)
+    frac = len(covered) / len(OPS)
+    assert frac >= 0.9, f"op sweep covers {frac:.0%}; missing: {missing}"
+    assert not missing, f"uncovered ops: {missing}"

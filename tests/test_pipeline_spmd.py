@@ -45,6 +45,7 @@ def test_pipeline_matches_sequential(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential(mesh):
     rng = np.random.default_rng(1)
     d, M, B = 4, 4, 2
@@ -69,6 +70,7 @@ def test_pipeline_grads_match_sequential(mesh):
                                    rtol=1e-4, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_end_to_end(mesh):
     """Full compiled train step: pipeline fwd + grad + sgd update."""
     rng = np.random.default_rng(2)

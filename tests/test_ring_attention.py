@@ -45,6 +45,7 @@ def test_ring_matches_full(mesh, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_full(mesh, causal):
     rng = np.random.default_rng(1)
@@ -58,6 +59,7 @@ def test_ulysses_matches_full(mesh, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_grads_match_full(mesh):
     rng = np.random.default_rng(2)
     B, S, H, D = 1, 64, 2, 8
@@ -76,6 +78,7 @@ def test_ring_grads_match_full(mesh):
                                    err_msg=f"d{n}")
 
 
+@pytest.mark.slow
 def test_ring_taped_eager(mesh):
     rng = np.random.default_rng(3)
     B, S, H, D = 1, 32, 2, 8
@@ -88,6 +91,7 @@ def test_ring_taped_eager(mesh):
     assert q.grad is not None and q.grad.shape == q.shape
 
 
+@pytest.mark.slow
 def test_ring_hybrid_tp_cp():
     """Review r3: heads stay mp-sharded inside the ring shard_map."""
     from paddle_tpu.parallel import ProcessMesh

@@ -14,6 +14,7 @@ def test_resnet_variants_forward():
     assert resnet50(num_classes=5)(x).shape == (1, 5)
 
 
+@pytest.mark.slow
 def test_mobilenet_vgg_lenet_forward():
     from paddle_tpu.vision.models import LeNet, mobilenet_v2, vgg11
     x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
@@ -34,6 +35,7 @@ def test_transforms_pipeline():
     assert float(out.numpy().max()) <= 1.0 + 1e-6
 
 
+@pytest.mark.slow
 def test_hapi_model_fit_evaluate_predict(tmp_path):
     from paddle_tpu.hapi import Model
     from paddle_tpu.io import TensorDataset
@@ -66,6 +68,7 @@ def test_hapi_model_fit_evaluate_predict(tmp_path):
         pred.numpy(), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_hapi_early_stopping():
     from paddle_tpu.hapi import EarlyStopping, Model
     from paddle_tpu.io import TensorDataset
@@ -118,6 +121,7 @@ def test_distribution_categorical_beta_gamma():
     assert abs(float(np.mean(sg.numpy())) - 1.5) < 0.1
 
 
+@pytest.mark.slow
 def test_fake_data_and_resnet_training_step():
     from paddle_tpu.hapi import Model
     from paddle_tpu.metric import Accuracy
