@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, _rope_tables
 from paddle_tpu.parallel.mesh import ProcessMesh
+from paddle_tpu.parallel.pipeline_1f1b import spmd_pipeline_1f1b
 from paddle_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
 
 __all__ = ["LlamaPipelineTrainer"]
@@ -81,7 +82,11 @@ class LlamaPipelineTrainer:
     may alias donated storage depending on placement)."""
 
     def __init__(self, model: LlamaForCausalLM, optimizer, mesh: ProcessMesh,
-                 n_micro: int = 2, pp_axis: str = "pp"):
+                 n_micro: int = 2, pp_axis: str = "pp",
+                 schedule: str = "1f1b"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = schedule
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -201,28 +206,86 @@ class LlamaPipelineTrainer:
         wd = getattr(opt, "_weight_decay", 0.0) or 0.0
         tie = cfg.tie_word_embeddings
 
+        lp_names = ("model.norm.weight",
+                    "model.embed_tokens.weight" if tie else "lm_head.weight")
+
+        def head_loss(lp, y, tgt):
+            # final norm + lm head + CE; shape-agnostic over leading dims —
+            # the single source of truth for BOTH schedules (runs inside the
+            # 1F1B loss seed at the last stage, and after the GPipe pipe)
+            w = lp["model.norm.weight"]
+            var = jnp.mean(jnp.square(y.astype(jnp.float32)), -1,
+                           keepdims=True)
+            h = (y.astype(jnp.float32) * jax.lax.rsqrt(
+                var + cfg.rms_norm_eps)).astype(y.dtype) * w
+            emb_or_head = lp["model.embed_tokens.weight" if tie
+                             else "lm_head.weight"]
+            head = emb_or_head.T if tie else emb_or_head
+            logits = (h @ head).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            # one-hot contraction, NOT take_along_axis: a gather along the
+            # mp-sharded vocab dim inside the partial-manual shard_map trips
+            # an XLA SPMD partitioner CHECK (PartitionGather + manual
+            # subgroups); the one-hot sum partitions as a plain reduction
+            onehot = jax.nn.one_hot(tgt, logits.shape[-1],
+                                    dtype=logits.dtype)
+            gold = jnp.sum(logits * onehot, axis=-1)
+            return jnp.mean(lse - gold)
+
         def loss_fn(stacked, outer, ids, labels):
             # ids: (M, B, S) micro-batched
             emb = outer["model.embed_tokens.weight"]
             h = emb[ids]                       # (M, B, S, H)
             h = spmd_pipeline(self._stage_fn, stacked, h, mesh, n_micro,
                               axis=pp_axis, partial_manual=True)
-            # final norm + head
-            w = outer["model.norm.weight"]
-            var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
-            h = (h.astype(jnp.float32) * jax.lax.rsqrt(
-                var + cfg.rms_norm_eps)).astype(h.dtype) * w
-            head = (emb.T if tie else outer["lm_head.weight"])
-            logits = h @ head                  # (M, B, S, V)
-            logits = logits.astype(jnp.float32)
-            lse = jax.nn.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(logits, labels[..., None],
-                                       axis=-1)[..., 0]
-            return jnp.mean(lse - gold)
+            return head_loss({n: outer[n] for n in lp_names}, h, labels)
+
+        def grads_1f1b(stacked, outer, ids, labels):
+            # every outer param must be covered by the manual grad assembly
+            # below — fail loudly instead of silently zero-filling a future
+            # non-layer parameter that the GPipe autodiff path would train
+            known = {"model.embed_tokens.weight", "model.norm.weight",
+                     "lm_head.weight"}
+            extra = set(outer) - known
+            if extra:
+                raise NotImplementedError(
+                    f"1F1B grad assembly does not cover outer params "
+                    f"{sorted(extra)}; use schedule='gpipe' or extend "
+                    "grads_1f1b")
+            emb = outer["model.embed_tokens.weight"]
+            # clean dp-sharded activation layout at the shard_map boundary:
+            # without the constraints the partial-manual group sharding of
+            # the pipe meets the vocab-sharded gather/scatter and trips an
+            # XLA SPMD partitioner CHECK (PartitionGather + manual subgroups)
+            dp_ax = "dp" if "dp" in self.mesh.dim_names else None
+            act_spec = NamedSharding(mesh.jax_mesh, P(None, dp_ax))
+            h0 = jax.lax.with_sharding_constraint(emb[ids], act_spec)
+            lp = {n: outer[n] for n in lp_names}
+            loss, gs, glp, gx = spmd_pipeline_1f1b(
+                self._stage_fn, head_loss, stacked, h0, labels, mesh,
+                n_micro, axis=pp_axis, loss_params=lp, return_x_grad=True,
+                partial_manual=True)
+            gx = jax.lax.with_sharding_constraint(gx, act_spec)
+            # chain the embedding lookup: dL/d emb from the input cotangent
+            demb = jnp.zeros_like(emb).at[ids].add(gx.astype(emb.dtype))
+            go = {n: jnp.zeros_like(v) for n, v in outer.items()}
+            go["model.norm.weight"] = glp["model.norm.weight"].astype(
+                outer["model.norm.weight"].dtype)
+            if tie:
+                go["model.embed_tokens.weight"] = (
+                    demb + glp["model.embed_tokens.weight"].astype(emb.dtype))
+            else:
+                go["model.embed_tokens.weight"] = demb
+                go["lm_head.weight"] = glp["lm_head.weight"].astype(
+                    outer["lm_head.weight"].dtype)
+            return loss, gs, go
 
         def step(stacked, outer, opt_stacked, opt_outer, lr, ids, labels):
-            loss, (gs, go) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-                stacked, outer, ids, labels)
+            if self.schedule == "1f1b":
+                loss, gs, go = grads_1f1b(stacked, outer, ids, labels)
+            else:
+                loss, (gs, go) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                    stacked, outer, ids, labels)
             # grad clip spans ALL params (global norm over stacked + outer),
             # matching ShardedTrainer/HybridParallelClipGrad semantics
             from paddle_tpu.parallel.train import _apply_grad_clip

@@ -48,15 +48,26 @@ def stack_stage_params(stage_states: Sequence[dict]) -> dict:
 
 def spmd_pipeline(stage_fn: Callable, stacked_params: dict, x,
                   mesh: ProcessMesh, n_micro: int, axis: str = "pp",
-                  checkpoint_ticks: bool = True, partial_manual: bool = False):
+                  checkpoint_ticks: bool = True, partial_manual: bool = False,
+                  virtual_chunks: int = 1):
     """Run `x` through S pipeline stages as one compiled SPMD program.
 
     stage_fn(params_slice, microbatch) -> microbatch (same shape/dtype);
     stacked_params[k] has leading dim S (stage axis, sharded over `axis`);
     x has leading dim M = n_micro (micro-batch axis, replicated).
 
+    With ``virtual_chunks = v > 1`` (interleaved VPP,
+    pipeline_parallel.py:987 analog) stacked_params[k] has leading dims
+    ``(v, S)`` — ``[j, r]`` holds global stage ``j*S + r`` — and the ring
+    is traversed v times, cutting the warmup bubble per chunk from
+    ``(S-1) * v``-deep to ``(S-1)``-deep stage computes.
+
     Returns the pipeline output with leading dim M.
     """
+    if virtual_chunks > 1:
+        return _spmd_pipeline_interleaved(
+            stage_fn, stacked_params, x, mesh, n_micro, axis,
+            checkpoint_ticks, partial_manual, virtual_chunks)
     S = mesh.dim_size(axis)
     lead = next(iter(stacked_params.values())).shape[0] if stacked_params else S
     if lead != S:
@@ -109,6 +120,85 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: dict, x,
     if partial_manual:
         # manual only over the pp ring; dp/mp/sep stay GSPMD-automatic so
         # hybrid tp/dp sharding inside a stage keeps working
+        kwargs["axis_names"] = {axis}
+    fn = shard_map(local, **kwargs)
+    return fn(stacked_params, x)
+
+
+def _spmd_pipeline_interleaved(stage_fn, stacked_params, x, mesh, n_micro,
+                               axis, checkpoint_ticks, partial_manual, v):
+    """Interleaved virtual-pipeline forward (Megatron VPP; reference
+    pipeline_parallel.py:987 ``interleave``): global stage ``l = j*S + r``
+    runs on rank ``l % S`` with local chunk ``j = l // S``, so each rank
+    touches every v-th layer block and micro-batches re-enter the ring v
+    times. One compiled SPMD loop of ``M + v*S - 1`` ticks; each tick a
+    rank runs (up to) v chunk computes, each cond-skipped when idle."""
+    S = mesh.dim_size(axis)
+    shapes = {k: p.shape for k, p in stacked_params.items()}
+    for k, shp in shapes.items():
+        if shp[0] != v or shp[1] != S:
+            raise ValueError(
+                f"virtual_chunks={v}: stacked param {k} must have leading "
+                f"dims (v, S) = ({v}, {S}), got {shp[:2]}")
+    M = x.shape[0]
+    if M != n_micro:
+        raise ValueError(f"x leading dim {M} != n_micro {n_micro}")
+    L = v * S
+    T = M + L - 1
+
+    param_specs = {k: P(None, axis) for k in stacked_params}
+
+    def local(params_loc, x_all):
+        r = jax.lax.axis_index(axis)
+        # params_loc[k]: (v, 1, ...) — this rank's v chunk slices
+        p_chunks = [{k: p[j, 0] for k, p in params_loc.items()}
+                    for j in range(v)]
+        zero = jnp.zeros_like(x_all[0])
+        outputs = jnp.zeros((M,) + x_all.shape[1:], x_all.dtype)
+        fs = [zero] * v  # per-chunk ring payload
+
+        compute = jax.checkpoint(stage_fn) if checkpoint_ticks else stage_fn
+
+        for t in range(T):
+            ys = []
+            for j in range(v):
+                # micro-batch at global stage j*S + r this tick
+                m = t - j * S - r
+                active = (m >= 0) & (m < M)
+                # chunk input: ring payload; rank 0 takes the wrapped
+                # payload of chunk j-1 (stage (j-1)*S + S-1 -> j*S); the
+                # j==0 wrap value is dead — global stage 0 injects x below
+                state_in = jnp.where(r == 0, fs[j - 1], fs[j])
+                inject = x_all[jnp.clip(m, 0, M - 1)]
+                state_in = jnp.where((r == 0) & (j == 0), inject, state_in)
+                if partial_manual:
+                    # masked, not cond: GSPMD inserts mp/dp collectives
+                    # inside branches and pp-divergent predicates deadlock
+                    # the mesh (see pipeline_1f1b.skip_idle)
+                    y = jnp.where(active, compute(p_chunks[j], state_in), zero)
+                else:
+                    y = jax.lax.cond(
+                        active,
+                        lambda s=state_in, pj=p_chunks[j]: compute(pj, s),
+                        lambda: zero)
+                ys.append(y)
+                # last global stage emits micro-batch m
+                if j == v - 1:
+                    mb = t - (L - 1)
+                    if 0 <= mb < M:
+                        emit = jnp.where(r == S - 1, y, jnp.zeros_like(y))
+                        outputs = outputs.at[mb].set(emit)
+            # one permute per chunk ring, all ranks, outside the conds
+            fs = [jax.lax.ppermute(
+                ys[j], axis, [(i, (i + 1) % S) for i in range(S)])
+                for j in range(v)]
+        outputs = jax.lax.psum(outputs, axis)
+        return outputs
+
+    kwargs = dict(mesh=mesh.jax_mesh,
+                  in_specs=({k: param_specs[k] for k in stacked_params}, P()),
+                  out_specs=P(), check_vma=False)
+    if partial_manual:
         kwargs["axis_names"] = {axis}
     fn = shard_map(local, **kwargs)
     return fn(stacked_params, x)

@@ -1,0 +1,181 @@
+"""1F1B + interleaved-VPP pipeline schedules (VERDICT round-2 item 4).
+
+Reference capability: fleet/meta_parallel/pipeline_parallel.py:459 (1F1B)
+and :987 (interleaved VPP)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import ProcessMesh
+from paddle_tpu.parallel.mesh import set_mesh
+from paddle_tpu.parallel.pipeline_1f1b import spmd_pipeline_1f1b
+from paddle_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
+
+
+@pytest.fixture
+def mesh():
+    m = ProcessMesh(shape=(4,), dim_names=("pp",))
+    yield m
+    set_mesh(None)
+
+
+def _stage_fn(params, x):
+    return x + jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _loss_fn(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+
+def _make_stages(n, d, rng):
+    return [{"w": jnp.asarray(rng.normal(size=(d, d)) * 0.1, jnp.float32),
+             "b": jnp.asarray(rng.normal(size=(d,)) * 0.1, jnp.float32)}
+            for _ in range(n)]
+
+
+def _sequential_loss(stacked, x, tgt, n_stages):
+    def total(stacked):
+        out = x
+        for s in range(n_stages):
+            st = {k: v[s] for k, v in stacked.items()}
+            out = jax.vmap(lambda mb: _stage_fn(st, mb))(out)
+        losses = jax.vmap(_loss_fn)(out, tgt)
+        return jnp.mean(losses)
+    return total
+
+
+def test_1f1b_loss_and_grads_match_sequential(mesh):
+    rng = np.random.default_rng(0)
+    d, M, B, S = 8, 6, 4, 4
+    stages = _make_stages(S, d, rng)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    loss, grads = spmd_pipeline_1f1b(_stage_fn, _loss_fn, stacked, x, tgt,
+                                     mesh, n_micro=M)
+
+    ref_total = _sequential_loss(stacked, x, tgt, S)
+    ref_loss = ref_total(stacked)
+    ref_grads = jax.grad(ref_total)(stacked)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_grads[k]),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"grad mismatch for {k}")
+
+
+def test_1f1b_under_jit(mesh):
+    """The 1F1B step must trace/compile (driver path: inside the train jit)."""
+    rng = np.random.default_rng(1)
+    d, M, B, S = 4, 4, 2, 4
+    stacked = stack_stage_params(_make_stages(S, d, rng))
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    @jax.jit
+    def step(stacked, x, tgt):
+        return spmd_pipeline_1f1b(_stage_fn, _loss_fn, stacked, x, tgt,
+                                  mesh, n_micro=M)
+
+    loss, grads = step(stacked, x, tgt)
+    ref = _sequential_loss(stacked, x, tgt, S)
+    np.testing.assert_allclose(float(loss), float(ref(stacked)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_fewer_ticks_than_gpipe_roundtrip():
+    """Bubble accounting: 1F1B runs M + 2S - 1 synchronization ticks where
+    the compiled-GPipe fwd+reversed-bwd runs 2(M + S - 1); for M >= 2 the
+    1F1B timeline is strictly shorter, and its in-flight residual window is
+    bounded by 2S micro-batches instead of growing with M."""
+    for S in (2, 4, 8):
+        for M in (2, 8, 32, 128):
+            t_1f1b = M + 2 * S - 1
+            t_gpipe = 2 * (M + S - 1)
+            assert t_1f1b < t_gpipe or M < 2
+            assert 2 * S < M + S - 1 or M <= S + 1  # window vs GPipe residuals
+
+
+@pytest.mark.slow
+def test_vpp_interleaved_matches_sequential(mesh):
+    """v=2 chunks over S=4 ranks = 8 global stages; parity + grads."""
+    rng = np.random.default_rng(2)
+    d, M, B, S, v = 6, 5, 3, 4, 2
+    stages = _make_stages(v * S, d, rng)
+    # [j, r] = global stage j*S + r
+    stacked = {k: jnp.stack([
+        jnp.stack([stages[j * S + r][k] for r in range(S)])
+        for j in range(v)]) for k in stages[0]}
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    out = spmd_pipeline(_stage_fn, stacked, x, mesh, n_micro=M,
+                        virtual_chunks=v)
+    ref = x
+    for st in stages:
+        ref = jax.vmap(lambda mb, st=st: _stage_fn(st, mb))(ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # gradients flow through the interleaved loop (XLA-reversed backward)
+    def loss(stacked):
+        y = spmd_pipeline(_stage_fn, stacked, x, mesh, n_micro=M,
+                          virtual_chunks=v)
+        return jnp.sum(y ** 2)
+
+    def ref_loss(stacked):
+        out = x
+        for l in range(v * S):
+            st = {k: v_[l // S, l % S] for k, v_ in stacked.items()}
+            out = jax.vmap(lambda mb, st=st: _stage_fn(st, mb))(out)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(stacked)
+    gr = jax.grad(ref_loss)(stacked)
+    for k in g:
+        np.testing.assert_allclose(np.asarray(g[k]), np.asarray(gr[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_loss_params_and_x_grad(mesh):
+    """Head weights inside the loss + input cotangents: everything an
+    embedding->pipe->head model needs to assemble full grads."""
+    rng = np.random.default_rng(3)
+    d, M, B, S = 6, 5, 3, 4
+    stacked = stack_stage_params(_make_stages(S, d, rng))
+    head = {"w": jnp.asarray(rng.normal(size=(d, d)) * 0.3, jnp.float32)}
+    x = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(M, B, d)), jnp.float32)
+
+    def loss_with_head(p, y, t):
+        return jnp.mean((y @ p["w"] - t) ** 2)
+
+    loss, grads, hgrads, xgrad = spmd_pipeline_1f1b(
+        _stage_fn, loss_with_head, stacked, x, tgt, mesh, n_micro=M,
+        loss_params=head, return_x_grad=True)
+
+    def ref_total(stacked, head, x):
+        out = x
+        for s in range(S):
+            st = {k: v[s] for k, v in stacked.items()}
+            out = jax.vmap(lambda mb: _stage_fn(st, mb))(out)
+        return jnp.mean(jax.vmap(
+            lambda y, t: loss_with_head(head, y, t))(out, tgt))
+
+    ref_loss = ref_total(stacked, head, x)
+    rg_s, rg_h, rg_x = jax.grad(ref_total, argnums=(0, 1, 2))(
+        stacked, head, x)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(grads[k]), np.asarray(rg_s[k]),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hgrads["w"]), np.asarray(rg_h["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(xgrad), np.asarray(rg_x),
+                               rtol=1e-4, atol=1e-5)
