@@ -400,12 +400,53 @@ def bench_ernie():
     return _emit("ernie_semiauto_tokens_per_sec", tps, "tokens/sec")
 
 
+def bench_decode():
+    """Greedy KV-cache decode tokens/sec on the flagship 134M Llama
+    (block_multi_head_attention capability analog)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                      num_hidden_layers=12, num_attention_heads=12,
+                      num_key_value_heads=12, max_position_embeddings=1024,
+                      dtype="bfloat16" if on_tpu else "float32"
+                      ) if on_tpu else LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
+    B, prompt_len = (8, 128) if on_tpu else (1, 8)
+    new_tokens = 128 if on_tpu else 8
+    dec = LlamaDecoder(model, max_len=prompt_len + new_tokens + 1)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (B, prompt_len))
+    dec.generate(prompt, max_new_tokens=new_tokens)  # compile prefill + scan
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = dec.generate(prompt, max_new_tokens=new_tokens)
+        best = min(best, time.perf_counter() - t0)
+    tps = B * new_tokens / best
+    print(f"decode: {best*1e3:.0f}ms for {new_tokens} tokens x B={B}",
+          file=sys.stderr)
+    return _emit("llama_110m_greedy_decode_tokens_per_sec", tps, "tokens/sec")
+
+
 CONFIGS = {
     "llama": bench_llama,
     "resnet50": bench_resnet50,
     "bert": bench_bert,
     "unet": bench_unet,
     "ernie": bench_ernie,
+    "decode": bench_decode,
 }
 
 

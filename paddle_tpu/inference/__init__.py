@@ -112,5 +112,26 @@ class Predictor:
         return True
 
 
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 max_len: int = 512, eos_token_id=None) -> np.ndarray:
+        """Greedy autoregressive decode with a compile-once KV cache
+        (block_multi_head_attention capability analog; see
+        inference/generate.py). Only causal-LM layers with a Llama-style
+        config are supported; the decoder is cached on the predictor so
+        repeated calls reuse the compiled prefill/step executables."""
+        from paddle_tpu.inference.generate import LlamaDecoder
+        dec = getattr(self, "_decoder", None)
+        if dec is None or dec.max_len < max_len:
+            dec = LlamaDecoder(self._layer, max_len=max_len)
+            self._decoder = dec
+        return dec.generate(input_ids, max_new_tokens=max_new_tokens,
+                            eos_token_id=eos_token_id)
+
+
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+from paddle_tpu.inference.aot import load_compiled, save_compiled  # noqa: E402,F401
+
+__all__ += ["save_compiled", "load_compiled"]

@@ -1,0 +1,47 @@
+"""AOT export/load of compiled executables (StableHLO bytes).
+
+Analog of the reference's save_inference_model → AnalysisPredictor flow
+(paddle/fluid/inference/api/analysis_predictor.h): the "IR program" here is
+jax.export's serialized StableHLO module. ``load_compiled`` rebuilds a
+callable WITHOUT re-tracing any Python — a fresh process never imports the
+model code, it just feeds the deserialized executable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax import export as _jexport
+
+__all__ = ["save_compiled", "load_compiled"]
+
+_MAGIC = b"PTPU-AOT1\n"
+
+
+def save_compiled(fn: Callable, example_args: Sequence, path: str,
+                  donate_argnums=()) -> None:
+    """Trace+lower ``fn`` at the example args' shapes/dtypes and write the
+    serialized StableHLO executable to ``path`` (save_inference_model
+    analog). The export is shape-polymorphism-free: static shapes are the
+    TPU deployment contract."""
+    exp = _jexport.export(jax.jit(fn, donate_argnums=donate_argnums))(
+        *example_args)
+    blob = exp.serialize()
+    # raw StableHLO bytes after the magic — NOT pickle: loading a model
+    # artifact must never execute arbitrary code from the file
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(bytes(blob))
+
+
+def load_compiled(path: str) -> Callable:
+    """Load an AOT-exported executable; returns a callable. No Python model
+    code runs — the deserialized module is invoked directly."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: not a paddle_tpu AOT export")
+        blob = f.read()
+    exp = _jexport.deserialize(bytearray(blob))
+    return lambda *args: exp.call(*args)

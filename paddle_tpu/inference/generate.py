@@ -1,0 +1,195 @@
+"""KV-cache autoregressive decoding for LlamaForCausalLM.
+
+Capability analog of the reference's decode stack —
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+(block-table KV cache attention) and the fused generation ops — in the
+TPU-native form: a PURE functional forward with a statically-shaped
+``(L, B, max_len, KV, D)`` cache, so prefill and every decode step are each
+ONE cached-compile XLA program (no recompiles across steps; static shapes
+are what the MXU wants). Block tables are unnecessary: XLA owns memory, and
+a padded dense cache + position mask is the layout it tiles best.
+
+Decode attention is a masked dense read of the cache — at sq=1 this is a
+bandwidth-bound matvec XLA fuses well; the Pallas flash kernel covers
+chunked prefill (bottom-right-aligned causal, sq != sk).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, _rope_tables
+
+__all__ = ["LlamaDecoder"]
+
+
+def _rope_at(x, pos, cfg):
+    """Rotate (B, S, H, D) by positions ``pos + [0..S)`` (traced offset);
+    shares the training-path frequency tables (_rope_tables) so decode can
+    never diverge from training if rope scaling changes."""
+    cos, sin = _rope_tables(x.shape[1], cfg.head_dim, cfg.rope_theta,
+                            x.dtype, offset=pos)
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    d2 = cfg.head_dim // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+
+
+def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
+    """One decoder block over h (B, S, H) writing K/V into the cache at
+    [pos, pos+S); attention reads the whole cache masked to < pos+S with
+    causal alignment to the bottom-right (query i attends to <= pos+i)."""
+    B, S, _ = h.shape
+    H, KV, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    pre = f"model.layers.{li}."
+
+    def rms(x, w):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x.astype(jnp.float32) * jax.lax.rsqrt(
+            var + cfg.rms_norm_eps)).astype(x.dtype) * w
+
+    x = rms(h, p[pre + "input_layernorm.weight"])
+    q = (x @ p[pre + "self_attn.q_proj.weight"]).reshape(B, S, H, D)
+    k = (x @ p[pre + "self_attn.k_proj.weight"]).reshape(B, S, KV, D)
+    v = (x @ p[pre + "self_attn.v_proj.weight"]).reshape(B, S, KV, D)
+    q = _rope_at(q, pos, cfg)
+    k = _rope_at(k, pos, cfg)
+
+    kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, pos, 0, 0))
+
+    rep = H // KV
+    kk, vv = kc[li], vc[li]                       # (B, max_len, KV, D)
+    if rep > 1:
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(
+        jnp.float32(D)).astype(q.dtype)
+    kpos = jnp.arange(max_len)[None, None, None, :]
+    qpos = pos + jnp.arange(S)[None, None, :, None]
+    mask = kpos <= qpos                           # bottom-right causal
+    scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+    attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", attn, vv).reshape(B, S, H * D)
+    h = h + out @ p[pre + "self_attn.o_proj.weight"]
+
+    x = rms(h, p[pre + "post_attention_layernorm.weight"])
+    a = jax.nn.silu(x @ p[pre + "mlp.gate_proj.weight"]) * (
+        x @ p[pre + "mlp.up_proj.weight"])
+    return h + a @ p[pre + "mlp.down_proj.weight"], kc, vc
+
+
+def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len):
+    """ids (B, S) -> logits of the LAST position (B, V), updated caches."""
+    h = p["model.embed_tokens.weight"][ids]
+    for li in range(cfg.num_hidden_layers):
+        h, kc, vc = _block_forward(p, cfg, li, h, kc, vc, pos, max_len)
+    var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
+    h = (h.astype(jnp.float32) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+         ).astype(h.dtype) * p["model.norm.weight"]
+    head = (p["model.embed_tokens.weight"].T if cfg.tie_word_embeddings
+            else p["lm_head.weight"])
+    logits = (h[:, -1] @ head).astype(jnp.float32)   # (B, V)
+    return logits, kc, vc
+
+
+class LlamaDecoder:
+    """Compile-once greedy/sampling decoder with a static KV cache.
+
+    Two executables total: ``prefill`` (fixed prompt length, pad to reuse)
+    and ``step`` (one token). Both are jit-cached by shape, so a
+    ``generate`` of N tokens runs N+1 device programs and zero retraces.
+    """
+
+    def __init__(self, model: LlamaForCausalLM, max_len: int = 512):
+        self.cfg = model.config
+        self.max_len = max_len
+        p = {}
+        for name, t in model.state_dict().items():
+            v = t.value
+            # nn.Linear keeps (in, out); the functional path uses x @ w
+            p[name] = v
+        self.params = p
+        cfg = self.cfg
+        self.trace_count = 0  # python side effect: bumps only on (re)trace
+
+        def prefill(p, ids, kc, vc):
+            self.trace_count += 1
+            return _forward_cached(p, cfg, ids, kc, vc, 0, max_len)
+
+        def step(p, ids, kc, vc, pos):
+            self.trace_count += 1
+            return _forward_cached(p, cfg, ids, kc, vc, pos, max_len)
+
+        def scan_decode(p, logits0, kc, vc, pos0, steps: int):
+            """The whole greedy loop as ONE device program (lax.scan): over
+            a network-tunneled chip, per-token host dispatches dominate —
+            this collapses N tokens to a single dispatch."""
+            self.trace_count += 1
+
+            def body(carry, _):
+                logits, kc, vc, pos = carry
+                tok = jnp.argmax(logits, -1).astype(jnp.int32)[:, None]
+                logits, kc, vc = _forward_cached(p, cfg, tok, kc, vc, pos,
+                                                 max_len)
+                return (logits, kc, vc, pos + 1), tok[:, 0]
+
+            (logits, _, _, _), toks = jax.lax.scan(
+                body, (logits0, kc, vc, pos0), None, length=steps)
+            last = jnp.argmax(logits, -1).astype(jnp.int32)
+            return jnp.concatenate([jnp.moveaxis(toks, 0, 1),
+                                    last[:, None]], axis=1)
+
+        self._prefill = jax.jit(prefill)
+        self._step = jax.jit(step)
+        self._scan_decode = jax.jit(scan_decode, static_argnames=("steps",))
+
+    def _empty_cache(self, B):
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        shape = (cfg.num_hidden_layers, B, self.max_len,
+                 cfg.num_key_value_heads, cfg.head_dim)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Greedy decode. input_ids: (B, S) ints. Returns (B, S + new)."""
+        ids = jnp.asarray(np.asarray(input_ids))
+        B, S = ids.shape
+        if S + max_new_tokens > self.max_len:
+            raise ValueError(f"prompt {S} + {max_new_tokens} new tokens "
+                             f"exceeds max_len {self.max_len}")
+        if max_new_tokens <= 0:
+            return np.asarray(ids)
+        kc, vc = self._empty_cache(B)
+        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        if eos_token_id is None:
+            # no early-exit condition -> run the whole loop on device
+            toks = self._scan_decode(self.params, logits, kc, vc,
+                                     jnp.asarray(S, jnp.int32),
+                                     steps=max_new_tokens - 1)
+            return np.asarray(jnp.concatenate(
+                [ids, toks.astype(ids.dtype)], axis=1))
+        out = [ids]
+        pos = S
+        done = np.zeros((B,), bool)
+        for i in range(max_new_tokens):
+            nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(
+                np.asarray(ids).dtype)
+            # rows already finished stay pinned to eos (per-row stopping;
+            # the reference pads post-eos positions the same way)
+            nxt = np.where(done, eos_token_id, nxt)
+            done |= nxt == eos_token_id
+            out.append(jnp.asarray(nxt[:, None]))
+            if bool(done.all()) or i == max_new_tokens - 1:
+                break  # no wasted forward for tokens nobody consumes
+            # pos as a device scalar: a Python int would bake into the trace
+            # and recompile every step
+            logits, kc, vc = self._step(self.params, jnp.asarray(nxt[:, None]),
+                                        kc, vc, jnp.asarray(pos, jnp.int32))
+            pos += 1
+        return np.asarray(jnp.concatenate(out, axis=1))

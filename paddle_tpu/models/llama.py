@@ -59,9 +59,11 @@ TINY_CONFIG = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
 LLAMA_7B_CONFIG = LlamaConfig()  # Llama-2-7B dims (BASELINE.md north star)
 
 
-def _rope_tables(seq_len: int, head_dim: int, theta: float, dtype):
+def _rope_tables(seq_len: int, head_dim: int, theta: float, dtype, offset=0):
+    """cos/sin tables for positions ``offset + [0..seq_len)``; offset may be
+    a traced scalar (KV-cache decode)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32)
+    t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)            # (S, D/2)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 

@@ -40,7 +40,7 @@ def _use_interpret() -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                num_k_blocks):
+                num_k_blocks, offset=0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -58,7 +58,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            # offset = sk - sq: bottom-right-aligned causal (KV-cache
+            # chunked prefill; query i sees keys <= i + offset)
+            mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, 0:1]                    # (BQ, 1)
@@ -76,7 +78,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
-        @pl.when(ki * block_k < (qi + 1) * block_q)
+        @pl.when(ki * block_k < (qi + 1) * block_q + offset)
         def _():
             _visible()
     else:
@@ -99,7 +101,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk)
+        block_k=block_k, num_k_blocks=nk, offset=sk - sq)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -135,7 +137,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_k, num_q_blocks):
+                *, scale, causal, block_q, block_k, num_q_blocks, offset=0):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -156,7 +158,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            # offset = sk - sq: bottom-right-aligned causal (KV-cache
+            # chunked prefill; query i sees keys <= i + offset)
+            mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                        # (BQ, BK) f32
         pc = p.astype(do.dtype)
@@ -172,7 +176,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when((qi + 1) * block_q > ki * block_k)
+        @pl.when((qi + 1) * block_q + offset > ki * block_k)
         def _():
             _visible()
     else:
@@ -186,7 +190,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, dq_scr,
-               *, scale, causal, block_q, block_k, num_k_blocks):
+               *, scale, causal, block_q, block_k, num_k_blocks, offset=0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -206,7 +210,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            # offset = sk - sq: bottom-right-aligned causal (KV-cache
+            # chunked prefill; query i sees keys <= i + offset)
+            mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -216,7 +222,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(ki * block_k < (qi + 1) * block_q)
+        @pl.when(ki * block_k < (qi + 1) * block_q + offset)
         def _():
             _visible()
     else:
@@ -237,7 +243,8 @@ def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          offset=sk - sq),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -257,7 +264,8 @@ def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          offset=sk - sq),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -355,11 +363,10 @@ def flash_attention_fn(q, k, v, causal: bool = False, scale=None,
     if sq % block_q or sk % block_k:
         raise ValueError(f"flash_attention: seq ({sq},{sk}) not divisible by "
                          f"blocks ({block_q},{block_k})")
-    if causal and sq != sk:
-        # the kernel's mask is top-left aligned; paddle causal semantics for
-        # sq != sk (KV-cache decode chunks) are bottom-right (tril(k=sk-sq))
-        raise ValueError("flash_attention: causal with sq != sk unsupported; "
-                         "use the sdpa reference path")
+    if causal and sq > sk:
+        # queries with no visible keys (bottom-right alignment needs
+        # sk >= sq for every query to see at least one key)
+        raise ValueError("flash_attention: causal requires sk >= sq")
     if k.shape[2] != h:
         raise ValueError("flash_attention: repeat kv heads before the kernel")
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)

@@ -107,23 +107,36 @@ def test_ring_hybrid_tp_cp():
                                rtol=2e-4, atol=2e-4)
 
 
-def test_flash_rejects_causal_cross_lengths():
-    """Review r3: sq != sk causal must fall back (mask alignment)."""
+def test_flash_causal_cross_lengths_bottom_right():
+    """sq != sk causal: bottom-right-aligned mask (KV-cache chunked
+    prefill; round-2 VERDICT item 8), forward AND gradients."""
     from paddle_tpu.ops.pallas.flash_attention import flash_attention_fn
     rng = np.random.default_rng(5)
-    q = jnp.asarray(rng.normal(size=(1, 64, 2, 32)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(1, 128, 2, 32)), jnp.float32)
-    with pytest.raises(ValueError, match="sq != sk"):
-        flash_attention_fn(q, k, k, causal=True, block_q=64, block_k=64)
-    # dispatcher silently falls back to the correct reference path
-    import paddle_tpu.nn.functional as F
-    qq = paddle.to_tensor(np.asarray(q))
-    kk = paddle.to_tensor(np.asarray(k))
-    out = F.scaled_dot_product_attention(qq, kk, kk, is_causal=True)
-    d = 32
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-    mask = jnp.tril(jnp.ones((64, 128), bool), k=128 - 64)
-    s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, -1)
-    ref = jnp.einsum("bhqk,bkhd->bqhd", p, k)
-    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    sq, sk, d = 64, 128, 32
+    q = jnp.asarray(rng.normal(size=(1, sq, 2, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, sk, 2, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, sk, 2, d)), jnp.float32)
+
+    def ref_fn(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+    out = flash_attention_fn(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = ref_fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention_fn(q, k, v, causal=True, block_q=32, block_k=32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+    # sq > sk stays rejected (queries with no visible keys)
+    with pytest.raises(ValueError, match="sk >= sq"):
+        flash_attention_fn(k, q, q, causal=True, block_q=32, block_k=32)

@@ -1,0 +1,114 @@
+"""ZeRO-3 must actually shard parameters — evidence, not docstrings
+(VERDICT round-2 item 7; reference group_sharded_stage3.py:85).
+
+Three independent witnesses on the 8-CPU mesh:
+1. per-device addressable shard shapes are 1/N of the full param,
+2. per-device live parameter bytes are ~1/N of the total (a model whose
+   full params would blow a per-shard budget still fits),
+3. the compiled HLO contains the all-gather (param reconstruction) and
+   reduce-scatter/all-reduce (grad) collectives XLA is claimed to emit.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.sharding import group_sharded_parallel, zero_param_plan
+from paddle_tpu.parallel import init_mesh
+from paddle_tpu.parallel.train import ShardedTrainer
+
+
+class _MLP(nn.Layer):
+    def __init__(self, d=64, depth=3):
+        super().__init__()
+        self.layers = nn.LayerList(
+            [nn.Linear(d, d) for _ in range(depth)])
+        self.head = nn.Linear(d, 8)
+
+    def forward(self, x):
+        for l in self.layers:
+            x = F.relu(l(x))
+        return self.head(x)
+
+
+def _bytes_per_device(params):
+    """Max over devices of summed addressable param-shard bytes."""
+    per_dev = {}
+    for t in params:
+        for s in t._value.addressable_shards:
+            b = int(np.prod(s.data.shape)) * s.data.dtype.itemsize
+            per_dev[s.device] = per_dev.get(s.device, 0) + b
+    return max(per_dev.values())
+
+
+def _setup(stage):
+    model = _MLP()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    if stage:
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}[stage]
+        group_sharded_parallel(model, opt, level=level)
+    mesh = init_mesh((8,), ("dp",))
+    plan = zero_param_plan(model, mesh, stage=stage or 0)
+    trainer = ShardedTrainer(model, opt, lambda m, x, y: F.cross_entropy(m(x), y),
+                             mesh, plan)
+    return model, trainer, mesh
+
+
+def test_stage3_params_actually_sharded_per_device():
+    model, trainer, mesh = _setup(stage=3)
+    n = 8
+    full_bytes = sum(p.size * 4 for p in model.parameters())
+    shard_bytes = _bytes_per_device(model.parameters())
+    # every weight matrix (64x64, 64x8) shards dim0=64 over 8 -> 1/8 per
+    # device; biases (64,) shard too. Allow slack for any unsharded stragglers
+    assert shard_bytes <= full_bytes // (n // 2), (shard_bytes, full_bytes)
+    for name, p in model.named_parameters():
+        shapes = {tuple(s.data.shape) for s in p._value.addressable_shards}
+        full = tuple(p.shape)
+        assert shapes != {full}, f"{name} is replicated under stage 3"
+
+
+def test_stage0_params_replicated_baseline():
+    model, trainer, mesh = _setup(stage=0)
+    full_bytes = sum(p.size * 4 for p in model.parameters())
+    # replicated: every device holds the full copy
+    assert _bytes_per_device(model.parameters()) == full_bytes
+
+
+def test_stage3_compiled_hlo_has_gather_and_grad_collectives():
+    model, trainer, mesh = _setup(stage=3)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 64)).astype(np.float32)
+    y = rng.integers(0, 8, (16,))
+    with mesh:
+        lowered = trainer.compile_lowered((x.shape, jnp.float32),
+                                          (y.shape, jnp.int32))
+    txt = lowered.compile().as_text()
+    assert "all-gather" in txt, "stage 3 step must all-gather params"
+    assert ("reduce-scatter" in txt) or ("all-reduce" in txt), \
+        "stage 3 step must reduce gradients"
+
+
+def test_stage3_trains_and_matches_stage0_losses():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 64)).astype(np.float32)
+    y = rng.integers(0, 8, (16,))
+
+    def run(stage, seed=7):
+        paddle.seed(seed)
+        model, trainer, mesh = _setup(stage)
+        with mesh:
+            return [float(np.asarray(trainer.train_step(x, y).value))
+                    for _ in range(4)]
+
+    l3 = run(3)
+    l0 = run(0)
+    assert all(np.isfinite(l3))
+    np.testing.assert_allclose(l3, l0, rtol=2e-4, atol=2e-5)
+    assert l3[-1] < l3[0]
