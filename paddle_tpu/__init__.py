@@ -50,7 +50,8 @@ def __getattr__(name):
     if name in ("distributed", "profiler", "vision", "incubate", "models",
                 "static", "hapi", "device", "distribution", "sparse",
                 "quantization", "text", "audio", "fft", "signal", "onnx",
-                "linalg", "geometric", "hub", "inference", "native"):
+                "linalg", "geometric", "hub", "inference", "native",
+                "cost_model"):
         mod = _lazy(name)
         globals()[name] = mod
         return mod

@@ -17,7 +17,14 @@ from paddle_tpu.framework.device import (  # noqa: F401
     is_compiled_with_tpu, set_device, synchronize,
 )
 
-__all__ = ["set_device", "get_device", "device_count", "synchronize",
+from paddle_tpu.framework.monitor import (  # noqa: F401
+    device_memory_stats, max_memory_allocated, memory_allocated,
+    memory_reserved,
+)
+
+__all__ = ["memory_allocated", "max_memory_allocated", "memory_reserved",
+           "device_memory_stats",
+           "set_device", "get_device", "device_count", "synchronize",
            "get_available_device", "get_available_custom_device",
            "is_compiled_with_cuda", "is_compiled_with_rocm",
            "is_compiled_with_xpu", "is_compiled_with_tpu", "cuda", "tpu",

@@ -51,7 +51,7 @@ def get_mesh_or_init():
 
 def __getattr__(name):
     import importlib
-    if name in ("checkpoint", "launch", "pipeline", "auto_parallel"):
+    if name in ("checkpoint", "launch", "pipeline", "auto_parallel", "rpc"):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod
         return mod

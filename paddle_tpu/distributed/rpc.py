@@ -1,0 +1,223 @@
+"""Simple RPC between workers (paddle.distributed.rpc analog).
+
+Redesign of the reference's RPC package
+(paddle/fluid/distributed/rpc/rpc_agent.cc + python/paddle/distributed/rpc)
+on top of the native TCPStore control plane instead of brpc: requests are
+densely-numbered store keys (``rpc/req/{dst}/{seq}``), every worker runs a
+daemon that blocks on its next sequence number, results come back on
+``rpc/res/{src}/{seq}``. Fine for control-plane traffic (the reference's
+stated scope); bulk tensors ride the XLA collectives, not RPC.
+
+Security note (same trust model as the reference): payloads are pickled —
+RPC peers must be the trusted training cluster, never untrusted input.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from paddle_tpu.native.tcp_store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_current_worker_info", "get_all_worker_info",
+           "WorkerInfo"]
+
+_DEFAULT_TIMEOUT = 30.0
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+
+
+class Future:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def _set(self, ok: bool, payload):
+        if ok:
+            self._result = payload
+        else:
+            self._exc = payload
+        self._ev.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout if timeout is not None
+                             else _DEFAULT_TIMEOUT):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class RpcAgent:
+    """One worker's RPC endpoint. Module-level init_rpc manages a process
+    singleton; tests may run several agents in one process."""
+
+    def __init__(self, name: str, rank: int, world_size: int,
+                 host: str = "127.0.0.1", port: int = 0,
+                 is_master: Optional[bool] = None):
+        # port=0: the master picks a free port (TCPStore default); workers
+        # must pass the master's advertised host/port
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = TCPStore(host=host, port=port,
+                              is_master=(rank == 0 if is_master is None
+                                         else is_master),
+                              world_size=world_size)
+        self.store.set(f"rpc/worker/{rank}", name.encode())
+        self._served = 0
+        self._next_reply: Dict[int, Future] = {}
+        self._seq_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._server = threading.Thread(target=self._serve, daemon=True)
+        self._server.start()
+        self._replier = threading.Thread(target=self._collect, daemon=True)
+        self._replier.start()
+        self._sent = 0
+
+    # -- worker info -------------------------------------------------------
+    def worker_info(self, name_or_rank) -> WorkerInfo:
+        if isinstance(name_or_rank, int):
+            nm = self.store.get(f"rpc/worker/{name_or_rank}").decode()
+            return WorkerInfo(nm, name_or_rank)
+        for r in range(self.world_size):
+            try:
+                nm = self.store.get(f"rpc/worker/{r}").decode()
+            except Exception:
+                continue
+            if nm == name_or_rank:
+                return WorkerInfo(nm, r)
+        raise ValueError(f"unknown rpc worker {name_or_rank!r}")
+
+    def all_worker_info(self):
+        return [self.worker_info(r) for r in range(self.world_size)]
+
+    # -- client ------------------------------------------------------------
+    def call(self, to, fn: Callable, args=(), kwargs=None,
+             timeout: float = _DEFAULT_TIMEOUT) -> Future:
+        dst = self.worker_info(to).rank if not isinstance(to, int) else to
+        fut = Future()
+        with self._seq_lock:
+            seq = self.store.add(f"rpc/cnt/{dst}", 1)
+            self._next_reply[(dst, seq)] = fut  # noqa: consumed by _collect
+        payload = pickle.dumps((self.rank, seq, fn, args, kwargs or {}))
+        self.store.set(f"rpc/req/{dst}/{seq}", payload)
+        return fut
+
+    def _collect(self):
+        """Wait for replies addressed to this rank, in arrival order."""
+        seen = 0
+        while not self._stop.is_set():
+            try:
+                raw = self.store.wait(f"rpc/res/{self.rank}/{seen + 1}",
+                                      timeout=0.25)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self._stop.is_set():
+                    return
+                continue
+            seen += 1
+            dst, seq, ok, payload = pickle.loads(raw)
+            fut = self._next_reply.pop((dst, seq), None)
+            if fut is not None:
+                fut._set(ok, payload)
+
+    # -- server ------------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            nxt = self._served + 1
+            try:
+                raw = self.store.wait(f"rpc/req/{self.rank}/{nxt}",
+                                      timeout=0.25)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self._stop.is_set():
+                    return
+                continue
+            self._served = nxt
+            src, seq, fn, args, kwargs = pickle.loads(raw)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back to the caller
+                result = (False, e)
+            try:
+                payload = pickle.dumps((self.rank, seq) + result)
+            except Exception as e:  # unpicklable result/exception: degrade
+                payload = pickle.dumps(
+                    (self.rank, seq, False,
+                     RuntimeError(f"rpc result not picklable: {e}")))
+            # reply stream is indexed by the CALLER's arrival order
+            ridx = self.store.add(f"rpc/rescnt/{src}", 1)
+            self.store.set(f"rpc/res/{src}/{ridx}", payload)
+
+    def shutdown(self):
+        self._stop.set()
+        self._server.join(timeout=2)
+        self._replier.join(timeout=2)
+
+
+_agent: Optional[RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """python/paddle/distributed/rpc/rpc.py:init_rpc analog."""
+    global _agent
+    import os
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    host, port = "127.0.0.1", 0
+    if master_endpoint:
+        host, port = master_endpoint.rsplit(":", 1)
+        port = int(port)
+    _agent = RpcAgent(name, rank, world_size, host=host, port=port)
+
+
+def _require_agent() -> RpcAgent:
+    if _agent is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to, fn: Callable, args=(), kwargs=None,
+             timeout: float = _DEFAULT_TIMEOUT):
+    return _require_agent().call(to, fn, args, kwargs,
+                                 timeout).wait(timeout)
+
+
+def rpc_async(to, fn: Callable, args=(), kwargs=None,
+              timeout: float = _DEFAULT_TIMEOUT) -> Future:
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    a = _require_agent()
+    return WorkerInfo(a.name, a.rank)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent().worker_info(name)
+
+
+def get_all_worker_info():
+    return _require_agent().all_worker_info()
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        _agent = None

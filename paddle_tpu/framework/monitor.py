@@ -1,0 +1,95 @@
+"""Runtime counters + device memory statistics.
+
+Analog of the reference's monitor registry
+(paddle/fluid/platform/monitor.cc STAT_INT64 / StatRegistry) and the memory
+stats API (paddle/fluid/memory/stats.h memory_allocated /
+max_memory_allocated): host-side counters are a thread-safe registry;
+device memory numbers come straight from the PJRT runtime
+(``device.memory_stats()``) since XLA owns the allocator.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["stat_add", "stat_get", "stat_reset", "stat_values",
+           "memory_allocated", "max_memory_allocated", "memory_reserved",
+           "device_memory_stats"]
+
+
+class _StatRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+
+    def add(self, name: str, value: int = 1) -> int:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + int(value)
+            return self._stats[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+
+_registry = _StatRegistry()
+
+stat_add = _registry.add
+stat_get = _registry.get
+stat_reset = _registry.reset
+stat_values = _registry.snapshot
+
+
+def _device(device_id: Optional[int]):
+    import jax
+    devs = jax.local_devices()
+    return devs[device_id or 0]
+
+
+def device_memory_stats(device_id: Optional[int] = None) -> dict:
+    """Raw PJRT memory stats dict ({} when the backend exposes none —
+    notably the CPU backend)."""
+    stats = _device(device_id).memory_stats()
+    return dict(stats) if stats else {}
+
+
+def _live_bytes_fallback() -> int:
+    import jax
+    return sum(v.nbytes for v in jax.live_arrays())
+
+
+def memory_allocated(device_id: Optional[int] = None) -> int:
+    """Bytes currently allocated on the device (memory/stats.h
+    memory_allocated analog)."""
+    s = device_memory_stats(device_id)
+    if "bytes_in_use" in s:
+        return int(s["bytes_in_use"])
+    return _live_bytes_fallback()
+
+
+def max_memory_allocated(device_id: Optional[int] = None) -> int:
+    s = device_memory_stats(device_id)
+    if "peak_bytes_in_use" in s:
+        return int(s["peak_bytes_in_use"])
+    return _live_bytes_fallback()
+
+
+def memory_reserved(device_id: Optional[int] = None) -> int:
+    s = device_memory_stats(device_id)
+    # bytes_limit would report pool CAPACITY, not reservations — fall back
+    # to allocated instead
+    if "bytes_reserved" in s:
+        return int(s["bytes_reserved"])
+    return memory_allocated(device_id)

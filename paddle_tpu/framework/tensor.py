@@ -27,7 +27,7 @@ class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "_grad", "_grad_node", "_out_index",
         "name", "persistable", "_placements", "_process_mesh", "_hooks",
-        "__weakref__",
+        "_dist_pad", "__weakref__",
     )
 
     # make numpy prefer our __r*__ ops over elementwise np ops
@@ -48,6 +48,9 @@ class Tensor:
         self._placements = None
         self._process_mesh = None
         self._hooks = None  # leaf gradient hooks (register_hook)
+        # uneven dist tensors: physical value is tile-padded; this records
+        # the LOGICAL global shape (pad-and-mask uneven sharding support)
+        self._dist_pad = None
 
     # -- raw value access ---------------------------------------------------
     @property
@@ -56,6 +59,8 @@ class Tensor:
 
     @property
     def shape(self):
+        if self._dist_pad is not None:
+            return tuple(self._dist_pad)
         return tuple(self._value.shape)
 
     @property
@@ -64,7 +69,7 @@ class Tensor:
 
     @property
     def size(self):
-        return int(np.prod(self._value.shape)) if self._value.shape else 1
+        return int(np.prod(self.shape)) if self.shape else 1
 
     @property
     def dtype(self):
@@ -81,7 +86,16 @@ class Tensor:
         return current_place()
 
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._value)
+        return np.asarray(self._logical_value())
+
+    def _logical_value(self):
+        """The unpadded (logical) value; identical to ``_value`` except for
+        uneven-sharded dist tensors, whose physical storage is tile-padded
+        (gathers the pad off — the cost of computing on an uneven view)."""
+        if self._dist_pad is None:
+            return self._value
+        idx = tuple(slice(0, s) for s in self._dist_pad)
+        return self._value[idx]
 
     def __array__(self, dtype=None):
         a = self.numpy()
@@ -114,10 +128,24 @@ class Tensor:
     def _accumulate_grad(self, g_value) -> None:
         """Leaf gradient accumulation (GradNodeAccumulation analog,
         paddle/fluid/eager/accumulation/accumulation_node.h)."""
+        if isinstance(g_value, Tensor):
+            g_value = g_value._logical_value()
+        if self._dist_pad is not None and tuple(
+                jnp.shape(g_value)) == tuple(self._dist_pad):
+            # uneven-sharded param: store the grad PADDED like the param's
+            # physical buffer so optimizer updates are shape-consistent
+            # (pad rows get zero grads and therefore never change)
+            pads = [(0, p - l) for p, l in zip(self._value.shape,
+                                               self._dist_pad)]
+            g_value = jnp.pad(g_value, pads)
+            if hasattr(self._value, "sharding"):
+                g_value = jax.device_put(g_value, self._value.sharding)
         if self._grad is None:
             self._grad = Tensor(g_value, stop_gradient=True)
         else:
             self._grad = Tensor(self._grad._value + g_value, stop_gradient=True)
+        if self._dist_pad is not None:
+            self._grad._dist_pad = self._dist_pad
 
     def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
         from paddle_tpu.autograd import tape
@@ -131,6 +159,9 @@ class Tensor:
 
     def detach(self) -> "Tensor":
         t = Tensor(self._value, stop_gradient=True, name=self.name)
+        t._placements = self._placements
+        t._process_mesh = self._process_mesh
+        t._dist_pad = self._dist_pad
         return t
 
     def clone(self) -> "Tensor":

@@ -1017,10 +1017,81 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     return _reduce_loss(loss, reduction)
 
 
-@register_op("ctc_loss", differentiable=False)
+@register_op("ctc_loss",
+             ref="paddle/phi/kernels/impl/warpctc_kernel_impl.h (warpctc) "
+                 "-> alpha-recursion lax.scan")
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean"):
-    raise NotImplementedError("ctc_loss lands with the audio domain ops")
+             reduction="mean", norm_by_times=False):
+    """CTC loss via the standard alpha (forward) recursion, batched and
+    scanned over time — differentiable through jax autodiff (no separate
+    beta/gradient kernel needed, unlike warpctc).
+
+    log_probs: (T, B, C) log-softmax outputs; labels: (B, L) int padded;
+    input_lengths/label_lengths: (B,).
+    """
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.float32(-1e30)
+    lp = log_probs.astype(jnp.float32)
+    labels = labels.astype(jnp.int32)
+    input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank  (B, S)
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(S)[None, :]
+    # skip-transition allowed where ext[s] != ext[s-2] and ext[s] != blank
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], 1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+    valid = pos < (2 * label_lengths[:, None] + 1)
+
+    def emit(t_lp, s_idx):
+        # log prob of emitting ext symbol at each position: (B, S)
+        return jnp.take_along_axis(t_lp, s_idx, axis=1)
+
+    a0 = jnp.full((B, S), neg_inf)
+    a0 = a0.at[:, 0].set(lp[0, :, blank])
+    first_lab = jnp.where(label_lengths > 0,
+                          jnp.take_along_axis(
+                              lp[0], ext[:, 1:2], axis=1)[:, 0], neg_inf)
+    a0 = a0.at[:, 1].set(first_lab)
+    a0 = jnp.where(valid, a0, neg_inf)
+
+    def step(alpha, t_lp):
+        shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+        shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        new = merged + emit(t_lp, ext)
+        return jnp.where(valid, new, neg_inf), new
+
+    _, alphas = lax.scan(step, a0, lp[1:])          # (T-1, B, S)
+    alphas = jnp.concatenate([a0[None], alphas], 0)  # (T, B, S)
+
+    # per-sample loss: -logadd(alpha[T_b-1, last], alpha[T_b-1, last-1])
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    last = jnp.take_along_axis(
+        alphas, t_idx[None, :, None], axis=0)[0]     # (B, S)
+    end = 2 * label_lengths                          # blank after last label
+    a_end = jnp.take_along_axis(last, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(last, jnp.maximum(end - 1, 0)[:, None],
+                            axis=1)[:, 0], neg_inf)
+    nll = -jnp.logaddexp(a_end, a_end1)
+    if norm_by_times:
+        nll = nll / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # paddle semantics: per-sample loss / label_length, then mean
+        return jnp.mean(nll / jnp.maximum(
+            label_lengths.astype(jnp.float32), 1.0))
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
 
 
 # ---------------------------------------------------------------------------

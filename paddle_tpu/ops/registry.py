@@ -155,8 +155,11 @@ def _check_nan_inf(opdef: OpDef, vals) -> None:
 
 def apply_op(opdef: OpDef, args: Sequence[Any], kwargs: Dict[str, Any]):
     """Eager dispatch path (the matmul call-stack analog, SURVEY §3.1)."""
+    from paddle_tpu.framework.monitor import stat_add
+    stat_add("STAT_eager_ops_dispatched")
     # unwrap any Tensor passed via kwargs (treated as non-differentiable attr)
-    kwargs = {k: (v.value if isinstance(v, Tensor) else v) for k, v in kwargs.items()}
+    kwargs = {k: (v._logical_value() if isinstance(v, Tensor) else v)
+              for k, v in kwargs.items()}
     template, tensors = _scan_args(args)
 
     needs_grad = (
@@ -165,7 +168,7 @@ def apply_op(opdef: OpDef, args: Sequence[Any], kwargs: Dict[str, Any]):
         and any(not t.stop_gradient for t in tensors)
     )
 
-    values = [t._value for t in tensors]
+    values = [t._logical_value() for t in tensors]
 
     # AMP auto-cast insertion (paddle/fluid/eager/amp_auto_cast.h analog)
     from paddle_tpu.amp.auto_cast import amp_dtype_for_op

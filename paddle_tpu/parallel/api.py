@@ -106,13 +106,14 @@ def shard_tensor(data, mesh: Optional[ProcessMesh] = None,
     was_param = isinstance(data, Parameter)
     if isinstance(data, Tensor):
         sg = data.stop_gradient if stop_gradient is None else stop_gradient
-        value = data._value
+        value = data._logical_value()  # never treat a source pad as data
         name = data.name
     else:
         sg = True if stop_gradient is None else stop_gradient
         value = jnp.asarray(data, dtype=dtype)
         name = None
     sharding = named_sharding(mesh, placements, ndim=jnp.ndim(value))
+    value, logical = _pad_for_uneven(value, mesh, placements)
     value = jax.device_put(value, sharding)
     if was_param:
         out = Parameter(value, name=name, trainable=not sg)
@@ -120,7 +121,43 @@ def shard_tensor(data, mesh: Optional[ProcessMesh] = None,
         out = Tensor(value, stop_gradient=sg, name=name)
     out._placements = list(placements)
     out._process_mesh = mesh
+    out._dist_pad = logical
     return out
+
+
+def _uneven_logical(shape, mesh: ProcessMesh, placements):
+    """The logical shape when `placements` shard `shape` unevenly, else None."""
+    counts = {}
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            counts[p.dim] = counts.get(p.dim, 1) * mesh.shape[mesh_dim]
+    if any(shape[d] % n for d, n in counts.items()):
+        return tuple(shape)
+    return None
+
+
+def _pad_for_uneven(value, mesh: ProcessMesh, placements):
+    """Pad-and-mask uneven shards (reference reshard/ uneven handling):
+    jax.Array storage requires tile-divisible dims, so non-divisible Shard
+    dims are zero-padded up to ``ceil(size/n)*n``. Returns (padded value,
+    logical shape or None). The logical view is restored by
+    Tensor._logical_value / unshard."""
+    shape = list(jnp.shape(value))
+    counts = {}
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            counts[p.dim] = counts.get(p.dim, 1) * mesh.shape[mesh_dim]
+    pads = [(0, 0)] * len(shape)
+    uneven = False
+    for dim, n in counts.items():  # dims sharded by several axes need
+        rem = shape[dim] % n       # divisibility by the PRODUCT
+        if rem:
+            pads[dim] = (0, n - rem)
+            uneven = True
+    if not uneven:
+        return value, None
+    logical = tuple(shape)
+    return jnp.pad(value, pads), logical
 
 
 def _materialize_partial(t: Tensor, mesh: ProcessMesh):
@@ -161,18 +198,28 @@ def reshard(x: Tensor, mesh: Optional[ProcessMesh] = None,
     if any(isinstance(p, Partial) for p in placements):
         raise ValueError("reshard target may not be Partial")
     sharding = named_sharding(mesh, placements, ndim=x.ndim)
+    logical = _uneven_logical(x.shape, mesh, placements)
     # run as a taped op so backward reaches x (device_put is differentiable;
-    # its transpose moves the cotangent back, i.e. the reverse collective)
+    # its transpose moves the cotangent back, i.e. the reverse collective).
+    # apply_op feeds the LOGICAL value, and padding happens inside the op,
+    # so uneven leaves keep their gradients (the pad's transpose is a slice)
     from paddle_tpu.ops.registry import OpDef, apply_op
     src = x
     if value is not x._value:  # partial was materialized outside the tape
+        if x._dist_pad is not None:
+            value = value[tuple(slice(0, s) for s in x._dist_pad)]
         src = Tensor(value, stop_gradient=x.stop_gradient, name=x.name)
         src._grad_node = x._grad_node
         src._out_index = x._out_index
-    opdef = OpDef("reshard", lambda v: jax.device_put(v, sharding))
-    out = apply_op(opdef, (src,), {})
+
+    def impl(v):
+        pv, _ = _pad_for_uneven(v, mesh, placements)
+        return jax.device_put(pv, sharding)
+
+    out = apply_op(OpDef("reshard", impl), (src,), {})
     out._placements = list(placements)
     out._process_mesh = mesh
+    out._dist_pad = logical
     return out
 
 
@@ -185,16 +232,28 @@ def unshard(x: Tensor) -> Tensor:
 
 
 def local_shape(global_shape: Sequence[int], mesh: ProcessMesh,
-                placements: Sequence[Placement]) -> tuple:
+                placements: Sequence[Placement],
+                coord: Optional[Sequence[int]] = None) -> tuple:
+    """Per-device shard shape, uneven dims included.
+
+    Uneven semantics match the reference's balanced split
+    (phi/core/distributed/auto_parallel/reshard/ uneven handling): each
+    rank holds ``ceil(size / n)`` rows except the tail, which holds the
+    remainder (possibly 0). Without ``coord`` (mesh coordinates, one per
+    mesh dim) the maximal (rank-0 / padded-tile) shape is returned — the
+    shape XLA actually tiles; with ``coord`` the exact shape at those
+    coordinates.
+    """
     shape = list(global_shape)
     for mesh_dim, p in enumerate(placements):
         if isinstance(p, Shard):
             n = mesh.shape[mesh_dim]
-            if shape[p.dim] % n != 0:
-                raise ValueError(
-                    f"dim {p.dim} of size {shape[p.dim]} not divisible by mesh "
-                    f"axis {mesh.dim_names[mesh_dim]}={n} (uneven shards TBD)")
-            shape[p.dim] //= n
+            tile = -(-shape[p.dim] // n)  # ceil
+            if coord is None:
+                shape[p.dim] = tile
+            else:
+                c = coord[mesh_dim]
+                shape[p.dim] = max(0, min(tile, shape[p.dim] - c * tile))
     return tuple(shape)
 
 

@@ -20,8 +20,12 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle  # noqa: F401  (registers all ops)
-import paddle_tpu.incubate  # noqa: F401  (registers fused/incubate ops too,
-#                                  regardless of test collection order)
+
+# force-load every lazy namespace that registers ops, so the registry (and
+# therefore the coverage gate) is identical regardless of collection order
+for _ns in ("incubate", "fft", "signal", "quantization", "sparse", "linalg",
+            "geometric", "text", "audio", "distribution"):
+    getattr(paddle, _ns)
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.ops.registry import OPS, op_api
 
@@ -63,6 +67,15 @@ def sym(n):
         a = r.uniform(-0.9, 0.9, (n, n)).astype(np.float32)
         return (a + a.T) / 2
 
+    return make
+
+
+def _segids(n, k):
+    """segment ids covering exactly [0, k) so the data-dependent output
+    size is deterministic across the grad-check perturbations."""
+    def make(r):
+        base = np.arange(n) % k
+        return base.astype(np.int64)
     return make
 
 
@@ -381,6 +394,58 @@ SPECS = {
     "softmax_mask_fuse": spec([f(1, 1, 2, 4), fneg(1, 1, 2, 4, lo=0, hi=0)],
                               grad=[0]),
     "swiglu": spec([f(2, 4), f(2, 4)], grad=[0, 1]),
+    # ---- fft / signal ----
+    "fft_fft": spec([f(8)], grad=[]),
+    "fft_ifft": spec([lambda r: (r.uniform(0.2, 0.9, (8,))
+                                 + 1j * r.uniform(0.2, 0.9, (8,))).astype(np.complex64)],
+                     grad=[]),
+    "fft_fft2": spec([f(4, 4)], grad=[]),
+    "fft_ifft2": spec([lambda r: (r.uniform(0.2, 0.9, (4, 4))
+                                  + 1j * r.uniform(0.2, 0.9, (4, 4))).astype(np.complex64)],
+                      grad=[]),
+    "fft_fftn": spec([f(2, 4, 4)], grad=[]),
+    "fft_ifftn": spec([lambda r: (r.uniform(0.2, 0.9, (2, 4, 4))
+                                  + 1j * r.uniform(0.2, 0.9, (2, 4, 4))).astype(np.complex64)],
+                      grad=[]),
+    "fft_rfft": spec([f(8)], grad=[]),
+    "fft_irfft": spec([lambda r: (r.uniform(0.2, 0.9, (5,))
+                                  + 1j * r.uniform(0.2, 0.9, (5,))).astype(np.complex64)],
+                      grad=[]),
+    "fft_rfft2": spec([f(4, 4)], grad=[]),
+    "fft_irfft2": spec([lambda r: (r.uniform(0.2, 0.9, (4, 3))
+                                   + 1j * r.uniform(0.2, 0.9, (4, 3))).astype(np.complex64)],
+                       grad=[]),
+    "fft_rfftn": spec([f(2, 4, 4)], grad=[]),
+    "fft_irfftn": spec([lambda r: (r.uniform(0.2, 0.9, (2, 4, 3))
+                                   + 1j * r.uniform(0.2, 0.9, (2, 4, 3))).astype(np.complex64)],
+                       grad=[]),
+    "fft_hfft": spec([lambda r: (r.uniform(0.2, 0.9, (5,))
+                                 + 1j * r.uniform(0.2, 0.9, (5,))).astype(np.complex64)],
+                     grad=[]),
+    "fft_ihfft": spec([f(8)], grad=[]),
+    "fft_fftshift": spec([f(8)], grad=[0]),
+    "fft_ifftshift": spec([f(8)], grad=[0]),
+    "frame": spec([f(16), S(4), S(2)], grad=[0]),
+    "overlap_add": spec([f(4, 5), S(2)], grad=[0]),
+    "stft": spec([f(1, 32), S(8), S(4), S(8), S(None), S(True),
+                  S("reflect"), S(False), S(True)], grad=[]),
+    # ---- quantization ----
+    "quantize_linear": spec([f(2, 4), S(0.1), S(0)], grad=[]),
+    "dequantize_linear": spec([ii(2, 4, lo=-3, hi=3), S(0.1), S(0)], grad=[]),
+    "fake_quantize": spec([fneg(2, 4), S(0.5)], grad=[]),  # STE grad != numeric by design
+    # ---- geometric / segment ----
+    "segment_sum": spec([f(6, 3), _segids(6, 3)], grad=[0], jit=False),
+    "segment_mean": spec([f(6, 3), _segids(6, 3)], grad=[0], jit=False),
+    "segment_max": spec([f(6, 3), _segids(6, 3)], grad=[], jit=False),
+    "segment_min": spec([f(6, 3), _segids(6, 3)], grad=[], jit=False),
+    "send_u_recv": spec([f(4, 3), ii(5, lo=0, hi=4), ii(5, lo=0, hi=4)],
+                        grad=[0]),
+    "send_ue_recv": spec([f(4, 3), f(5, 3), ii(5, lo=0, hi=4),
+                          ii(5, lo=0, hi=4)], grad=[0, 1]),
+    "send_uv": spec([f(4, 3), f(4, 3), ii(5, lo=0, hi=4), ii(5, lo=0, hi=4)],
+                    grad=[0, 1]),
+    "viterbi_decode": spec([f(1, 5, 3), f(3, 3), ii(1, lo=5, hi=6)],
+                           grad=[], sel=0),
     # ---- losses ----
     "binary_cross_entropy": spec([f(2, 3, lo=0.2, hi=0.8),
                                   f(2, 3, lo=0.2, hi=0.8)], grad=[0]),
@@ -440,7 +505,8 @@ RANDOM_OPS = {
 SKIP = {
     "getitem": "internal indexing plumbing; exercised via Tensor.__getitem__",
     "setitem": "internal indexing plumbing; exercised via Tensor.__setitem__",
-    "ctc_loss": "not yet implemented (VERDICT missing #8)",
+    "ctc_loss": "needs structured (T,B,C)+lengths inputs; dedicated "
+                "parity-vs-torch test in test_subsystems.py",
 }
 
 

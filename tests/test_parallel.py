@@ -134,3 +134,87 @@ def test_sharded_trainer_checkpoint_roundtrip(tmp_path, mesh):
         t2.load(str(tmp_path / "ck"))
         new_losses = [float(t2.train_step(X, Y).numpy()) for _ in range(3)]
     np.testing.assert_allclose(new_losses, ref_losses, rtol=1e-5)
+
+
+def test_uneven_shard_roundtrip_dim7_over_4():
+    """VERDICT round-2 item 9: shard dim 7 over 4 devices, reshard back,
+    values intact (reference reshard/ uneven-split handling)."""
+    import numpy as np
+    from paddle_tpu.parallel import (ProcessMesh, Replicate, Shard,
+                                     local_shape, reshard, shard_tensor,
+                                     unshard)
+    from paddle_tpu.parallel.mesh import set_mesh
+
+    mesh = ProcessMesh(shape=(4,), dim_names=("x",))
+    try:
+        data = np.arange(7 * 3, dtype=np.float32).reshape(7, 3)
+        t = shard_tensor(data, mesh, [Shard(0)])
+        # padded-tile local shape is ceil(7/4)=2; the tail rank holds 1
+        assert local_shape((7, 3), mesh, [Shard(0)]) == (2, 3)
+        assert local_shape((7, 3), mesh, [Shard(0)], coord=(3,)) == (1, 3)
+        assert local_shape((7, 3), mesh, [Shard(0)], coord=(0,)) == (2, 3)
+        # physical storage is tile-padded (pad-and-mask): uniform 2-row
+        # tiles; the logical view stays (7, 3)
+        shard_rows = sorted(s.data.shape[0] for s in t._value.addressable_shards)
+        assert shard_rows == [2, 2, 2, 2]
+        assert t.shape == (7, 3) and t.size == 21
+        # round trip through replicate and back
+        r = unshard(t)
+        np.testing.assert_array_equal(r.numpy(), data)
+        s2 = reshard(r, mesh, [Shard(1)])  # dim 3 over 4: also uneven
+        np.testing.assert_array_equal(unshard(s2).numpy(), data)
+        # compute on the uneven-sharded tensor
+        import paddle_tpu as paddle
+        out = paddle.matmul(t, paddle.to_tensor(
+            np.ones((3, 2), np.float32)))
+        np.testing.assert_allclose(out.numpy(), data @ np.ones((3, 2)))
+    finally:
+        set_mesh(None)
+
+
+def test_uneven_shard_training_and_grads():
+    """Review findings: uneven-sharded params must train (padded grads) and
+    uneven leaves keep gradients through reshard/unshard."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Parameter
+    from paddle_tpu.parallel import (ProcessMesh, Shard, shard_tensor,
+                                     unshard)
+    from paddle_tpu.parallel.mesh import set_mesh
+
+    mesh = ProcessMesh(shape=(4,), dim_names=("x",))
+    try:
+        w0 = np.arange(21, dtype=np.float32).reshape(7, 3) / 10
+        p = shard_tensor(Parameter(w0.copy()), mesh, [Shard(0)],
+                         stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        x = paddle.to_tensor(np.ones((2, 7), np.float32))
+        loss = paddle.sum(paddle.matmul(x, p))
+        loss.backward()
+        assert p.grad is not None
+        assert p.grad.shape == (7, 3)  # logical view
+        np.testing.assert_allclose(p.grad.numpy(), np.full((7, 3), 2.0))
+        opt.step()
+        # update applied on the logical rows; pad rows stay zero internally
+        np.testing.assert_allclose(
+            np.asarray(p._value)[:7], w0 - 0.1 * 2.0, rtol=1e-6)
+
+        # uneven leaf keeps its gradient through unshard
+        t = shard_tensor(np.ones((7, 3), np.float32), mesh, [Shard(0)],
+                         stop_gradient=False)
+        out = unshard(t)
+        paddle.sum(out * 3.0).backward()
+        assert t.grad is not None
+        np.testing.assert_allclose(t.grad.numpy(), np.full((7, 3), 3.0))
+
+        # detach keeps the logical view
+        d = t.detach()
+        assert d.shape == (7, 3)
+        np.testing.assert_array_equal(d.numpy(), np.ones((7, 3)))
+
+        # re-sharding an already-padded tensor never turns pad into data
+        t2 = shard_tensor(t, mesh, [Shard(1)])
+        assert t2.shape == (7, 3)
+        np.testing.assert_array_equal(t2.numpy(), np.ones((7, 3)))
+    finally:
+        set_mesh(None)

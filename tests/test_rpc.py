@@ -1,0 +1,73 @@
+"""distributed.rpc over the native TCPStore (reference
+paddle/fluid/distributed/rpc/rpc_agent.cc + python/paddle/distributed/rpc)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.rpc import RpcAgent
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+def _matsum(arr):
+    return float(np.asarray(arr).sum())
+
+
+@pytest.fixture
+def agents():
+    a0 = RpcAgent("worker0", 0, 2)
+    a1 = RpcAgent("worker1", 1, 2, host=a0.store.host, port=a0.store.port,
+                  is_master=False)
+    yield a0, a1
+    a0.shutdown()
+    a1.shutdown()
+
+
+def test_rpc_sync_roundtrip(agents):
+    a0, a1 = agents
+    assert a0.call("worker1", _add, (2, 3)).wait() == 5
+    assert a1.call("worker0", _add, (10, 30)).wait() == 40
+
+
+def test_rpc_async_many_ordered(agents):
+    a0, a1 = agents
+    futs = [a0.call(1, _add, (i, i)) for i in range(8)]
+    assert [f.wait() for f in futs] == [2 * i for i in range(8)]
+
+
+def test_rpc_remote_exception_propagates(agents):
+    a0, a1 = agents
+    with pytest.raises(ValueError, match="remote failure"):
+        a0.call("worker1", _boom).wait()
+    # agent still serves after an exception
+    assert a0.call("worker1", _add, (1, 1)).wait() == 2
+
+
+def test_rpc_numpy_payload_and_worker_info(agents):
+    a0, a1 = agents
+    arr = np.arange(12.0).reshape(3, 4)
+    assert a1.call("worker0", _matsum, (arr,)).wait() == arr.sum()
+    info = a0.worker_info("worker1")
+    assert info.rank == 1 and info.name == "worker1"
+    assert [w.name for w in a0.all_worker_info()] == ["worker0", "worker1"]
+
+
+def test_rpc_module_api():
+    import paddle_tpu.distributed.rpc as rpc
+    rpc.init_rpc("solo", rank=0, world_size=1,
+                 master_endpoint=None)
+    try:
+        assert rpc.rpc_sync("solo", _add, (4, 5)) == 9
+        fut = rpc.rpc_async(0, _add, (6, 7))
+        assert fut.wait() == 13
+        assert rpc.get_current_worker_info().name == "solo"
+    finally:
+        rpc.shutdown()

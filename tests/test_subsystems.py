@@ -381,3 +381,78 @@ def test_hub_local(tmp_path):
     assert "tiny model" in hub.help(str(tmp_path), "tiny")
     layer = hub.load(str(tmp_path), "tiny", 5)
     assert layer.weight.shape == (5, 5)
+
+
+def test_ctc_loss_matches_torch():
+    """CTC alpha-recursion vs torch's reference implementation
+    (warpctc_kernel_impl.h capability analog)."""
+    import jax
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    T, B, C, L = 12, 3, 6, 4
+    log_probs = np.asarray(jax.nn.log_softmax(
+        rng.normal(size=(T, B, C)).astype(np.float32), -1))
+    labels = rng.integers(1, C, (B, L)).astype(np.int64)
+    in_len = np.array([12, 10, 8])
+    lab_len = np.array([4, 3, 2])
+    ref = TF.ctc_loss(torch.tensor(log_probs), torch.tensor(labels),
+                      torch.tensor(in_len), torch.tensor(lab_len),
+                      blank=0, reduction="none").numpy()
+    got = F.ctc_loss(paddle.to_tensor(log_probs), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                     blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    lp = paddle.to_tensor(log_probs, stop_gradient=False)
+    F.ctc_loss(lp, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+               paddle.to_tensor(lab_len)).backward()
+    assert lp.grad is not None and np.all(np.isfinite(lp.grad.numpy()))
+
+
+def test_monitor_counters_and_memory_stats():
+    """STAT_* registry (platform/monitor.cc) + memory stats (memory/stats.h)."""
+    import paddle_tpu.device as device
+    from paddle_tpu.framework import monitor
+
+    monitor.stat_reset()
+    before = monitor.stat_get("STAT_eager_ops_dispatched")
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    _ = x + x
+    _ = paddle.matmul(x, x)
+    after = monitor.stat_get("STAT_eager_ops_dispatched")
+    assert after >= before + 2
+    monitor.stat_add("my_counter", 5)
+    monitor.stat_add("my_counter", 2)
+    assert monitor.stat_get("my_counter") == 7
+    assert monitor.stat_values()["my_counter"] == 7
+    monitor.stat_reset("my_counter")
+    assert monitor.stat_get("my_counter") == 0
+
+    alloc = device.memory_allocated()
+    assert alloc > 0  # live arrays exist
+    assert device.max_memory_allocated() >= 0
+    assert device.memory_reserved() >= 0
+
+
+def test_cost_model_static_and_measured():
+    """cost_model.CostModel analog: per-op static flops agree with XLA's
+    compiled cost analysis (python/paddle/cost_model/cost_model.py)."""
+    import jax.numpy as jnp
+    cm = paddle.cost_model.CostModel()
+
+    def f(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x, w = jnp.ones((8, 16)), jnp.ones((16, 32))
+    rows = cm.static_cost(f, x, w)
+    dots = [r for r in rows if r["op"] == "dot_general"]
+    assert dots and dots[0]["flops"] == 2 * 8 * 16 * 32
+    res = cm.profile_measure(fn=f, example_args=(x, w))
+    assert res["time"] > 0
+    xla = res["xla_cost_analysis"]
+    if xla:  # backend-dependent; CPU provides it
+        assert abs(xla["flops"] - res["total_static_flops"]) < 0.1 * (
+            res["total_static_flops"] + 1)
