@@ -41,6 +41,8 @@ class StepWatchdog:
 
     def beat(self):
         """Call once per completed train step."""
+        from paddle_tpu.framework.monitor import stat_add
+        stat_add("STAT_watchdog_beats")
         self._last_beat = time.monotonic()
 
     def _loop(self):

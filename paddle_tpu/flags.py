@@ -115,6 +115,10 @@ def set_flags(d: Dict[str, Any]) -> None:
 # Core flag inventory (analog of paddle/common/flags.cc switchboard).
 # ---------------------------------------------------------------------------
 define_flag("check_nan_inf", False, "scan every op output for NaN/Inf and raise")
+define_flag("check_nan_inf_skip_ops", "",
+            "comma-separated op names exempt from the NaN/Inf scan "
+            "(op_type skip list, fluid/eager/nan_inf_utils.h analog — e.g. "
+            "softmax_with_cross_entropy produces benign -inf internally)")
 define_flag("deterministic", False, "prefer deterministic kernels / reductions")
 define_flag("eager_jit_ops", True, "cache-and-jit each eager op call (vs. raw dispatch)")
 define_flag("benchmark", False, "print per-step timing")

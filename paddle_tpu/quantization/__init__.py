@@ -21,7 +21,7 @@ from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.ops.registry import register_op
 
 __all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "quantize",
-           "dequantize", "fake_quantize", "QuantedLinear"]
+           "dequantize", "fake_quantize", "QuantedLinear", "QuantedConv2D"]
 
 
 @register_op("quantize_linear")
@@ -78,34 +78,74 @@ class QuantConfig:
     def __init__(self, activation=None, weight=None):
         self.activation = activation or (lambda: AbsmaxObserver())
         self.weight = weight or (lambda: AbsmaxObserver())
-        self._layer_types = (nn.Linear,)
+        self._layer_types = (nn.Linear, nn.Conv2D)
 
     def add_layer_config(self, layer_types, activation=None, weight=None):
         self._layer_types = tuple(layer_types)
 
 
-class QuantedLinear(nn.Layer):
-    """Linear with fake-quantized weight+activation (QAT/PTQ simulation)."""
+class _QuantedBase(nn.Layer):
+    """Shared fake-quant wrapper state (QAT/PTQ simulation)."""
 
-    def __init__(self, linear: nn.Linear, w_scale: float, a_observer,
-                 bits: int = 8):
+    def __init__(self, inner, w_scale: float, a_observer, bits: int = 8):
         super().__init__()
-        self.inner = linear
+        self.inner = inner
         self.w_scale = w_scale
         self.a_observer = a_observer
         self.bits = bits
         self.calibrating = True
+        self.int8_kernel = False
 
-    def forward(self, x):
+    def _a_scale(self, x):
         if self.calibrating:
             self.a_observer.observe(x)
-            a_scale = self.a_observer.scale()
-        else:
-            a_scale = self.a_observer.scale()
+        return self.a_observer.scale()
+
+
+class QuantedLinear(_QuantedBase):
+    """Linear with fake-quantized weight+activation; after convert() with
+    ``int8_kernel`` the matmul really runs int8 x int8 -> int32 on the MXU
+    (the deployment path, not just simulation)."""
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        a_scale = self._a_scale(x)
+        if self.int8_kernel and not self.calibrating:
+            from paddle_tpu.ops.registry import OpDef, apply_op
+            ws, ascale, bits = self.w_scale, a_scale, self.bits
+            w = self.inner.weight
+            qmax = 2 ** (bits - 1) - 1
+
+            def impl(xv, wv):
+                xq = jnp.clip(jnp.round(xv / ascale), -qmax - 1,
+                              qmax).astype(jnp.int8)
+                wq = jnp.clip(jnp.round(wv / ws), -qmax - 1,
+                              qmax).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    xq, wq, (((xv.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return acc.astype(jnp.float32) * (ascale * ws)
+
+            out = apply_op(OpDef("int8_linear", impl, differentiable=False),
+                           (x, w), {})
+            return out + self.inner.bias if self.inner.bias is not None else out
         xq = fake_quantize(x, a_scale, self.bits)
         wq = fake_quantize(self.inner.weight, self.w_scale, self.bits)
-        import paddle_tpu.nn.functional as F
         return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    """Conv2D with fake-quantized weight+activation
+    (quantization/imperative quantized conv analog)."""
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        a_scale = self._a_scale(x)
+        xq = fake_quantize(x, a_scale, self.bits)
+        wq = fake_quantize(self.inner.weight, self.w_scale, self.bits)
+        c = self.inner
+        return F.conv2d(xq, wq, c.bias, stride=c.stride, padding=c.padding,
+                        dilation=c.dilation, groups=c.groups)
 
 
 def _swap_quanted(model: nn.Layer, config: QuantConfig):
@@ -113,8 +153,9 @@ def _swap_quanted(model: nn.Layer, config: QuantConfig):
         if isinstance(sub, config._layer_types):
             obs = config.weight()
             obs.observe(sub.weight)
-            model._sub_layers[name] = QuantedLinear(sub, obs.scale(),
-                                                    config.activation())
+            cls = QuantedConv2D if isinstance(sub, nn.Conv2D) else QuantedLinear
+            model._sub_layers[name] = cls(sub, obs.scale(),
+                                          config.activation())
         else:
             _swap_quanted(sub, config)
 
@@ -131,10 +172,12 @@ class PTQ:
         _swap_quanted(m, self.config)
         return m
 
-    def convert(self, model: nn.Layer, inplace: bool = True):
+    def convert(self, model: nn.Layer, inplace: bool = True,
+                int8_kernel: bool = False):
         for _, sub in model.named_sublayers(include_self=True):
-            if isinstance(sub, QuantedLinear):
+            if isinstance(sub, _QuantedBase):
                 sub.calibrating = False
+                sub.int8_kernel = int8_kernel
         return model
 
 

@@ -456,3 +456,94 @@ def test_cost_model_static_and_measured():
     if xla:  # backend-dependent; CPU provides it
         assert abs(xla["flops"] - res["total_static_flops"]) < 0.1 * (
             res["total_static_flops"] + 1)
+
+
+def test_ptq_conv_and_int8_kernel():
+    """PTQ over Conv2D+Linear; converted Linear can run a REAL int8 MXU
+    matmul whose outputs track the float model (imperative quant analog)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import PTQ, QuantedConv2D, QuantedLinear
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 4, 3, padding=1)
+            self.fc = nn.Linear(4, 5)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.conv(x))
+            return self.fc(h.mean(axis=[2, 3]))
+
+    rng = np.random.default_rng(0)
+    m = M()
+    x = paddle.to_tensor(rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
+    ref = m(x).numpy()
+
+    q = PTQ().quantize(m)
+    assert isinstance(q.conv, QuantedConv2D)
+    assert isinstance(q.fc, QuantedLinear)
+    q(x)  # calibrate
+    PTQ().convert(q, int8_kernel=True)
+    out = q(x).numpy()
+    assert np.all(np.isfinite(out))
+    # int8 simulation should stay close to the fp32 model on this scale
+    assert np.max(np.abs(out - ref)) < 0.15 * (np.max(np.abs(ref)) + 1e-6)
+
+
+def test_qat_trains_through_ste():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import QAT
+
+    rng = np.random.default_rng(1)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    q = QAT().quantize(m, inplace=True)
+    opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                parameters=q.parameters())
+    x = paddle.to_tensor(rng.normal(size=(16, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 2, (16,)))
+    losses = []
+    for _ in range(15):
+        loss = paddle.nn.functional.cross_entropy(q(x), y)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_sparse_matmul_trains_dense_weight():
+    """Sparse training story: a dense parameter learns through
+    sparse.matmul (python/paddle/sparse capability)."""
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.default_rng(2)
+    idx = np.array([[0, 0, 1, 2], [0, 2, 1, 3]])
+    vals = rng.normal(size=(4,)).astype(np.float32)
+    sp = sparse.sparse_coo_tensor(idx, vals, shape=(3, 4))
+    w = paddle.to_tensor(rng.normal(size=(4, 2)).astype(np.float32),
+                         stop_gradient=False)
+    tgt = paddle.to_tensor(rng.normal(size=(3, 2)).astype(np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    losses = []
+    for _ in range(20):
+        out = sparse.matmul(sp, w)
+        loss = paddle.mean((out - tgt) ** 2)
+        loss.backward()
+        assert w.grad is not None
+        opt.step(); opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_nan_check_skip_list():
+    """Per-op NaN-scan exemption (nan_inf_utils op_type skip-list analog)."""
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([-1.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.log(x)
+        paddle.set_flags({"check_nan_inf_skip_ops": "log"})
+        out = paddle.log(x)  # exempted: no raise
+        assert np.isnan(out.numpy()).all()
+    finally:
+        paddle.set_flags({"check_nan_inf": False,
+                          "check_nan_inf_skip_ops": ""})
