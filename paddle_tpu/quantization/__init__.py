@@ -107,27 +107,31 @@ class QuantedLinear(_QuantedBase):
     ``int8_kernel`` the matmul really runs int8 x int8 -> int32 on the MXU
     (the deployment path, not just simulation)."""
 
+    def _freeze_int8(self):
+        """Build the frozen-scale int8 op ONCE at convert() time (scales
+        stop moving then; rebuilding per forward is hot-path garbage)."""
+        from paddle_tpu.ops.registry import OpDef
+        ws, ascale = self.w_scale, self.a_observer.scale()
+        qmax = 2 ** (self.bits - 1) - 1
+
+        def impl(xv, wv):
+            xq = jnp.clip(jnp.round(xv / ascale), -qmax - 1,
+                          qmax).astype(jnp.int8)
+            wq = jnp.clip(jnp.round(wv / ws), -qmax - 1,
+                          qmax).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, wq, (((xv.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc.astype(jnp.float32) * (ascale * ws)
+
+        self._int8_op = OpDef("int8_linear", impl, differentiable=False)
+
     def forward(self, x):
         import paddle_tpu.nn.functional as F
         a_scale = self._a_scale(x)
         if self.int8_kernel and not self.calibrating:
-            from paddle_tpu.ops.registry import OpDef, apply_op
-            ws, ascale, bits = self.w_scale, a_scale, self.bits
-            w = self.inner.weight
-            qmax = 2 ** (bits - 1) - 1
-
-            def impl(xv, wv):
-                xq = jnp.clip(jnp.round(xv / ascale), -qmax - 1,
-                              qmax).astype(jnp.int8)
-                wq = jnp.clip(jnp.round(wv / ws), -qmax - 1,
-                              qmax).astype(jnp.int8)
-                acc = jax.lax.dot_general(
-                    xq, wq, (((xv.ndim - 1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32)
-                return acc.astype(jnp.float32) * (ascale * ws)
-
-            out = apply_op(OpDef("int8_linear", impl, differentiable=False),
-                           (x, w), {})
+            from paddle_tpu.ops.registry import apply_op
+            out = apply_op(self._int8_op, (x, self.inner.weight), {})
             return out + self.inner.bias if self.inner.bias is not None else out
         xq = fake_quantize(x, a_scale, self.bits)
         wq = fake_quantize(self.inner.weight, self.w_scale, self.bits)
@@ -138,22 +142,56 @@ class QuantedConv2D(_QuantedBase):
     """Conv2D with fake-quantized weight+activation
     (quantization/imperative quantized conv analog)."""
 
+    def _freeze_int8(self):
+        from paddle_tpu.ops.registry import OpDef
+        ws, ascale = self.w_scale, self.a_observer.scale()
+        qmax = 2 ** (self.bits - 1) - 1
+        c = self.inner
+
+        def impl(xv, wv):
+            import paddle_tpu.nn.functional as FN
+            xq = jnp.clip(jnp.round(xv / ascale), -qmax - 1, qmax)
+            wq = jnp.clip(jnp.round(wv / ws), -qmax - 1, qmax)
+            # int8 conv: quantized integer grids; XLA keeps the MXU layout.
+            # Accumulate in f32 (conv transpose rule forbids a widened
+            # preferred_element_type; values are exact integers < 2^21)
+            out = FN.conv2d.op.impl(xq, wq, None, stride=c.stride,
+                                    padding=c.padding, dilation=c.dilation,
+                                    groups=c.groups)
+            return out * (ascale * ws)
+
+        self._int8_op = OpDef("int8_conv2d", impl, differentiable=False)
+
     def forward(self, x):
         import paddle_tpu.nn.functional as F
         a_scale = self._a_scale(x)
+        c = self.inner
+        if self.int8_kernel and not self.calibrating:
+            from paddle_tpu.ops.registry import apply_op
+            out = apply_op(self._int8_op, (x, c.weight), {})
+            if c.bias is not None:
+                out = out + paddle_reshape_bias(c.bias, out.ndim)
+            return out
         xq = fake_quantize(x, a_scale, self.bits)
         wq = fake_quantize(self.inner.weight, self.w_scale, self.bits)
-        c = self.inner
         return F.conv2d(xq, wq, c.bias, stride=c.stride, padding=c.padding,
                         dilation=c.dilation, groups=c.groups)
+
+
+_WRAPPERS = {}  # filled below: inner layer type -> quanted wrapper
 
 
 def _swap_quanted(model: nn.Layer, config: QuantConfig):
     for name, sub in list(model._sub_layers.items()):
         if isinstance(sub, config._layer_types):
+            cls = next((w for t, w in _WRAPPERS.items()
+                        if isinstance(sub, t)), None)
+            if cls is None:
+                raise NotImplementedError(
+                    f"no quantized wrapper for {type(sub).__name__}; "
+                    f"supported: {[t.__name__ for t in _WRAPPERS]}")
             obs = config.weight()
             obs.observe(sub.weight)
-            cls = QuantedConv2D if isinstance(sub, nn.Conv2D) else QuantedLinear
             model._sub_layers[name] = cls(sub, obs.scale(),
                                           config.activation())
         else:
@@ -178,7 +216,19 @@ class PTQ:
             if isinstance(sub, _QuantedBase):
                 sub.calibrating = False
                 sub.int8_kernel = int8_kernel
+                if int8_kernel:
+                    sub._freeze_int8()
         return model
+
+
+_WRAPPERS.update({nn.Conv2D: QuantedConv2D, nn.Linear: QuantedLinear})
+
+
+def paddle_reshape_bias(bias, ndim):
+    shape = [1] * ndim
+    shape[1] = bias.shape[0]
+    import paddle_tpu as paddle
+    return paddle.reshape(bias, shape)
 
 
 class QAT(PTQ):
