@@ -47,6 +47,7 @@ def _sequential_loss(stacked, x, tgt, n_stages):
     return total
 
 
+@pytest.mark.slow
 def test_1f1b_loss_and_grads_match_sequential(mesh):
     rng = np.random.default_rng(0)
     d, M, B, S = 8, 6, 4, 4
@@ -143,6 +144,7 @@ def test_vpp_interleaved_matches_sequential(mesh):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_loss_params_and_x_grad(mesh):
     """Head weights inside the loss + input cotangents: everything an
     embedding->pipe->head model needs to assemble full grads."""
