@@ -130,6 +130,10 @@ define_flag("flash_attention_min_seq", 1024,
             "single-block/512-block schedule wins — measured on v5e: "
             "S=512 sdpa 3.6ms vs flash 4.5ms, S=1024 sdpa 9.8ms vs "
             "flash 6.8ms fwd+bwd per layer, and sdpa OOMs at S=2048)")
+define_flag("use_fused_lm_ce", True,
+            "route large-vocab LM losses through the chunked-vocab fused "
+            "head+CE (ops/fused_ce.py) instead of materializing (T, V) "
+            "logits")
 define_flag("use_ring_attention", True,
             "use ring (context-parallel) attention when the mesh has a sep>1 axis")
 define_flag("default_dtype", "float32", "default floating point dtype")

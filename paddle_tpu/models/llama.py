@@ -206,16 +206,33 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
 
+    def _head_weight(self):
+        """The (H, V) lm-head matrix — single source for forward and the
+        fused loss (tied: transposed embedding; untied: lm_head weight)."""
+        if self.lm_head is None:
+            return self.model.embed_tokens.weight.t()
+        return self.lm_head.weight
+
     def forward(self, input_ids, attn_mask=None):
         hidden = self.model(input_ids, attn_mask)
         if self.lm_head is None:
-            w = self.model.embed_tokens.weight  # (V, H)
-            return paddle.matmul(hidden, w.t())
+            return paddle.matmul(hidden, self._head_weight())
         return self.lm_head(hidden)
 
     def loss(self, input_ids, labels):
+        from paddle_tpu.flags import flags
+        V = self.config.vocab_size
+        if flags.use_fused_lm_ce and V >= 4096:
+            # chunked-vocab fused head+CE: never materializes the (T, V)
+            # logits (the largest activation of the step — see
+            # ops/fused_ce.py; phi fusion/cross_entropy_with_softmax analog)
+            hidden = self.model(input_ids)
+            B, S, H = hidden.shape
+            from paddle_tpu.ops.registry import op_api
+            return op_api("fused_linear_ce")(
+                hidden.reshape([B * S, H]), self._head_weight(),
+                labels.reshape([-1]), chunk=8192)
         logits = self(input_ids)
-        V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
 
     def num_params(self) -> int:

@@ -12,6 +12,7 @@ from paddle_tpu.ops.comparison import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.creation import *  # noqa: F401,F403
 
+from paddle_tpu.ops import fused_ce as _fused_ce  # noqa: F401 (registers fused_linear_ce)
 from paddle_tpu.ops import methods as _methods
 
 _methods.monkey_patch_tensor()

@@ -1,0 +1,147 @@
+"""Fused lm-head + softmax cross-entropy, chunked over the vocab.
+
+Capability analog of the reference's fused softmax-CE kernels
+(paddle/phi/kernels/fusion/ + cross_entropy_with_softmax): the (T, V)
+logits matrix for a 32k vocab at T = B*S tokens is the single largest
+activation in an LM step (f32 logits alone are ~1GB at B=8, S=1024 —
+pure HBM traffic). This op never materializes it:
+
+- forward: one ``lax.scan`` over vocab chunks computes the online
+  max/sum-exp merge (the flash-attention recurrence, applied to the
+  softmax denominator) plus the gold-label logit; residuals are just
+  (hidden, head, lse) — O(T) extra, not O(T*V),
+- backward: a second scan recomputes each logits chunk, forms
+  ``softmax - onehot`` locally, and accumulates dhidden / dhead chunk by
+  chunk on the MXU.
+
+Numerics: logits accumulate in f32 (preferred_element_type) regardless of
+the io dtype; results match the unfused path to f32 roundoff.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def _chunks(V: int, chunk: int) -> int:
+    return (V + chunk - 1) // chunk
+
+
+def _pad_head(head, V: int, chunk: int):
+    n = _chunks(V, chunk)
+    pad = n * chunk - V
+    if pad:
+        head = jnp.pad(head, ((0, 0), (0, pad)))
+    return head, n, pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(hidden, head, labels, chunk: int = 4096):
+    """mean over tokens of CE(softmax(hidden @ head), labels).
+
+    hidden: (T, H); head: (H, V); labels: (T,) int. Returns a scalar f32.
+    Labels outside [0, V) (e.g. -100 padding) contribute zero loss and
+    zero gradient, with the mean still taken over ALL T tokens — exactly
+    the unfused path's semantics (one_hot of an invalid label is all-zero).
+    """
+    loss, _ = _fwd_impl(hidden, head, labels, chunk)
+    return loss
+
+
+def _fwd_impl(hidden, head, labels, chunk):
+    T, H = hidden.shape
+    V = head.shape[1]
+    chunk = min(chunk, V)  # never pad past one chunk of waste
+    headp, n, _ = _pad_head(head, V, chunk)
+    hchunks = jnp.moveaxis(headp.reshape(H, n, chunk), 1, 0)  # (n, H, C)
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, xs):
+        m, s, gold = carry
+        w, idx = xs                                   # (H, C), chunk index
+        logits = jax.lax.dot_general(
+            hidden, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (T, C) f32
+        base = idx * chunk
+        cols = base + jnp.arange(chunk)[None, :]
+        valid = cols < V
+        logits = jnp.where(valid, logits, -jnp.inf)
+        # online logsumexp merge
+        m_c = jnp.max(logits, axis=1)
+        m_new = jnp.maximum(m, m_c)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1)
+        # gold logit if the label lands in this chunk
+        in_chunk = (labels >= base) & (labels < base + chunk)
+        local = jnp.clip(labels - base, 0, chunk - 1)
+        g = jnp.take_along_axis(logits, local[:, None], axis=1)[:, 0]
+        gold = jnp.where(in_chunk, g, gold)
+        return (m_new, s, gold), None
+
+    m0 = jnp.full((T,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((T,), jnp.float32)
+    g0 = jnp.zeros((T,), jnp.float32)
+    (m, s, gold), _ = jax.lax.scan(
+        body, (m0, s0, g0), (hchunks, jnp.arange(n)))
+    lse = m + jnp.log(s)
+    valid = (labels >= 0) & (labels < V)
+    loss = jnp.mean(jnp.where(valid, lse - gold, 0.0))
+    return loss, lse
+
+
+def _fwd(hidden, head, labels, chunk):
+    loss, lse = _fwd_impl(hidden, head, labels, chunk)
+    return loss, (hidden, head, labels.astype(jnp.int32), lse)
+
+
+def _bwd(chunk, res, g):
+    hidden, head, labels, lse = res
+    T, H = hidden.shape
+    V = head.shape[1]
+    chunk = min(chunk, V)
+    headp, n, _ = _pad_head(head, V, chunk)
+    hchunks = jnp.moveaxis(headp.reshape(H, n, chunk), 1, 0)
+    valid = ((labels >= 0) & (labels < V)).astype(jnp.float32)
+    scale = (g / T) * valid  # mean over ALL tokens; ignored rows get 0
+
+    def body(dh, xs):
+        w, idx = xs
+        logits = jax.lax.dot_general(
+            hidden, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        base = idx * chunk
+        cols = base + jnp.arange(chunk)[None, :]
+        valid = cols < V
+        p = jnp.where(valid, jnp.exp(logits - lse[:, None]), 0.0)
+        onehot = (cols == labels[:, None]).astype(jnp.float32)
+        dlogits = ((p - onehot) * scale[:, None]).astype(hidden.dtype)
+        dh = dh + jax.lax.dot_general(
+            dlogits, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw = jax.lax.dot_general(
+            hidden, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (H, C)
+        return dh, dw
+
+    dh0 = jnp.zeros((T, H), jnp.float32)
+    dh, dws = jax.lax.scan(body, dh0, (hchunks, jnp.arange(n)))
+    dhead = jnp.moveaxis(dws, 0, 1).reshape(H, n * chunk)[:, :V]
+    return (dh.astype(hidden.dtype), dhead.astype(head.dtype), None)
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)
+
+
+from paddle_tpu.ops.registry import register_op
+
+
+@register_op("fused_linear_ce",
+             ref="paddle/phi/kernels/fusion/ + cross_entropy_with_softmax "
+                 "(capability analog)")
+def fused_linear_ce_op(hidden, head, labels, chunk: int = 4096):
+    return fused_linear_cross_entropy(hidden, head, labels, chunk)

@@ -5,7 +5,8 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
-from paddle_tpu.models.llama import TINY_CONFIG, LlamaForCausalLM, llama_tp_plan
+from paddle_tpu.models.llama import (TINY_CONFIG, LlamaConfig,
+                                     LlamaForCausalLM, llama_tp_plan)
 from paddle_tpu.parallel import init_mesh
 from paddle_tpu.parallel.mesh import set_mesh
 from paddle_tpu.parallel.train import ShardedTrainer
@@ -127,3 +128,43 @@ def test_unet_denoising_step():
         loss.backward(); opt.step(); opt.clear_grad()
         l0 = l0 or float(loss.numpy())
     assert float(loss.numpy()) < l0
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_fused_lm_ce_matches_unfused_loss_and_grads(tie):
+    """Chunked-vocab fused head+CE (ops/fused_ce.py) == the materialized
+    logits path, loss and parameter grads (fusion/cross_entropy analog).
+    Covers -100 padding labels and tied embeddings."""
+    import paddle_tpu
+    from paddle_tpu.flags import flags
+
+    cfg = LlamaConfig(vocab_size=4096, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=32,
+                      tie_word_embeddings=tie)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 16))
+    labels = rng.integers(0, cfg.vocab_size, (2, 16))
+    labels[:, ::3] = -100  # padding convention: ignored, zero grad
+
+    def run(fused):
+        paddle.seed(11)
+        model = LlamaForCausalLM(cfg)
+        old = flags.use_fused_lm_ce
+        paddle.set_flags({"use_fused_lm_ce": fused})
+        try:
+            loss = model.loss(paddle.to_tensor(ids), paddle.to_tensor(labels))
+            loss.backward()
+        finally:
+            paddle.set_flags({"use_fused_lm_ce": old})
+        grads = {n: p.grad.numpy() for n, p in model.named_parameters()
+                 if p.grad is not None}
+        return float(loss.numpy()), grads
+
+    l1, g1 = run(True)
+    l0, g0 = run(False)
+    np.testing.assert_allclose(l1, l0, rtol=1e-5)
+    assert set(g1) == set(g0)
+    for n in g0:
+        np.testing.assert_allclose(g1[n], g0[n], rtol=1e-4, atol=1e-5,
+                                   err_msg=n)

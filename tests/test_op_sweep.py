@@ -394,6 +394,8 @@ SPECS = {
     "softmax_mask_fuse": spec([f(1, 1, 2, 4), fneg(1, 1, 2, 4, lo=0, hi=0)],
                               grad=[0]),
     "swiglu": spec([f(2, 4), f(2, 4)], grad=[0, 1]),
+    "fused_linear_ce": spec([f(4, 8), f(8, 12), ii(4, lo=0, hi=12)],
+                            kw=dict(chunk=5), grad=[0, 1], atol=5e-3),
     # ---- fft / signal ----
     "fft_fft": spec([f(8)], grad=[]),
     "fft_ifft": spec([lambda r: (r.uniform(0.2, 0.9, (8,))
