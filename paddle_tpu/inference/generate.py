@@ -156,8 +156,22 @@ class LlamaDecoder:
         return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None) -> np.ndarray:
-        """Greedy decode. input_ids: (B, S) ints. Returns (B, S + new)."""
+                 eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 seed: int = 0) -> np.ndarray:
+        """Decode. input_ids: (B, S) ints. Returns (B, S + new).
+
+        Greedy by default; ``do_sample=True`` draws from the
+        temperature/top-k/top-p-filtered distribution (the reference
+        generation-op sampling surface). Sampling uses the host loop
+        (per-token randomness), greedy-without-eos uses the one-dispatch
+        scan path.
+        """
+        if do_sample:
+            return self._generate_sampled(input_ids, max_new_tokens,
+                                          eos_token_id, temperature,
+                                          top_k, top_p, seed)
         ids = jnp.asarray(np.asarray(input_ids))
         B, S = ids.shape
         if S + max_new_tokens > self.max_len:
@@ -193,3 +207,61 @@ class LlamaDecoder:
                                         kc, vc, jnp.asarray(pos, jnp.int32))
             pos += 1
         return np.asarray(jnp.concatenate(out, axis=1))
+
+    def _generate_sampled(self, input_ids, max_new_tokens, eos_token_id,
+                          temperature, top_k, top_p, seed):
+        import jax.random as jrandom
+
+        ids = jnp.asarray(np.asarray(input_ids))
+        B, S = ids.shape
+        if S + max_new_tokens > self.max_len:
+            raise ValueError(f"prompt {S} + {max_new_tokens} new tokens "
+                             f"exceeds max_len {self.max_len}")
+        if max_new_tokens <= 0:
+            return np.asarray(ids)
+        kc, vc = self._empty_cache(B)
+        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        key = jrandom.key(seed)
+        out = [ids]
+        pos = S
+        done = np.zeros((B,), bool)
+        for i in range(max_new_tokens):
+            key, sub = jrandom.split(key)
+            nxt = np.asarray(_sample_logits(logits, sub, temperature,
+                                            top_k, top_p))
+            nxt = nxt.astype(np.asarray(ids).dtype)
+            if eos_token_id is not None:
+                nxt = np.where(done, eos_token_id, nxt)
+                done |= nxt == eos_token_id
+            out.append(jnp.asarray(nxt[:, None]))
+            if (eos_token_id is not None and bool(done.all())) \
+                    or i == max_new_tokens - 1:
+                break
+            logits, kc, vc = self._step(self.params, jnp.asarray(nxt[:, None]),
+                                        kc, vc, jnp.asarray(pos, jnp.int32))
+            pos += 1
+        return np.asarray(jnp.concatenate(out, axis=1))
+
+
+import functools
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("temperature", "top_k", "top_p"))
+def _sample_logits(logits, key, temperature: float = 1.0,
+                   top_k=None, top_p=None):
+    """Temperature / top-k / top-p filtered categorical sample. (B, V) -> (B,)."""
+    lg = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None:
+        kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p is not None:
+        sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest logit still inside the nucleus
+        keep_n = jnp.sum(cum - probs < top_p, axis=-1)  # (B,)
+        cutoff = jnp.take_along_axis(
+            sorted_lg, jnp.maximum(keep_n - 1, 0)[:, None], axis=-1)
+        lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+    return jax.random.categorical(key, lg, axis=-1)
