@@ -1,0 +1,3 @@
+"""paddle_tpu.utils — extension/loading utilities."""
+
+from paddle_tpu.utils import cpp_extension  # noqa: F401

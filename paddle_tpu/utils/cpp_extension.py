@@ -1,0 +1,104 @@
+"""JIT-compiled custom C++ extensions (utils/cpp_extension analog).
+
+The reference's custom-op packaging story
+(python/paddle/utils/cpp_extension/extension_utils.py + load()): compile
+user C++ sources into a shared library on first use and expose the
+symbols. TPU-native twist: there is no device-kernel ABI to bind — custom
+TPU kernels are Pallas (pure Python) — so the C++ surface this loader
+serves is HOST-side ops: data munging, tokenization, custom IO. Functions
+are exposed via ctypes (no pybind dependency); ``as_custom_op`` lifts a
+host function into the op registry via ``jax.pure_callback`` so it
+composes with jit tracing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "get_build_directory", "as_custom_op"]
+
+_DEFAULT_BUILD_ROOT = os.path.join(
+    os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+
+
+def get_build_directory() -> str:
+    root = os.environ.get("PADDLE_TPU_EXTENSION_DIR", _DEFAULT_BUILD_ROOT)
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+class CppExtension:
+    """Source bundle (setup()-style declaration parity)."""
+
+    def __init__(self, sources: Sequence[str], extra_compile_args=(),
+                 extra_link_args=()):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args)
+        self.extra_link_args = list(extra_link_args)
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
+         extra_ldflags=(), build_directory: Optional[str] = None,
+         verbose: bool = False) -> ctypes.CDLL:
+    """Compile `sources` with g++ into a cached .so and return the CDLL
+    (utils/cpp_extension.load analog; ctypes instead of pybind)."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    tag = hashlib.sha256(
+        ("\0".join(srcs) + repr(tuple(extra_cxx_cflags))).encode()
+    ).hexdigest()[:12]
+    so_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if not (os.path.exists(so_path) and os.path.getmtime(so_path) >= newest):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               *extra_cxx_cflags, *srcs, *extra_ldflags,
+               "-o", so_path + ".tmp"]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{e.stderr}") from e
+        os.replace(so_path + ".tmp", so_path)
+    return ctypes.CDLL(so_path)
+
+
+def as_custom_op(name: str, host_fn: Callable, out_shape_fn: Callable,
+                 out_dtype=np.float32, differentiable: bool = False):
+    """Register a HOST function (e.g. a ctypes-wrapped C++ routine) as a
+    framework op. ``host_fn(*np_arrays) -> np_array`` runs on the host via
+    ``jax.pure_callback``, so the op works in eager mode AND under jit
+    tracing (XLA inserts the host callback). ``out_shape_fn(*shapes) ->
+    shape``. Returns the user-facing op API.
+
+    Custom TPU-device kernels should be Pallas functions registered with
+    ``ops.registry.register_op`` directly; this wrapper is the C++ host-op
+    path (custom_op extension capability analog)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.registry import register_op
+
+    @register_op(name, differentiable=differentiable,
+                 ref="python/paddle/utils/cpp_extension (capability analog)")
+    def op(*args):
+        shapes = [tuple(np.shape(a)) for a in args]
+        out = jax.ShapeDtypeStruct(tuple(out_shape_fn(*shapes)),
+                                   np.dtype(out_dtype))
+        return jax.pure_callback(
+            lambda *xs: np.asarray(host_fn(*[np.asarray(x) for x in xs]),
+                                   dtype=out_dtype),
+            out, *args, vmap_method="sequential")
+
+    return op

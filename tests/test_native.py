@@ -153,3 +153,53 @@ def test_dataloader_num_workers():
     dl0 = DataLoader(ds, batch_size=4, shuffle=False, num_workers=0)
     for (x1, y1), (x0, y0) in zip(DataLoader(ds, batch_size=4, num_workers=2), dl0):
         np.testing.assert_array_equal(x1.numpy(), x0.numpy())
+
+
+def test_cpp_extension_load_and_custom_op(tmp_path):
+    """utils.cpp_extension: compile user C++ on the fly, bind via ctypes,
+    and lift it into the op registry (works eagerly AND under jit via
+    pure_callback). Reference python/paddle/utils/cpp_extension analog."""
+    import numpy as np
+
+    src = tmp_path / "myop.cpp"
+    src.write_text("""
+extern "C" void scale_add(const float* x, const float* y, float* out,
+                          int n, float alpha) {
+    for (int i = 0; i < n; ++i) out[i] = alpha * x[i] + y[i];
+}
+""")
+    from paddle_tpu.utils import cpp_extension
+
+    lib = cpp_extension.load("myop", [str(src)],
+                             build_directory=str(tmp_path))
+    import ctypes
+    lib.scale_add.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_float]
+
+    def scale_add_np(x, y, alpha=2.0):
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.ascontiguousarray(y, np.float32)
+        out = np.empty_like(x)
+        lib.scale_add(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      x.size, alpha)
+        return out
+
+    from paddle_tpu.ops.registry import OPS
+    op = cpp_extension.as_custom_op(
+        "my_scale_add", scale_add_np, lambda sx, sy: sx)
+    try:
+        import paddle_tpu as paddle
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        y = np.ones((2, 3), np.float32)
+        out = op(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), 2 * x + y)
+
+        # composes with jit tracing (pure_callback)
+        import jax
+        jout = jax.jit(OPS["my_scale_add"].impl)(x, y)
+        np.testing.assert_allclose(np.asarray(jout), 2 * x + y)
+    finally:
+        del OPS["my_scale_add"]  # keep the registry sweep deterministic
