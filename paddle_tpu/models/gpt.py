@@ -81,6 +81,13 @@ class GPTForCausalLM(nn.Layer):
         x = self.ln_f(x)
         return paddle.matmul(x, self.wte.weight.t())  # tied head
 
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        """Greedy/sampled decode (no-cache fallback; GenerationMixin
+        analog)."""
+        from paddle_tpu.nn.generation import generate_tokens
+        return generate_tokens(self, input_ids,
+                               max_new_tokens=max_new_tokens, **kwargs)
+
     def loss(self, input_ids, labels):
         logits = self(input_ids)
         V = logits.shape[-1]

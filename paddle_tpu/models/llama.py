@@ -235,6 +235,25 @@ class LlamaForCausalLM(nn.Layer):
         logits = self(input_ids)
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
 
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 max_len: Optional[int] = None, **kwargs):
+        """Decode with the compile-once KV-cache engine (GenerationMixin
+        surface; inference/generate.py). The decoder is cached on the
+        model, so repeated calls reuse the compiled executables."""
+        import numpy as np
+        from paddle_tpu.inference.generate import LlamaDecoder
+        need = int(np.asarray(input_ids).shape[1]) + max_new_tokens
+        ml = max_len or max(64, need)
+        dec = self.__dict__.get("_decoder")
+        if dec is None or dec.max_len < need:
+            # NOTE: the decoder snapshots the weights; it is rebuilt when a
+            # longer max_len is needed — call model.generate after training
+            # steps via a fresh model or drop model.__dict__['_decoder']
+            dec = LlamaDecoder(self, max_len=ml)
+            self.__dict__["_decoder"] = dec
+        return dec.generate(input_ids, max_new_tokens=max_new_tokens,
+                            **kwargs)
+
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
 

@@ -1,0 +1,53 @@
+"""High-level text generation mixin (paddle generation API analog:
+python/paddle/nn + PaddleNLP GenerationMixin surface).
+
+``generate_tokens(model, ...)`` works on ANY eager causal LM whose
+``forward(input_ids) -> (B, S, V) logits`` — a no-cache fallback usable by
+every model family. ``LlamaForCausalLM.generate`` overrides it with the
+compile-once KV-cache decoder (inference/generate.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["generate_tokens"]
+
+
+def generate_tokens(model, input_ids, max_new_tokens: int = 32,
+                    eos_token_id: Optional[int] = None,
+                    do_sample: bool = False, temperature: float = 1.0,
+                    top_k: Optional[int] = None, top_p: Optional[float] = None,
+                    seed: int = 0) -> np.ndarray:
+    """Autoregressive decode by re-running the full forward per token
+    (no-cache fallback; O(S^2) per sequence). Greedy or sampled."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.inference.generate import _sample_logits
+
+    ids = np.asarray(input_ids)
+    B = ids.shape[0]
+    key = jax.random.key(seed)
+    done = np.zeros((B,), bool)
+    with tape.no_grad():
+        for _ in range(max_new_tokens):
+            logits = model(paddle.to_tensor(ids)).value[:, -1].astype(
+                jnp.float32)
+            if do_sample:
+                key, sub = jax.random.split(key)
+                nxt = np.asarray(_sample_logits(logits, sub, temperature,
+                                                top_k, top_p))
+            else:
+                nxt = np.asarray(jnp.argmax(logits, axis=-1))
+            nxt = nxt.astype(ids.dtype)
+            if eos_token_id is not None:
+                nxt = np.where(done, eos_token_id, nxt)
+                done |= nxt == eos_token_id
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+            if eos_token_id is not None and done.all():
+                break
+    return ids

@@ -156,3 +156,28 @@ def test_sampled_decode_topk_topp():
     cold = dec.generate(prompt, max_new_tokens=6, do_sample=True,
                         temperature=1e-4, seed=4)
     np.testing.assert_array_equal(greedy, cold)
+
+
+def test_model_generate_api_llama_and_gpt():
+    """GenerationMixin surface: model.generate on both families; Llama
+    rides the KV-cache decoder, GPT the no-cache fallback — same tokens."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    model = _model(6)
+    prompt = np.array([[1, 2, 3]])
+    out = model.generate(prompt, max_new_tokens=5)
+    assert out.shape == (1, 8)
+    # KV decoder and the generic no-cache fallback agree token-for-token
+    from paddle_tpu.nn.generation import generate_tokens
+    ref = generate_tokens(model, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(out, ref)
+
+    paddle.seed(7)
+    gpt = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, dropout=0.0))
+    gpt.eval()
+    gout = gpt.generate(prompt, max_new_tokens=4)
+    assert gout.shape == (1, 7)
+    assert np.all((gout >= 0) & (gout < 64))
