@@ -243,14 +243,19 @@ class LlamaForCausalLM(nn.Layer):
         import numpy as np
         from paddle_tpu.inference.generate import LlamaDecoder
         need = int(np.asarray(input_ids).shape[1]) + max_new_tokens
-        ml = max_len or max(64, need)
+        if max_len is not None and max_len < need:
+            raise ValueError(f"max_len {max_len} < prompt + new tokens "
+                             f"({need})")
+        ml = max(64, need) if max_len is None else max_len
+        # the decoder snapshots weights: rebuild when any param buffer has
+        # been swapped since (optimizer step / set_state_dict)
+        version = tuple(id(p._value) for p in self.parameters())
         dec = self.__dict__.get("_decoder")
-        if dec is None or dec.max_len < need:
-            # NOTE: the decoder snapshots the weights; it is rebuilt when a
-            # longer max_len is needed — call model.generate after training
-            # steps via a fresh model or drop model.__dict__['_decoder']
+        if (dec is None or dec.max_len < need
+                or self.__dict__.get("_decoder_version") != version):
             dec = LlamaDecoder(self, max_len=ml)
             self.__dict__["_decoder"] = dec
+            self.__dict__["_decoder_version"] = version
         return dec.generate(input_ids, max_new_tokens=max_new_tokens,
                             **kwargs)
 

@@ -31,9 +31,19 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
 
     ids = np.asarray(input_ids)
     B = ids.shape[0]
+    max_pos = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+    if max_pos is not None and ids.shape[1] + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt {ids.shape[1]} + {max_new_tokens} new tokens exceeds "
+            f"max_position_embeddings {max_pos}")
     key = jax.random.key(seed)
     done = np.zeros((B,), bool)
-    with tape.no_grad():
+    was_training = getattr(model, "training", False)
+    if was_training:
+        model.eval()  # deterministic decode: no live dropout
+    try:
+      with tape.no_grad():
         for _ in range(max_new_tokens):
             logits = model(paddle.to_tensor(ids)).value[:, -1].astype(
                 jnp.float32)
@@ -50,4 +60,7 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
             if eos_token_id is not None and done.all():
                 break
+    finally:
+        if was_training:
+            model.train()
     return ids

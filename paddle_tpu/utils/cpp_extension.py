@@ -55,14 +55,15 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
         if not os.path.exists(s):
             raise FileNotFoundError(s)
     tag = hashlib.sha256(
-        ("\0".join(srcs) + repr(tuple(extra_cxx_cflags))).encode()
+        ("\0".join(srcs) + repr(tuple(extra_cxx_cflags))
+         + repr(tuple(extra_ldflags))).encode()
     ).hexdigest()[:12]
     so_path = os.path.join(build_dir, f"{name}_{tag}.so")
     newest = max(os.path.getmtime(s) for s in srcs)
     if not (os.path.exists(so_path) and os.path.getmtime(so_path) >= newest):
+        tmp = f"{so_path}.{os.getpid()}.tmp"  # unique: concurrent builders
         cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-               *extra_cxx_cflags, *srcs, *extra_ldflags,
-               "-o", so_path + ".tmp"]
+               *extra_cxx_cflags, *srcs, *extra_ldflags, "-o", tmp]
         if verbose:
             print("cpp_extension:", " ".join(cmd))
         try:
@@ -70,7 +71,7 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
         except subprocess.CalledProcessError as e:
             raise RuntimeError(
                 f"cpp_extension build failed:\n{e.stderr}") from e
-        os.replace(so_path + ".tmp", so_path)
+        os.replace(tmp, so_path)  # atomic publish
     return ctypes.CDLL(so_path)
 
 
