@@ -91,10 +91,11 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_avals",
-                 "closure", "hooks", "__weakref__")
+                 "closure", "hooks", "tuple_out", "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int,
-                 out_avals: Sequence[Tuple[tuple, Any]], closure: Optional[Callable] = None):
+                 out_avals: Sequence[Tuple[tuple, Any]], closure: Optional[Callable] = None,
+                 tuple_out: Optional[bool] = None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)  # list[Tensor]
@@ -102,6 +103,10 @@ class GradNode:
         self.out_avals = list(out_avals)  # [(shape, dtype)] per output
         self.closure = closure
         self.hooks: Optional[Dict[int, List[Callable]]] = None
+        # whether the recorded forward closure returned a tuple/list: the
+        # cotangent passed to vjp_fn must mirror that pytree even when there
+        # is a single output (e.g. to_static impls return 1-tuples)
+        self.tuple_out = n_outputs > 1 if tuple_out is None else tuple_out
 
     def add_hook(self, out_index: int, fn: Callable):
         if self.hooks is None:
@@ -158,7 +163,7 @@ def _taped_vjp(node: GradNode, cotangents: Sequence[Any]) -> List[Any]:
             "re-differentiable forward closure (PyLayer backward is opaque "
             "to the tape)")
     n_in = len(node.inputs)
-    multi = node.n_outputs > 1
+    multi = node.tuple_out
 
     def vjp_closure(*vals):
         primals, cts = vals[:n_in], vals[n_in:]
@@ -283,7 +288,7 @@ def _run_backward(
                     raise RuntimeError(
                         f"grad node {node.name} was already released; pass "
                         "retain_graph=True to backward() to allow a second backward pass")
-                in_grads = node.vjp_fn(tuple(cotangents) if node.n_outputs > 1
+                in_grads = node.vjp_fn(tuple(cotangents) if node.tuple_out
                                        else cotangents[0])
                 if not isinstance(in_grads, (tuple, list)):
                     in_grads = (in_grads,)

@@ -50,6 +50,13 @@ class StaticFunction:
             if hasattr(function, "__self__") and isinstance(function.__self__, Layer):
                 self._layer = function.__self__
         self._input_spec = input_spec
+        # BuildStrategy fuse/amp/decomposition switches -> jaxpr rewrite
+        # rules applied to the traced graph (passes/rewrite.py engine).
+        # Resolved lazily at first compile so strategy mutations after
+        # decoration still take effect (paddle reads it at build time).
+        self._build_strategy = build_strategy
+        self._pass_rules: list = []
+        self._rules_resolved = False
         try:
             functools.update_wrapper(self, self._fn)
         except Exception:
@@ -95,6 +102,25 @@ class StaticFunction:
 
         return impl
 
+    def _resolve_pass_rules(self) -> list:
+        # resolved ONCE, at the first compile: every cached specialization of
+        # this StaticFunction must share one rule set (mutating the strategy
+        # between calls would otherwise fork numerics across cache entries)
+        if self._rules_resolved:
+            return self._pass_rules
+        bs = self._build_strategy
+        if bs is not None:
+            if hasattr(bs, "build_rules"):
+                self._pass_rules = bs.build_rules()
+            elif isinstance(bs, (list, tuple)):
+                self._pass_rules = list(bs)
+            else:
+                raise TypeError(
+                    "build_strategy must be a static.BuildStrategy or a list "
+                    f"of rewrite rules, got {type(bs).__name__}")
+        self._rules_resolved = True
+        return self._pass_rules
+
     def __call__(self, *args, **kwargs):
         static_kwargs = tuple(sorted(kwargs.items()))
         training = self._layer.training if self._layer is not None else False
@@ -115,6 +141,10 @@ class StaticFunction:
             cell: dict = {}
             impl = self._make_impl(static_kwargs, training, len(state_tensors),
                                    state_names, cell)
+            rules = self._resolve_pass_rules()
+            if rules:
+                from paddle_tpu.passes.rewrite import rewrite as _rewrite
+                impl = _rewrite(impl, rules)
             jitted = jax.jit(impl, static_argnames=())
             opdef = OpDef(f"to_static<{getattr(self._fn, '__name__', 'fn')}>",
                           jitted, n_outputs=-1)

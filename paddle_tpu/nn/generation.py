@@ -39,28 +39,39 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
             f"max_position_embeddings {max_pos}")
     key = jax.random.key(seed)
     done = np.zeros((B,), bool)
-    was_training = getattr(model, "training", False)
-    if was_training:
+    # per-sublayer snapshot: a blanket model.train() on exit would clobber
+    # submodules the user deliberately froze with sub.eval(). Models are
+    # duck-typed (any callable with forward(ids)->logits): no Layer, no-op.
+    mode_snapshot = [(m, m.training) for m in _sublayers_with_self(model)
+                     if hasattr(m, "training")]
+    if hasattr(model, "eval"):
         model.eval()  # deterministic decode: no live dropout
     try:
-      with tape.no_grad():
-        for _ in range(max_new_tokens):
-            logits = model(paddle.to_tensor(ids)).value[:, -1].astype(
-                jnp.float32)
-            if do_sample:
-                key, sub = jax.random.split(key)
-                nxt = np.asarray(_sample_logits(logits, sub, temperature,
-                                                top_k, top_p))
-            else:
-                nxt = np.asarray(jnp.argmax(logits, axis=-1))
-            nxt = nxt.astype(ids.dtype)
-            if eos_token_id is not None:
-                nxt = np.where(done, eos_token_id, nxt)
-                done |= nxt == eos_token_id
-            ids = np.concatenate([ids, nxt[:, None]], axis=1)
-            if eos_token_id is not None and done.all():
-                break
+        with tape.no_grad():
+            for _ in range(max_new_tokens):
+                logits = model(paddle.to_tensor(ids)).value[:, -1].astype(
+                    jnp.float32)
+                if do_sample:
+                    key, sub = jax.random.split(key)
+                    nxt = np.asarray(_sample_logits(logits, sub, temperature,
+                                                    top_k, top_p))
+                else:
+                    nxt = np.asarray(jnp.argmax(logits, axis=-1))
+                nxt = nxt.astype(ids.dtype)
+                if eos_token_id is not None:
+                    nxt = np.where(done, eos_token_id, nxt)
+                    done |= nxt == eos_token_id
+                ids = np.concatenate([ids, nxt[:, None]], axis=1)
+                if eos_token_id is not None and done.all():
+                    break
     finally:
-        if was_training:
-            model.train()
+        for m, was in mode_snapshot:
+            m.training = was
     return ids
+
+
+def _sublayers_with_self(model):
+    out = [model]
+    if hasattr(model, "sublayers"):
+        out.extend(model.sublayers(include_self=False))
+    return out

@@ -1,0 +1,68 @@
+"""Fused RMSNorm as a single differentiable unit.
+
+Reference analog: the fused norm kernels in paddle/phi/kernels/fusion/
+(fused_rms_norm / rms_norm_kernel family) that paddle.incubate.nn.functional
+exposes. On TPU the fusion itself is a routing decision: XLA already fuses
+the elementwise chain, so the win is (a) one custom-vjp unit with a
+hand-written backward that recomputes the cheap statistics instead of
+saving them, and (b) a kernel boundary the pass framework
+(paddle_tpu/passes) can target when pattern-matching user-written
+compositions. A Pallas kernel can be slotted into ``_fwd_impl`` without
+touching callers.
+
+Semantics match nn.functional.rms_norm: statistics in f32, output in the
+promoted dtype of (x.dtype-normalized x) * w.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["rms_norm_fused"]
+
+
+def _stats(x, eps):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    inv = lax.rsqrt(ms + eps)
+    return xf, inv
+
+
+def _fwd_impl(x, w, eps):
+    xf, inv = _stats(x, eps)
+    y = (xf * inv).astype(x.dtype)
+    return y * w
+
+
+@jax.custom_vjp
+def rms_norm_fused(x, w, eps):
+    return _fwd_impl(x, w, eps)
+
+
+def _fwd(x, w, eps):
+    # save primals only; the f32 statistics are recomputed in the backward
+    # (cheaper than spilling an extra (rows,) f32 buffer through HBM)
+    return _fwd_impl(x, w, eps), (x, w, eps)
+
+
+def _bwd(res, g):
+    x, w, eps = res
+    xf, inv = _stats(x, eps)
+    y = xf * inv  # f32 normalized
+    gf = g.astype(jnp.float32)
+    wf = jnp.asarray(w).astype(jnp.float32)
+    dy = gf * wf
+    # d/dx of y = x * rsqrt(mean(x^2)+eps):
+    #   dx = inv * (dy - y * mean(dy * y, -1))
+    dx = inv * (dy - y * jnp.mean(dy * y, axis=-1, keepdims=True))
+    # the forward quantized the normalized activations to x.dtype before the
+    # w-multiply; dw must see the same quantization
+    dw = jnp.sum(gf * y.astype(x.dtype).astype(jnp.float32),
+                 axis=tuple(range(g.ndim - 1)))
+    return (dx.astype(x.dtype), dw.astype(jnp.asarray(w).dtype),
+            jnp.zeros_like(jnp.asarray(eps, dtype=jnp.float32)))
+
+
+rms_norm_fused.defvjp(_fwd, _bwd)

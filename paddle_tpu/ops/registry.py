@@ -200,7 +200,8 @@ def apply_op(opdef: OpDef, args: Sequence[Any], kwargs: Dict[str, Any]):
     vals = out_vals if isinstance(out_vals, (tuple, list)) else (out_vals,)
     out_avals = [(tuple(v.shape), jnp.dtype(v.dtype)) for v in vals]
     node = tape.GradNode(opdef.name, vjp_fn, tensors, len(vals), out_avals,
-                         closure=closure)
+                         closure=closure,
+                         tuple_out=isinstance(out_vals, (tuple, list)))
     return _wrap_outputs(opdef, out_vals, node=node)
 
 

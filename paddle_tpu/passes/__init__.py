@@ -1,0 +1,16 @@
+"""Graph pass / rewrite layer (reference pir::PassManager + DRR analog).
+
+See rewrite.py for the engine and library.py for the built-in rules."""
+
+from paddle_tpu.passes.rewrite import (EqnRule, MatchInfo, PassManager,
+                                       RewriteRule, dce_jaxpr, rewrite,
+                                       rewrite_jaxpr)
+from paddle_tpu.passes.library import (DEFAULT_DECOMPOSITIONS, amp_cast_rules,
+                                       decompose_rule, decomposition_rules,
+                                       fuse_rms_norm_rule)
+
+__all__ = [
+    "EqnRule", "MatchInfo", "PassManager", "RewriteRule", "dce_jaxpr",
+    "rewrite", "rewrite_jaxpr", "DEFAULT_DECOMPOSITIONS", "amp_cast_rules",
+    "decompose_rule", "decomposition_rules", "fuse_rms_norm_rule",
+]

@@ -1,0 +1,169 @@
+"""Built-in rewrite rules: fusion routing, AMP insertion, decomposition.
+
+These are the three pass families the reference implements over PIR —
+fusion patterns (paddle/fluid/pir/transforms/gpu/fused_*_pass.cc), the AMP
+pass (python/paddle/distributed/passes/auto_parallel_amp.py), and op
+decomposition (python/paddle/decomposition/) — re-expressed as jaxpr
+rewrite rules (see passes/rewrite.py for the engine).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import jax.extend.core as jex
+from jax import lax
+
+from paddle_tpu.passes.rewrite import EqnRule, MatchInfo, RewriteRule
+
+__all__ = [
+    "fuse_rms_norm_rule", "amp_cast_rules", "decompose_rule",
+    "DEFAULT_DECOMPOSITIONS", "decomposition_rules",
+]
+
+
+# --------------------------------------------------------------------------
+# fusion: rms_norm composition -> single custom-vjp unit
+# --------------------------------------------------------------------------
+
+def _rms_pattern(x, w):
+    # the exact composition nn.functional.rms_norm emits (f32 statistics)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(ms + 1e-6)).astype(x.dtype) * w
+
+
+def _rms_where(info: MatchInfo) -> bool:
+    x_aval = info.captures[0].aval
+    red = info.target_eqn("reduce_sum")
+    if tuple(red.params.get("axes", ())) != (len(x_aval.shape) - 1,):
+        return False
+    div = info.target_eqn("div")
+    d = div.invars[1]
+    if not isinstance(d, jex.Literal):
+        return False
+    try:
+        if float(d.val) != float(x_aval.shape[-1]):
+            return False
+    except TypeError:
+        return False
+    add = info.target_eqn("add")
+    return isinstance(add.invars[1], jex.Literal)
+
+
+def _rms_replace(info: MatchInfo) -> Callable:
+    from paddle_tpu.ops.fused_norm import rms_norm_fused
+
+    eps = float(info.target_eqn("add").invars[1].val)
+    return lambda x, w: rms_norm_fused(x, w, eps)
+
+
+def fuse_rms_norm_rule(hidden: int = 8) -> RewriteRule:
+    """Match x * rsqrt(mean(x^2)+eps) * w (any eps, any trailing width) and
+    replace it with ops.fused_norm.rms_norm_fused."""
+    f32 = jax.ShapeDtypeStruct((4, hidden), jnp.float32)
+    bf16 = jax.ShapeDtypeStruct((4, hidden), jnp.bfloat16)
+    wf32 = jax.ShapeDtypeStruct((hidden,), jnp.float32)
+    wbf16 = jax.ShapeDtypeStruct((hidden,), jnp.bfloat16)
+    return RewriteRule(
+        "fuse_rms_norm", _rms_pattern,
+        examples=[(bf16, wbf16), (f32, wf32), (bf16, wf32)],
+        replace=_rms_replace, where=_rms_where)
+
+
+# --------------------------------------------------------------------------
+# AMP: cast matmul/conv operands to a low-precision compute dtype
+# --------------------------------------------------------------------------
+
+def amp_cast_rules(compute_dtype: str = "bfloat16",
+                   prims: Sequence[str] = ("dot_general",
+                                           "conv_general_dilated")):
+    """Rewrite f32 matmuls/convs to compute in ``compute_dtype`` on the MXU
+    while keeping the f32 output dtype via preferred_element_type (the
+    auto_parallel_amp pass analog; numerics match TPU mixed precision)."""
+    dt = jnp.dtype(compute_dtype)
+
+    def make(prim_name: str) -> EqnRule:
+        def replace(eqn) -> Optional[Callable]:
+            if any(not hasattr(v.aval, "dtype")
+                   or v.aval.dtype != jnp.float32 for v in eqn.invars):
+                return None
+            out_dtype = eqn.outvars[0].aval.dtype
+            params = dict(eqn.params)
+            params["preferred_element_type"] = jnp.dtype(out_dtype)
+            prim = eqn.primitive
+
+            def build(*invals):
+                cast = [v.astype(dt) for v in invals]
+                out = prim.bind(*cast, **params)
+                return out
+
+            return build
+
+        return EqnRule(f"amp_cast_{prim_name}", prim_name, replace)
+
+    return [make(p) for p in prims]
+
+
+# --------------------------------------------------------------------------
+# decomposition: prim -> composition of simpler prims
+# --------------------------------------------------------------------------
+
+def decompose_rule(prim_name: str,
+                   builder_from_params: Callable[[dict], Callable],
+                   name: str = "") -> EqnRule:
+    """EqnRule that replaces every ``prim_name`` equation with the traceable
+    function ``builder_from_params(eqn.params)`` (python/paddle/decomposition
+    analog; used by the ONNX exporter to lower to a portable prim set)."""
+    return EqnRule(name or f"decompose_{prim_name}", prim_name,
+                   lambda eqn: builder_from_params(dict(eqn.params)))
+
+
+def _decomp_logistic(params):
+    return lambda x: 1.0 / (1.0 + jnp.exp(-x))
+
+
+def _decomp_softmax(params):
+    axis = params.get("axis", (-1,))
+
+    def f(x):
+        m = jnp.max(x, axis=axis, keepdims=True)
+        e = jnp.exp(x - lax.stop_gradient(m))
+        return e / jnp.sum(e, axis=axis, keepdims=True)
+
+    return f
+
+
+def _decomp_integer_pow(params):
+    y = params["y"]
+
+    def f(x):
+        if y == 0:
+            return jnp.ones_like(x)
+        inv = y < 0
+        n = -y if inv else y
+        out = x
+        for _ in range(int(n) - 1):
+            out = out * x
+        return 1.0 / out if inv else out
+
+    return f
+
+
+def _decomp_rsqrt(params):
+    return lambda x: 1.0 / jnp.sqrt(x)
+
+
+DEFAULT_DECOMPOSITIONS: Dict[str, Callable[[dict], Callable]] = {
+    "logistic": _decomp_logistic,
+    "softmax": _decomp_softmax,
+    "integer_pow": _decomp_integer_pow,
+    "rsqrt": _decomp_rsqrt,
+}
+
+
+def decomposition_rules(table: Optional[Dict[str, Callable]] = None):
+    table = DEFAULT_DECOMPOSITIONS if table is None else table
+    return [decompose_rule(k, v) for k, v in table.items()]

@@ -1,0 +1,424 @@
+"""Jaxpr pass/rewrite framework — the PIR transforms / DRR analog.
+
+The reference carries a full IR pass infrastructure: a pass manager over PIR
+(reference paddle/pir/include/pass/pass.h, paddle/fluid/pir/transforms/) and
+a declarative rewrite-rule layer, DRR, where a source pattern and a result
+pattern are both *described* and the engine does subgraph match + replace
+(reference paddle/fluid/pir/drr/README.md). Fusion routing, AMP insertion
+and op decomposition all ride that one mechanism.
+
+TPU-native redesign: the IR is the jaxpr that jax tracing already produces —
+we add the missing piece, a small pattern-match-and-rewrite engine over it.
+Both the source pattern and the replacement are plain traceable Python
+functions (the most natural "declarative" form in a functional tracer):
+
+    rule = RewriteRule(
+        "fuse_rms_norm",
+        pattern=lambda x, w: my_rms_norm_composition(x, w),
+        examples=[(f32[4, 8], f32[8])],      # avals to trace the pattern
+        replace=lambda info: fused_rms_norm,  # builder, given match info
+        where=check_axes,                     # optional semantic guard
+    )
+    fast_fn = rewrite(fn, [rule])             # or PassManager([...]).wrap(fn)
+
+Matching is structural (primitive names + def-use topology, rooted at the
+pattern's final equation); shapes and shape-dependent params are NOT
+compared — a rule's ``where`` predicate checks the semantic bits that
+matter (reduction axes, broadcast dims, literal values). Replacement splices
+the traced builder jaxpr in place of the anchor equation; orphaned producer
+equations are swept by a liveness DCE pass. Rewrites recurse into
+sub-jaxprs (pjit / scan / cond bodies) so rules apply under jit.
+
+Everything here is compile-time graph surgery on pure jax data structures;
+the rewritten jaxpr is executed with ``jax.core.eval_jaxpr`` and remains
+fully traceable (jit / grad / vmap compose on top).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.core as jcore
+import jax.extend.core as jex
+from jax.tree_util import tree_flatten, tree_structure, tree_unflatten
+
+__all__ = [
+    "RewriteRule", "EqnRule", "MatchInfo", "rewrite", "rewrite_jaxpr",
+    "dce_jaxpr", "PassManager",
+]
+
+
+class MatchInfo:
+    """What a successful pattern match captured.
+
+    captures  — target atoms bound to the pattern's free inputs, in the
+                pattern function's positional order.
+    eqns      — list of (pattern_eqn, target_eqn) pairs, anchor first.
+    literals  — list of (pattern_literal_value, target_literal_value) pairs
+                in match order (e.g. to recover an eps constant).
+    """
+
+    def __init__(self):
+        self.captures: List[Any] = []
+        self.eqns: List[Tuple[Any, Any]] = []
+        self.literals: List[Tuple[Any, Any]] = []
+
+    def target_eqn(self, prim_name: str, index: int = 0):
+        """The index-th matched target eqn with the given primitive name."""
+        hits = [te for pe, te in self.eqns if te.primitive.name == prim_name]
+        if index >= len(hits):
+            raise KeyError(f"no matched eqn #{index} for primitive {prim_name!r}")
+        return hits[index]
+
+
+class RewriteRule:
+    """Subgraph rewrite: ``pattern`` (a traceable fn) -> ``replace`` builder.
+
+    pattern   — pure function of N arrays; its trace (over each ``examples``
+                entry) is the source pattern. Must return a single array.
+    examples  — sequence of example-argument tuples (arrays or
+                ShapeDtypeStructs); one pattern variant is traced per entry
+                (e.g. a bf16 and an f32 variant differ by convert ops).
+    replace   — ``replace(info) -> callable(*captured_arrays)``; the callable
+                is traced at the match site and spliced in. Its output count
+                and avals must equal the anchor equation's.
+    where     — optional ``where(info) -> bool`` semantic guard.
+    """
+
+    def __init__(self, name: str, pattern: Callable, examples: Sequence[tuple],
+                 replace: Callable[[MatchInfo], Callable], where=None):
+        self.name = name
+        self.replace = replace
+        self.where = where
+        self.hits = 0  # successful applications (observability/tests)
+        self.patterns: List[Any] = []  # list of ClosedJaxpr
+        for ex in examples:
+            closed = jax.make_jaxpr(pattern)(*[_as_sds(a) for a in ex])
+            if len(closed.jaxpr.outvars) != 1:
+                raise ValueError(
+                    f"rule {name!r}: pattern must return a single array")
+            out = closed.jaxpr.outvars[0]
+            if not closed.jaxpr.eqns or not any(
+                    out is o for o in closed.jaxpr.eqns[-1].outvars):
+                raise ValueError(
+                    f"rule {name!r}: pattern output must come from its last "
+                    "equation (the match anchor)")
+            self.patterns.append(closed)
+
+
+class EqnRule:
+    """Single-equation rewrite keyed by primitive name (decompose/AMP form).
+
+    replace — ``replace(eqn) -> callable(*invals)`` traced and spliced in
+              place of the equation; None to leave this site untouched.
+    """
+
+    def __init__(self, name: str, prim_name: str,
+                 replace: Callable[[Any], Optional[Callable]], where=None):
+        self.name = name
+        self.prim_name = prim_name
+        self.replace = replace
+        self.where = where
+        self.hits = 0  # successful applications (observability/tests)
+
+
+def _as_sds(a):
+    if isinstance(a, jax.ShapeDtypeStruct):
+        return a
+    return jax.ShapeDtypeStruct(jax.numpy.shape(a), jax.numpy.asarray(a).dtype)
+
+
+def _same_atom(a, b) -> bool:
+    if isinstance(a, jex.Literal) or isinstance(b, jex.Literal):
+        if not (isinstance(a, jex.Literal) and isinstance(b, jex.Literal)):
+            return False
+        try:
+            return bool(a.val == b.val)
+        except Exception:
+            return False
+    return a is b
+
+
+class _GraphView:
+    def __init__(self, eqns):
+        self.producer: Dict[Any, int] = {}
+        for i, e in enumerate(eqns):
+            for o in e.outvars:
+                self.producer[o] = i
+
+
+def _prims_compatible(pe, te) -> bool:
+    """Structural primitive equality, plus known same-semantics spellings
+    (jnp.square traces to `square`, x**2 to `integer_pow[y=2]`)."""
+    pn, tn = pe.primitive.name, te.primitive.name
+    if len(pe.invars) != len(te.invars) or len(pe.outvars) != len(te.outvars):
+        return False
+    if pn == tn:
+        return True
+    if {pn, tn} == {"square", "integer_pow"}:
+        ip = pe if pn == "integer_pow" else te
+        return ip.params.get("y") == 2
+    return False
+
+
+def _match_at(pat_jaxpr, gv: _GraphView, eqns, anchor_idx: int) -> Optional[MatchInfo]:
+    """Unify the pattern (rooted at its last eqn) against eqns[anchor_idx]."""
+    pat_producer = {}
+    for e in pat_jaxpr.eqns:
+        for o in e.outvars:
+            pat_producer[o] = e
+    info = MatchInfo()
+    var_map: Dict[Any, Any] = {}
+    eqn_map: Dict[int, int] = {}
+
+    def unify_atom(pv, tv) -> bool:
+        if isinstance(pv, jex.Literal):
+            if not isinstance(tv, jex.Literal):
+                return False
+            info.literals.append((pv.val, tv.val))
+            return True
+        if pv in var_map:
+            return _same_atom(var_map[pv], tv)
+        pe = pat_producer.get(pv)
+        if pe is None:  # free pattern input: wildcard capture
+            var_map[pv] = tv
+            return True
+        if isinstance(tv, jex.Literal):
+            return False
+        ti = gv.producer.get(tv)
+        if ti is None:  # target var is a graph input; pattern expects a producer
+            return False
+        var_map[pv] = tv
+        return unify_eqn(pe, ti)
+
+    def unify_eqn(pe, ti: int) -> bool:
+        te = eqns[ti]
+        if not _prims_compatible(pe, te):
+            return False
+        if id(pe) in eqn_map:
+            return eqn_map[id(pe)] == ti
+        eqn_map[id(pe)] = ti
+        info.eqns.append((pe, te))
+        return all(unify_atom(pv, tv) for pv, tv in zip(pe.invars, te.invars))
+
+    if not unify_eqn(pat_jaxpr.eqns[-1], anchor_idx):
+        return None
+    # captures in pattern-invar order; a pattern input the trace dropped
+    # (unused) stays None
+    info.captures = [var_map.get(v) for v in pat_jaxpr.invars]
+    if any(c is None for c in info.captures):
+        return None
+    return info
+
+
+def _trace_builder(builder, captured):
+    avals = [jax.ShapeDtypeStruct(a.aval.shape, a.aval.dtype) for a in captured]
+    return jax.make_jaxpr(builder)(*avals)
+
+
+def _splice(builder_closed, captured, anchor_outvars):
+    """Return (eqns, constvars, consts) for the builder wired into the graph."""
+    bj = builder_closed.jaxpr
+    sub: Dict[Any, Any] = {}
+    for v, atom in zip(bj.invars, captured):
+        sub[v] = atom
+    if len(bj.outvars) != len(anchor_outvars):
+        raise ValueError("builder output arity != anchor output arity")
+    for bo, ao in zip(bj.outvars, anchor_outvars):
+        if not isinstance(bo, jex.Var) or bo in sub or bo not in _produced(bj):
+            # identity/passthrough builders can't be spliced in place
+            raise ValueError("builder outputs must be produced by builder eqns")
+        if tuple(bo.aval.shape) != tuple(ao.aval.shape) or \
+                bo.aval.dtype != ao.aval.dtype:
+            raise ValueError(
+                f"builder output aval {bo.aval} != anchor aval {ao.aval}")
+        sub[bo] = ao
+
+    def s(atom):
+        return sub.get(atom, atom) if isinstance(atom, jex.Var) else atom
+
+    new_eqns = []
+    for e in bj.eqns:
+        new_eqns.append(e.replace(invars=[s(v) for v in e.invars],
+                                  outvars=[s(v) for v in e.outvars]))
+    return new_eqns, list(bj.constvars), list(builder_closed.consts)
+
+
+def _produced(jaxpr):
+    out = set()
+    for e in jaxpr.eqns:
+        out.update(v for v in e.outvars if isinstance(v, jex.Var))
+    return out
+
+
+def _sub_jaxpr_params(params: dict):
+    """Yield (key, value) for params holding jaxprs (directly or in tuples)."""
+    for k, v in params.items():
+        if isinstance(v, (jex.ClosedJaxpr, jex.Jaxpr)):
+            yield k, v
+        elif isinstance(v, (tuple, list)) and v and all(
+                isinstance(x, (jex.ClosedJaxpr, jex.Jaxpr)) for x in v):
+            yield k, v
+
+
+def rewrite_jaxpr(closed, rules, recurse: bool = True, max_rounds: int = 10):
+    """Apply rewrite rules to a ClosedJaxpr until fixpoint; DCE at the end."""
+    jaxpr = closed.jaxpr
+    consts = list(closed.consts)
+    constvars = list(jaxpr.constvars)
+    eqns = list(jaxpr.eqns)
+
+    for _ in range(max_rounds):
+        changed = False
+        gv = _GraphView(eqns)
+        out: List[Any] = []
+        extra_constvars: List[Any] = []
+        extra_consts: List[Any] = []
+        for i, eqn in enumerate(eqns):
+            repl = _try_rules(rules, gv, eqns, i)
+            if repl is None:
+                out.append(eqn)
+                continue
+            rule, builder, captured = repl
+            try:
+                bclosed = _trace_builder(builder, captured)
+                new_eqns, cvars, cvals = _splice(bclosed, captured, eqn.outvars)
+            except ValueError:
+                out.append(eqn)
+                continue
+            rule.hits += 1
+            out.extend(new_eqns)
+            extra_constvars.extend(cvars)
+            extra_consts.extend(cvals)
+            changed = True
+        eqns = out
+        constvars += extra_constvars
+        consts += extra_consts
+        if not changed:
+            break
+
+    if recurse:
+        eqns = [_rewrite_sub_jaxprs(e, rules) for e in eqns]
+
+    new_jaxpr = _rebuild(jaxpr, constvars, eqns)
+    closed2 = jex.ClosedJaxpr(new_jaxpr, consts)
+    return dce_jaxpr(closed2)
+
+
+def _try_rules(rules, gv, eqns, i):
+    eqn = eqns[i]
+    for rule in rules:
+        if isinstance(rule, EqnRule):
+            if eqn.primitive.name != rule.prim_name:
+                continue
+            if rule.where is not None and not rule.where(eqn):
+                continue
+            builder = rule.replace(eqn)
+            if builder is None:
+                continue
+            return rule, builder, list(eqn.invars)
+        for pat in rule.patterns:
+            info = _match_at(pat.jaxpr, gv, eqns, i)
+            if info is None:
+                continue
+            if rule.where is not None and not rule.where(info):
+                continue
+            builder = rule.replace(info)
+            if builder is None:
+                continue
+            return rule, builder, info.captures
+    return None
+
+
+def _rewrite_sub_jaxprs(eqn, rules):
+    updates = {}
+    for k, v in _sub_jaxpr_params(eqn.params):
+        if isinstance(v, jex.ClosedJaxpr):
+            updates[k] = rewrite_jaxpr(v, rules)
+        elif isinstance(v, jex.Jaxpr):
+            updates[k] = rewrite_jaxpr(jex.ClosedJaxpr(v, []), rules).jaxpr
+        else:
+            updates[k] = type(v)(
+                rewrite_jaxpr(x, rules) if isinstance(x, jex.ClosedJaxpr)
+                else rewrite_jaxpr(jex.ClosedJaxpr(x, []), rules).jaxpr
+                for x in v)
+    if not updates:
+        return eqn
+    params = dict(eqn.params)
+    params.update(updates)
+    return eqn.replace(params=params)
+
+
+def _rebuild(template_jaxpr, constvars, eqns):
+    effects = frozenset().union(*[e.effects for e in eqns]) if eqns else frozenset()
+    return jex.Jaxpr(constvars, template_jaxpr.invars, template_jaxpr.outvars,
+                     eqns, effects=effects,
+                     debug_info=template_jaxpr.debug_info)
+
+
+def dce_jaxpr(closed):
+    """Liveness sweep: drop equations whose outputs are never used (keeps
+    effectful equations)."""
+    jaxpr = closed.jaxpr
+    live = {v for v in jaxpr.outvars if isinstance(v, jex.Var)}
+    kept = []
+    for eqn in reversed(jaxpr.eqns):
+        if eqn.effects or any(o in live for o in eqn.outvars):
+            kept.append(eqn)
+            live.update(v for v in eqn.invars if isinstance(v, jex.Var))
+    kept.reverse()
+    # drop now-unused consts too
+    constvars, consts = [], []
+    for v, c in zip(jaxpr.constvars, closed.consts):
+        if v in live:
+            constvars.append(v)
+            consts.append(c)
+    return jex.ClosedJaxpr(_rebuild(jaxpr, constvars, kept), consts)
+
+
+def rewrite(fn: Callable, rules: Sequence, recurse: bool = True) -> Callable:
+    """Wrap ``fn`` so every trace of it goes through the rewrite rules.
+
+    The wrapper traces ``fn`` to a jaxpr, rewrites it, and evaluates the
+    result; composing with jit/grad/vmap re-traces through this machinery,
+    so the rules always apply to the final program.
+    """
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        flat, in_tree = tree_flatten((args, kwargs))
+
+        def flat_fn(*leaves):
+            a, k = tree_unflatten(in_tree, leaves)
+            return fn(*a, **k)
+
+        closed, out_shape = jax.make_jaxpr(flat_fn, return_shape=True)(*flat)
+        closed = rewrite_jaxpr(closed, rules, recurse=recurse)
+        outs = jcore.eval_jaxpr(closed.jaxpr, closed.consts, *flat)
+        return tree_unflatten(tree_structure(out_shape), outs)
+
+    return wrapped
+
+
+class PassManager:
+    """Ordered pass pipeline (reference pir::PassManager analog): each entry
+    is a list of rules applied to fixpoint before the next entry runs."""
+
+    def __init__(self, stages: Sequence[Sequence]):
+        # accept a flat rule list or a list of stages
+        if stages and not isinstance(stages[0], (list, tuple)):
+            stages = [list(stages)]
+        self.stages = [list(s) for s in stages]
+
+    def run(self, closed):
+        for stage in self.stages:
+            closed = rewrite_jaxpr(closed, stage)
+        return closed
+
+    def wrap(self, fn: Callable) -> Callable:
+        out = fn
+        for stage in self.stages:
+            out = rewrite(out, stage)
+        return out

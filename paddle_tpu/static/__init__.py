@@ -21,7 +21,33 @@ from paddle_tpu.framework.tensor import Tensor
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "data", "Executor",
            "save_inference_model", "load_inference_model", "gradients",
-           "name_scope"]
+           "name_scope", "BuildStrategy"]
+
+
+class BuildStrategy:
+    """Graph-build options (paddle.static.BuildStrategy analog).
+
+    The reference's fuse_* switches turn on PIR fusion passes; here they
+    select jaxpr rewrite rules (paddle_tpu/passes) that jit.to_static
+    applies to the traced graph before XLA compilation. ``passes`` accepts
+    additional user rules (RewriteRule/EqnRule instances)."""
+
+    def __init__(self):
+        self.fuse_rms_norm = False
+        self.amp_dtype: Optional[str] = None   # e.g. "bfloat16"
+        self.decompositions: Optional[dict] = None
+        self.passes: list = []
+
+    def build_rules(self) -> list:
+        from paddle_tpu import passes as P
+        rules: list = list(self.passes)
+        if self.fuse_rms_norm:
+            rules.append(P.fuse_rms_norm_rule())
+        if self.amp_dtype:
+            rules.extend(P.amp_cast_rules(self.amp_dtype))
+        if self.decompositions is not None:
+            rules.extend(P.decomposition_rules(self.decompositions))
+        return rules
 
 
 class InputSpec:

@@ -68,10 +68,13 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
             print("cpp_extension:", " ".join(cmd))
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, so_path)  # atomic publish
         except subprocess.CalledProcessError as e:
             raise RuntimeError(
                 f"cpp_extension build failed:\n{e.stderr}") from e
-        os.replace(tmp, so_path)  # atomic publish
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return ctypes.CDLL(so_path)
 
 

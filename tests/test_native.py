@@ -68,7 +68,7 @@ def test_store_wait_timeout_no_overshoot():
 
 def _worker_barrier(host, port, world, idx, q):
     st = TCPStore(host=host, port=port, world_size=world)
-    st.barrier("b1", timeout=60)
+    st.barrier("b1", timeout=180)
     q.put(idx)
 
 
@@ -81,10 +81,12 @@ def test_store_barrier_multiprocess():
     for p in procs:
         p.start()
     time.sleep(0.5)
-    s.barrier("b1", timeout=60)  # third participant releases everyone
-    done = sorted(q.get(timeout=60) for _ in range(2))
+    # generous timeouts: spawn children re-import the test module, which can
+    # take tens of seconds when the suite saturates the machine with compiles
+    s.barrier("b1", timeout=180)  # third participant releases everyone
+    done = sorted(q.get(timeout=180) for _ in range(2))
     for p in procs:
-        p.join(timeout=5)
+        p.join(timeout=30)
     assert done == [0, 1]
 
 
