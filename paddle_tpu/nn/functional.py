@@ -572,13 +572,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
 
 @register_op("rms_norm")
 def rms_norm(x, weight=None, epsilon=1e-6):
-    """RMSNorm (no reference analog as a fused op; Llama-family requirement)."""
-    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
-    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    out = (xf * lax.rsqrt(ms + epsilon)).astype(x.dtype)
-    if weight is not None:
-        out = out * weight
-    return out
+    """RMSNorm (fused analog: paddle.incubate.nn.functional.fused_rms_norm,
+    paddle/phi/kernels/fusion/gpu/fused_rms_norm). Routes to the Pallas
+    kernel (ops/pallas/rms_norm.py) when shapes/flags allow."""
+    from paddle_tpu.ops.fused_norm import _pallas_ok, rms_lax, rms_norm_fused
+    if weight is not None and _pallas_ok(x, weight, epsilon):
+        return rms_norm_fused(x, weight, epsilon)
+    return rms_lax(x, weight, epsilon)
 
 
 @register_op("batch_norm_infer")

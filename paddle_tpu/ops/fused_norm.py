@@ -16,11 +16,23 @@ promoted dtype of (x.dtype-normalized x) * w.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["rms_norm_fused"]
+__all__ = ["rms_norm_fused", "rms_lax"]
+
+
+def rms_lax(x, w, eps):
+    """The canonical unfused composition — single source for the
+    nn.functional fallback AND the pass-framework source pattern
+    (passes/library._rms_pattern), so matcher and emitter stay in sync."""
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * lax.rsqrt(ms + eps)).astype(x.dtype)
+    return out * w if w is not None else out
 
 
 def _stats(x, eps):
@@ -30,25 +42,45 @@ def _stats(x, eps):
     return xf, inv
 
 
+def _pallas_ok(x, w, eps) -> bool:
+    from paddle_tpu.flags import flags
+    if not flags.use_fused_rms_norm or not isinstance(eps, (int, float)):
+        return False
+    from paddle_tpu.ops.pallas import rms_norm as k
+    return k.supported(jnp.shape(x), jnp.shape(w))
+
+
 def _fwd_impl(x, w, eps):
-    xf, inv = _stats(x, eps)
-    y = (xf * inv).astype(x.dtype)
-    return y * w
+    if _pallas_ok(x, w, eps):
+        from paddle_tpu.ops.pallas import rms_norm as k
+        return k.rms_fwd(x, w, eps)[0]
+    return rms_lax(x, w, eps)
 
 
-@jax.custom_vjp
+# eps is a static (nondiff) arg: as a traced operand it would be a Tracer
+# inside jit, silently failing _pallas_ok's concreteness check and routing
+# every compiled forward to the lax fallback
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def rms_norm_fused(x, w, eps):
     return _fwd_impl(x, w, eps)
 
 
 def _fwd(x, w, eps):
-    # save primals only; the f32 statistics are recomputed in the backward
-    # (cheaper than spilling an extra (rows,) f32 buffer through HBM)
-    return _fwd_impl(x, w, eps), (x, w, eps)
+    if _pallas_ok(x, w, eps):
+        from paddle_tpu.ops.pallas import rms_norm as k
+        out, inv = k.rms_fwd(x, w, eps)
+        return out, (x, w, inv)
+    # lax path: save primals only; the f32 statistics are recomputed in the
+    # backward (cheaper than spilling an extra (rows,) f32 buffer via HBM)
+    return _fwd_impl(x, w, eps), (x, w, None)
 
 
-def _bwd(res, g):
-    x, w, eps = res
+def _bwd(eps, res, g):
+    x, w, inv_res = res
+    if inv_res is not None:
+        from paddle_tpu.ops.pallas import rms_norm as k
+        dx, dw = k.rms_bwd(x, w, inv_res, g)
+        return dx, dw
     xf, inv = _stats(x, eps)
     y = xf * inv  # f32 normalized
     gf = g.astype(jnp.float32)
@@ -61,8 +93,7 @@ def _bwd(res, g):
     # w-multiply; dw must see the same quantization
     dw = jnp.sum(gf * y.astype(x.dtype).astype(jnp.float32),
                  axis=tuple(range(g.ndim - 1)))
-    return (dx.astype(x.dtype), dw.astype(jnp.asarray(w).dtype),
-            jnp.zeros_like(jnp.asarray(eps, dtype=jnp.float32)))
+    return dx.astype(x.dtype), dw.astype(jnp.asarray(w).dtype)
 
 
 rms_norm_fused.defvjp(_fwd, _bwd)

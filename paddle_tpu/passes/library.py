@@ -29,10 +29,10 @@ __all__ = [
 # --------------------------------------------------------------------------
 
 def _rms_pattern(x, w):
-    # the exact composition nn.functional.rms_norm emits (f32 statistics)
-    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
-    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    return (xf * lax.rsqrt(ms + 1e-6)).astype(x.dtype) * w
+    # the exact composition nn.functional.rms_norm emits (single source:
+    # ops/fused_norm.rms_lax keeps matcher and emitter in sync)
+    from paddle_tpu.ops.fused_norm import rms_lax
+    return rms_lax(x, w, 1e-6)
 
 
 def _rms_where(info: MatchInfo) -> bool:
@@ -50,7 +50,20 @@ def _rms_where(info: MatchInfo) -> bool:
     except TypeError:
         return False
     add = info.target_eqn("add")
-    return isinstance(add.invars[1], jex.Literal)
+    if not isinstance(add.invars[1], jex.Literal):
+        return False
+    # structural matching ignores params: the weight's broadcast must map it
+    # onto the LAST axis (w[:, None]-style per-row scaling would otherwise
+    # match on square activations and silently corrupt numerics)
+    w_atom = info.captures[1]
+    for _, te in info.eqns:
+        if (te.primitive.name == "broadcast_in_dim"
+                and any(v is w_atom for v in te.invars)):
+            out_ndim = len(te.outvars[0].aval.shape)
+            if tuple(te.params.get("broadcast_dimensions", ())) != \
+                    (out_ndim - 1,):
+                return False
+    return True
 
 
 def _rms_replace(info: MatchInfo) -> Callable:

@@ -333,6 +333,11 @@ def _try_rules(rules, gv, eqns, i):
 
 
 def _rewrite_sub_jaxprs(eqn, rules):
+    # never rewrite inside custom-differentiation bodies: their fwd/bwd pair
+    # must stay consistent, and a rule whose replacement falls back to the
+    # very composition it matched would re-fuse its own body forever
+    if eqn.primitive.name.startswith("custom_"):
+        return eqn
     updates = {}
     for k, v in _sub_jaxpr_params(eqn.params):
         if isinstance(v, jex.ClosedJaxpr):

@@ -84,6 +84,26 @@ def test_fuse_rms_norm_rejects_wrong_axis_and_wrong_divisor():
                        for e in j.jaxpr.eqns)
 
 
+def test_fuse_rms_norm_rejects_per_row_weight_broadcast():
+    # square activations + w[:, None]: structurally identical to the pattern
+    # but scales rows, not columns — the where-guard must reject it
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+
+    def per_row(x, w):
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(ms + 1e-6)) * w[:, None]
+
+    fast = P.rewrite(per_row, [P.fuse_rms_norm_rule()])
+    j = jax.make_jaxpr(fast)(x, w)
+    assert not any(e.primitive.name == "custom_vjp_call"
+                   for e in j.jaxpr.eqns)
+    np.testing.assert_allclose(np.asarray(fast(x, w)),
+                               np.asarray(per_row(x, w)),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_fuse_applies_inside_jit_and_scan():
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
