@@ -21,6 +21,8 @@ from paddle_tpu.framework.device import (  # noqa: F401
     set_device, synchronize,
 )
 from paddle_tpu.framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from paddle_tpu.framework.tensor_array import (  # noqa: F401
+    TensorArray, array_length, array_read, array_write, create_array)
 from paddle_tpu.autograd.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 
 # op surface: paddle_tpu.matmul(...), paddle_tpu.add(...), ...

@@ -1,0 +1,96 @@
+"""TensorArray — dynamic tensor list (N5 gap; reference LoDTensorArray).
+
+Reference analog: the LoDTensorArray variable type plus the array ops
+(paddle/fluid/operators/array_operator.h, python surface
+python/paddle/tensor/array.py: array_length/array_read/array_write/
+create_array). Used by while-loop style decoding and RNN unrolls.
+
+TPU-native form: an eager Python list of Tensors with the paddle API on
+top. Under jit tracing a TensorArray works whenever its length is
+trace-static (the usual case: bounded unrolls); for fully dynamic lengths
+inside one compiled graph, use lax.scan-style loops (jit/to_static) — the
+same boundary the reference draws between LoDTensorArray and while_op.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["TensorArray", "create_array", "array_length", "array_read",
+           "array_write"]
+
+
+class TensorArray:
+    """Append/read/write list of same-rank Tensors with stack/concat exits."""
+
+    def __init__(self, values: Optional[List[Tensor]] = None):
+        self._items: List[Tensor] = list(values or [])
+
+    # -- paddle array API ---------------------------------------------------
+    def append(self, x) -> "TensorArray":
+        self._items.append(_as_tensor(x))
+        return self
+
+    def write(self, index: int, x) -> "TensorArray":
+        index = int(index)
+        if index == len(self._items):
+            self._items.append(_as_tensor(x))
+        elif index < len(self._items):
+            self._items[index] = _as_tensor(x)
+        else:  # paddle semantics: grow with zeros-like up to index
+            filler = _as_tensor(x)
+            while len(self._items) < index:
+                self._items.append(Tensor(jnp.zeros_like(filler._value)))
+            self._items.append(filler)
+        return self
+
+    def read(self, index: int) -> Tensor:
+        return self._items[int(index)]
+
+    def pop(self, index: int = -1) -> Tensor:
+        return self._items.pop(int(index))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __getitem__(self, index):
+        return self._items[index]
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def stack(self, axis: int = 0) -> Tensor:
+        import paddle_tpu as paddle
+        return paddle.stack(list(self._items), axis=axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        import paddle_tpu as paddle
+        return paddle.concat(list(self._items), axis=axis)
+
+
+def _as_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def create_array(dtype="float32", initialized_list=None) -> TensorArray:
+    """python/paddle/tensor/array.py:create_array analog."""
+    return TensorArray([_as_tensor(v) for v in (initialized_list or [])])
+
+
+def array_length(array: TensorArray) -> Tensor:
+    return Tensor(jnp.asarray(len(array)))
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    return array.read(int(i.numpy()) if isinstance(i, Tensor) else int(i))
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    array.write(int(i.numpy()) if isinstance(i, Tensor) else int(i), x)
+    return array

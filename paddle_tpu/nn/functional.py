@@ -462,6 +462,19 @@ def adaptive_avg_pool1d(x, output_size):
     return jnp.mean(jnp.reshape(x, x.shape[:-1] + (out, n // out)), axis=-1)
 
 
+def _adaptive_pool_matrix(n_in: int, n_out: int, dtype):
+    """(n_out, n_in) averaging matrix with torch/paddle adaptive windows
+    (start = floor(i*n/o), end = ceil((i+1)*n/o)); pooling becomes a small
+    matmul, which is the MXU-friendly general (non-divisible) form."""
+    import numpy as np
+    m = np.zeros((n_out, n_in), dtype=np.float32)
+    for i in range(n_out):
+        s = (i * n_in) // n_out
+        e = -(-((i + 1) * n_in) // n_out)  # ceil
+        m[i, s:e] = 1.0 / (e - s)
+    return jnp.asarray(m, dtype=dtype)
+
+
 @register_op("adaptive_avg_pool2d")
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     if isinstance(output_size, int):
@@ -469,12 +482,26 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     oh, ow = output_size
     if data_format == "NCHW":
         n_, c, h, w = x.shape
-        assert h % oh == 0 and w % ow == 0, "adaptive pool requires divisible sizes"
-        r = jnp.reshape(x, (n_, c, oh, h // oh, ow, w // ow))
-        return jnp.mean(r, axis=(3, 5))
+        if h % oh == 0 and w % ow == 0:  # fast path: plain reshape-mean
+            r = jnp.reshape(x, (n_, c, oh, h // oh, ow, w // ow))
+            return jnp.mean(r, axis=(3, 5))
+        cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+        mh = _adaptive_pool_matrix(h, oh, cdt)
+        mw = _adaptive_pool_matrix(w, ow, cdt)
+        # highest precision: default TPU matmul quantizes to bf16, which
+        # would put ~3e-3 error into a pooling average
+        out = jnp.einsum("nchw,oh,pw->ncop", x.astype(cdt), mh, mw,
+                         precision="highest")
+        return out.astype(x.dtype)
     n_, h, w, c = x.shape
-    r = jnp.reshape(x, (n_, oh, h // oh, ow, w // ow, c))
-    return jnp.mean(r, axis=(2, 4))
+    if h % oh == 0 and w % ow == 0:
+        r = jnp.reshape(x, (n_, oh, h // oh, ow, w // ow, c))
+        return jnp.mean(r, axis=(2, 4))
+    cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    mh = _adaptive_pool_matrix(h, oh, cdt)
+    mw = _adaptive_pool_matrix(w, ow, cdt)
+    return jnp.einsum("nhwc,oh,pw->nopc", x.astype(cdt), mh, mw,
+                      precision="highest").astype(x.dtype)
 
 
 @register_op("adaptive_max_pool2d")
@@ -1118,3 +1145,17 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     rest = r[:, :, 2 * fold:]
     out = jnp.concatenate([left, right, rest], axis=2)
     return jnp.reshape(out, (nt, c, h, w))
+
+
+# schema-codegen'd losses + vision ops re-exported on the functional surface
+# (defined once in ops/schema_defs.py; see ops/schema.py for the fan-out)
+from paddle_tpu.ops.schema_defs import (  # noqa: E402
+    affine_grid, channel_shuffle, dice_loss, grid_sample, huber_loss,
+    log_loss, multi_label_soft_margin_loss, npair_loss, pdist,
+    soft_margin_loss)
+
+__all__ += [
+    "affine_grid", "channel_shuffle", "dice_loss", "grid_sample",
+    "huber_loss", "log_loss", "multi_label_soft_margin_loss", "npair_loss",
+    "pdist", "soft_margin_loss",
+]

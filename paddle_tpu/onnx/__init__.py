@@ -1,16 +1,14 @@
-"""paddle_tpu.onnx (python/paddle/onnx/export.py analog).
+"""paddle_tpu.onnx (python/paddle/onnx/export.py analog) — in-tree.
 
-The reference is a thin wrapper over the external paddle2onnx package; the
-TPU-native serving path is paddle.static.save_inference_model (compiled
-XLA executables), so ONNX export delegates to jax2onnx-style converters
-when installed and raises a clear error otherwise.
+Unlike the reference (a thin wrapper over the external paddle2onnx wheel),
+export here is self-contained: jaxpr trace -> inline/decompose passes ->
+ONNX node mapping -> hand-rolled protobuf serialization (onnx/proto.py).
+onnx/runtime.py executes the exported bytes with numpy for verification.
+Covers feed-forward/conv model families; unsupported primitives raise
+with the primitive named.
 """
 
-__all__ = ["export"]
+from paddle_tpu.onnx.export import export, to_model_bytes  # noqa: F401
+from paddle_tpu.onnx.runtime import parse_model, run_model  # noqa: F401
 
-
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export requires an external converter (the reference wraps "
-        "paddle2onnx the same way); use paddle_tpu.static.save_inference_model "
-        "or paddle_tpu.jit.save for the TPU-native serving path")
+__all__ = ["export", "to_model_bytes", "parse_model", "run_model"]

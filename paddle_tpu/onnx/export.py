@@ -1,0 +1,496 @@
+"""ONNX export: trace a Layer to jaxpr, lower, and serialize ModelProto.
+
+Reference analog: python/paddle/onnx/export.py — which shells out to the
+external paddle2onnx wheel. Here the full pipeline is in-tree: the model is
+traced to a jaxpr (the same trace jit uses), call-like equations (pjit /
+custom_vjp bodies) are inlined, composite prims are decomposed by the pass
+framework (passes/library.decomposition_rules), and the remaining base
+prims map 1:1 onto ONNX ops, serialized with the dependency-free wire
+writer in onnx/proto.py.
+
+Covers the feed-forward/conv model families (Linear/Conv/Pool/Norm/
+activation/softmax chains — LeNet, MLPs, VGG-style nets). Ops outside the
+mapping raise with the offending primitive named. onnx/runtime.py can
+execute the exported bytes with numpy for verification.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.onnx.proto import Msg
+
+__all__ = ["export", "to_model_bytes"]
+
+_DTYPE = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+          "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+          "bfloat16": 16}
+
+
+def _dt(dtype) -> int:
+    name = str(dtype)
+    if name in _DTYPE:
+        return _DTYPE[name]
+    # substring fallback, longest names first so 'bfloat16' wins over
+    # 'float16' (BFLOAT16=16 vs FLOAT16=10)
+    for k in sorted(_DTYPE, key=len, reverse=True):
+        if k in name:
+            return _DTYPE[k]
+    raise ValueError(f"no ONNX dtype for {dtype}")
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> Msg:
+    t = Msg()
+    for d in arr.shape:
+        t.int64(1, d)
+    t.int64(2, _dt(arr.dtype))
+    t.string(8, name)
+    t.bytes_(9, np.ascontiguousarray(arr).tobytes())
+    return t
+
+
+def _value_info(name: str, shape, dtype) -> Msg:
+    dim_msgs = Msg()
+    tt = Msg()
+    tt.int64(1, _dt(dtype))
+    shp = Msg()
+    for d in shape:
+        shp.msg(1, Msg().int64(1, int(d)))
+    tt.msg(2, shp)
+    tp = Msg()
+    tp.msg(1, tt)
+    del dim_msgs
+    return Msg().string(1, name).msg(2, tp)
+
+
+def _attr_i(name: str, v: int) -> Msg:
+    return Msg().string(1, name).int64(3, int(v)).int64(20, 2)
+
+
+def _attr_f(name: str, v: float) -> Msg:
+    return Msg().string(1, name).float32(2, float(v)).int64(20, 1)
+
+
+def _attr_ints(name: str, vs) -> Msg:
+    m = Msg().string(1, name)
+    for v in vs:
+        m.int64(8, int(v))
+    m.int64(20, 7)
+    return m
+
+
+class _Graph:
+    def __init__(self):
+        self.nodes: List[Msg] = []
+        self.initializers: List[Msg] = []
+        self.names: Dict[int, str] = {}  # id(var) -> name
+        self.counter = 0
+        self._const_cache: Dict[bytes, str] = {}
+
+    def name_of(self, var) -> str:
+        key = id(var)
+        if key not in self.names:
+            self.counter += 1
+            self.names[key] = f"t{self.counter}"
+        return self.names[key]
+
+    def const(self, arr: np.ndarray, hint: str = "c") -> str:
+        arr = np.asarray(arr)
+        cache_key = arr.tobytes() + str(arr.dtype).encode() + str(arr.shape).encode()
+        if cache_key in self._const_cache:
+            return self._const_cache[cache_key]
+        self.counter += 1
+        name = f"{hint}{self.counter}"
+        self.initializers.append(_tensor_proto(name, arr))
+        self._const_cache[cache_key] = name
+        return name
+
+    def node(self, op_type: str, inputs: List[str], outputs: List[str],
+             attrs: List[Msg] = ()):
+        n = Msg()
+        for i in inputs:
+            n.string(1, i)
+        for o in outputs:
+            n.string(2, o)
+        n.string(3, f"{op_type}_{len(self.nodes)}")
+        n.string(4, op_type)
+        for a in attrs:
+            n.msg(5, a)
+        self.nodes.append(n)
+
+    def atom(self, a) -> str:
+        """Var -> assigned name; Literal -> constant initializer."""
+        import jax.extend.core as jex
+
+        if isinstance(a, jex.Literal):
+            val = np.asarray(a.val)
+            if val.dtype == np.dtype("bfloat16") if hasattr(val, "dtype") else False:
+                val = val.astype(np.float32)
+            return self.const(val)
+        return self.name_of(a)
+
+
+def _alias_eqn(src, dst):
+    """A real identity equation src -> dst (mul by one / and with True),
+    keeping the jaxpr well-formed when a call output is a passthrough."""
+    import jax
+    import jax.extend.core as jex
+    import jax.numpy as jnp
+
+    aval = dst.aval
+    if np.dtype(aval.dtype) == np.bool_:
+        fn = lambda x: jnp.logical_and(x, np.bool_(True))  # noqa: E731
+    else:
+        one = np.ones((), dtype=aval.dtype)
+        fn = lambda x: x * one  # noqa: E731
+    traced = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct(aval.shape, aval.dtype))
+    ae = traced.jaxpr.eqns[0]
+    new_in = [src if isinstance(v, jex.Var) else v for v in ae.invars]
+    return ae.replace(invars=new_in, outvars=[dst])
+
+
+def _inline_calls(closed):
+    """Splice pjit / custom_vjp/jvp / closed_call bodies into the top-level
+    equation list so the mapper only sees base primitives."""
+    import jax.extend.core as jex
+
+    jaxpr = closed.jaxpr
+    consts = list(closed.consts)
+    constvars = list(jaxpr.constvars)
+    changed = True
+    eqns = list(jaxpr.eqns)
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        out = []
+        for eqn in eqns:
+            sub = None
+            n_skip = 0
+            p = eqn.primitive.name
+            if p in ("jit", "pjit", "closed_call", "core_call", "remat",
+                     "checkpoint"):
+                sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            elif p in ("custom_vjp_call", "custom_jvp_call",
+                       "custom_vjp_call_jaxpr"):
+                sub = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+                n_skip = int(eqn.params.get("num_consts", 0))
+            if sub is None:
+                out.append(eqn)
+                continue
+            changed = True
+            if isinstance(sub, jex.ClosedJaxpr):
+                sub_jaxpr, sub_consts = sub.jaxpr, list(sub.consts)
+            else:
+                sub_jaxpr, sub_consts = sub, []
+            sub_map = {}
+            for v, c in zip(sub_jaxpr.constvars, sub_consts):
+                constvars.append(v)
+                consts.append(c)
+            for v, a in zip(sub_jaxpr.invars, eqn.invars[n_skip:]):
+                sub_map[v] = a
+            produced = set()
+            for se in sub_jaxpr.eqns:
+                produced.update(v for v in se.outvars
+                                if isinstance(v, jex.Var))
+            # map body-produced outvars to the call's outvars; outputs that
+            # pass an input (or literal) through need an explicit alias eqn
+            # AFTER the body — mapping them would clobber the invar binding
+            # and make body eqns read the not-yet-defined output var
+            alias_pairs = []
+            for v, a in zip(sub_jaxpr.outvars, eqn.outvars):
+                if isinstance(v, jex.Var) and v in produced \
+                        and v not in sub_map:
+                    sub_map[v] = a
+                else:
+                    src = sub_map.get(v, v) if isinstance(v, jex.Var) else v
+                    alias_pairs.append((src, a))
+
+            def s(x):
+                return sub_map.get(x, x) if isinstance(x, jex.Var) else x
+
+            for se in sub_jaxpr.eqns:
+                out.append(se.replace(invars=[s(v) for v in se.invars],
+                                      outvars=[s(v) for v in se.outvars]))
+            for src, a in alias_pairs:
+                out.append(_alias_eqn(src, a))
+        eqns = out
+    new = jex.Jaxpr(constvars, jaxpr.invars, jaxpr.outvars, eqns,
+                    debug_info=jaxpr.debug_info)
+    return jex.ClosedJaxpr(new, consts)
+
+
+# --------------------------------------------------------------------------
+# primitive -> ONNX node mapping
+# --------------------------------------------------------------------------
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "max": "Max",
+    "min": "Min", "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "sqrt": "Sqrt", "abs": "Abs", "erf": "Erf", "pow": "Pow",
+    "floor": "Floor", "ceil": "Ceil", "sign": "Sign", "sin": "Sin",
+    "cos": "Cos", "stop_gradient": "Identity", "copy": "Identity",
+    "squeeze": None, "not": "Not", "and": "And", "or": "Or",
+    "eq": "Equal", "lt": "Less", "gt": "Greater",
+    "le": "LessOrEqual", "ge": "GreaterOrEqual",
+}
+
+
+def _map_eqn(g: _Graph, eqn) -> None:
+    p = eqn.primitive.name
+    ins = [g.atom(a) for a in eqn.invars]
+    outs = [g.name_of(o) for o in eqn.outvars]
+    params = eqn.params
+
+    if p in _SIMPLE and _SIMPLE[p]:
+        g.node(_SIMPLE[p], ins, outs)
+    elif p in ("reshape", "squeeze", "expand_dims"):
+        shape = [int(d) for d in eqn.outvars[0].aval.shape]
+        g.node("Reshape", [ins[0], g.const(np.asarray(shape, np.int64),
+                                           "shape")], outs)
+    elif p == "transpose":
+        g.node("Transpose", ins, outs,
+               [_attr_ints("perm", params["permutation"])])
+    elif p == "broadcast_in_dim":
+        in_shape = eqn.invars[0].aval.shape
+        out_shape = [int(d) for d in eqn.outvars[0].aval.shape]
+        bdims = params["broadcast_dimensions"]
+        mid = [1] * len(out_shape)
+        for src_dim, dst_dim in enumerate(bdims):
+            mid[dst_dim] = int(in_shape[src_dim])
+        cur = ins[0]
+        if list(mid) != list(in_shape):
+            r = f"{outs[0]}_rs"
+            g.node("Reshape", [cur, g.const(np.asarray(mid, np.int64),
+                                            "shape")], [r])
+            cur = r
+        g.node("Expand", [cur, g.const(np.asarray(out_shape, np.int64),
+                                       "shape")], outs)
+    elif p in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[p]
+        axes = list(params["axes"])
+        # opset 13: ReduceSum takes axes as input; Max/Min still attribute
+        if op == "ReduceSum":
+            g.node(op, [ins[0], g.const(np.asarray(axes, np.int64), "axes")],
+                   outs, [_attr_i("keepdims", 0)])
+        else:
+            g.node(op, ins, outs,
+                   [_attr_ints("axes", axes), _attr_i("keepdims", 0)])
+    elif p == "convert_element_type":
+        g.node("Cast", ins, outs,
+               [_attr_i("to", _dt(params["new_dtype"]))])
+    elif p == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        # jax: cases[which]; which==True -> cases[1]. ONNX Where(c, X, Y)=X@true
+        g.node("Where", [ins[0], ins[2], ins[1]], outs)
+    elif p == "integer_pow":
+        y = int(params["y"])
+        g.node("Pow", [ins[0], g.const(np.asarray(
+            y, _np_dtype(eqn.invars[0].aval.dtype)))], outs)
+    elif p == "dot_general":
+        ((lc, rc), (lb, rb)) = params["dimension_numbers"]
+        lhs_ndim = len(eqn.invars[0].aval.shape)
+        if (not lb and not rb and tuple(lc) == (lhs_ndim - 1,)
+                and tuple(rc) == (0,)):
+            g.node("MatMul", ins, outs)
+        else:
+            raise NotImplementedError(
+                f"dot_general dims {params['dimension_numbers']}")
+    elif p == "conv_general_dilated":
+        dn = params["dimension_numbers"]
+        if dn.lhs_spec != (0, 1, 2, 3) or dn.rhs_spec != (0, 1, 2, 3) or \
+                dn.out_spec != (0, 1, 2, 3):
+            raise NotImplementedError(f"conv layout {dn}")
+        pads = params["padding"]
+        g.node("Conv", ins, outs, [
+            _attr_ints("strides", params["window_strides"]),
+            _attr_ints("dilations", params["rhs_dilation"]),
+            _attr_ints("pads", [pads[0][0], pads[1][0],
+                                pads[0][1], pads[1][1]]),
+            _attr_i("group", params["feature_group_count"]),
+        ])
+    elif p in ("reduce_window_max", "reduce_window_sum"):
+        wd = params["window_dimensions"]
+        ws = params["window_strides"]
+        pads = params["padding"]
+        if len(wd) != 4 or wd[0] != 1 or wd[1] != 1:
+            raise NotImplementedError(f"pool window {wd}")
+        attrs = [_attr_ints("kernel_shape", wd[2:]),
+                 _attr_ints("strides", ws[2:]),
+                 _attr_ints("pads", [pads[2][0], pads[3][0],
+                                     pads[2][1], pads[3][1]])]
+        if p == "reduce_window_max":
+            g.node("MaxPool", ins, outs, attrs)
+        else:
+            tmp = f"{outs[0]}_avg"
+            g.node("AveragePool", ins, [tmp],
+                   attrs + [_attr_i("count_include_pad", 1)])
+            k = float(wd[2] * wd[3])
+            g.node("Mul", [tmp, g.const(np.asarray(
+                k, _np_dtype(eqn.invars[0].aval.dtype)))], outs)
+    elif p == "concatenate":
+        g.node("Concat", ins, outs, [_attr_i("axis", params["dimension"])])
+    elif p == "slice":
+        starts = list(params["start_indices"])
+        ends = list(params["limit_indices"])
+        steps = list(params["strides"] or [1] * len(starts))
+        axes = list(range(len(starts)))
+        g.node("Slice", [ins[0],
+                         g.const(np.asarray(starts, np.int64), "st"),
+                         g.const(np.asarray(ends, np.int64), "en"),
+                         g.const(np.asarray(axes, np.int64), "ax"),
+                         g.const(np.asarray(steps, np.int64), "sp")], outs)
+    elif p == "rsqrt":
+        tmp = f"{outs[0]}_sq"
+        g.node("Sqrt", ins, [tmp])
+        g.node("Div", [g.const(np.asarray(
+            1, _np_dtype(eqn.invars[0].aval.dtype))), tmp], outs)
+    elif p == "logistic":
+        g.node("Sigmoid", ins, outs)
+    elif p == "square":
+        g.node("Mul", [ins[0], ins[0]], outs)
+    elif p == "argmax":
+        g.node("ArgMax", ins, outs, [
+            _attr_i("axis", params["axes"][0]), _attr_i("keepdims", 0)])
+    elif p == "iota":
+        aval = eqn.outvars[0].aval
+        rng = np.arange(aval.shape[params["dimension"]],
+                        dtype=_np_dtype(aval.dtype))
+        shape = [1] * len(aval.shape)
+        shape[params["dimension"]] = -1
+        arr = np.broadcast_to(rng.reshape(shape), aval.shape)
+        g.node("Identity", [g.const(np.ascontiguousarray(arr), "iota")], outs)
+    else:
+        raise NotImplementedError(
+            f"ONNX export: no mapping for primitive {p!r} "
+            f"(params={list(params)})")
+
+
+def _np_dtype(dt):
+    name = str(dt)
+    if name == "bfloat16":
+        return np.float32
+    return np.dtype(name)
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+def to_model_bytes(layer, example_inputs, opset_version: int = 13) -> bytes:
+    """Trace `layer` on example inputs and serialize an ONNX ModelProto."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.nn.utils import functional_call
+    from paddle_tpu.passes import decomposition_rules, rewrite_jaxpr
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        state = dict(layer.state_dict())
+        for name, b in layer.named_buffers():
+            state.setdefault(name, b)
+        names = list(state.keys())
+        vals = [state[n]._value for n in names]
+        xs = [np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+              for x in example_inputs]
+
+        def fn(param_vals, *inputs):
+            with tape.no_grad():
+                out, _ = functional_call(
+                    layer, dict(zip(names, param_vals)),
+                    tuple(Tensor(i) for i in inputs))
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return [o._value for o in outs]
+
+        closed = jax.make_jaxpr(fn)(vals, *[jnp.asarray(x) for x in xs])
+        closed = _inline_calls(closed)
+        closed = rewrite_jaxpr(closed, decomposition_rules(), recurse=False)
+        closed = _inline_calls(closed)
+    finally:
+        for m, was in ([(layer, was_training)]
+                       if hasattr(layer, "training") else []):
+            m.training = was
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    g = _Graph()
+    jaxpr = closed.jaxpr
+    n_params = len(vals)
+    # params + consts -> initializers; remaining invars -> graph inputs
+    for var, val, pname in zip(jaxpr.invars[:n_params], vals, names):
+        arr = np.asarray(val)
+        if arr.dtype == jnp.bfloat16:
+            arr = arr.astype(np.float32)
+        g.names[id(var)] = pname
+        g.initializers.append(_tensor_proto(pname, arr))
+    for var, c in zip(jaxpr.constvars, closed.consts):
+        arr = np.asarray(c)
+        g.initializers.append(_tensor_proto(g.name_of(var), arr))
+    graph_inputs = []
+    for i, var in enumerate(jaxpr.invars[n_params:]):
+        g.names[id(var)] = f"input_{i}"
+        graph_inputs.append(_value_info(f"input_{i}", var.aval.shape,
+                                        var.aval.dtype))
+    for eqn in jaxpr.eqns:
+        _map_eqn(g, eqn)
+    graph_outputs = []
+    import jax.extend.core as jex
+    for i, var in enumerate(jaxpr.outvars):
+        if isinstance(var, jex.Literal):
+            nm = g.const(np.asarray(var.val), "out")
+        else:
+            nm = g.name_of(var)
+        out_name = f"output_{i}"
+        g.node("Identity", [nm], [out_name])
+        graph_outputs.append(_value_info(out_name, var.aval.shape,
+                                         var.aval.dtype))
+
+    graph = Msg()
+    for n in g.nodes:
+        graph.msg(1, n)
+    graph.string(2, type(layer).__name__)
+    for init in g.initializers:
+        graph.msg(5, init)
+    for vi in graph_inputs:
+        graph.msg(11, vi)
+    for vo in graph_outputs:
+        graph.msg(12, vo)
+
+    model = Msg()
+    model.int64(1, 8)  # ir_version
+    model.string(2, "paddle_tpu")
+    model.string(3, "0.2")
+    model.msg(7, graph)
+    model.msg(8, Msg().string(1, "").int64(2, opset_version))
+    return bytes(model)
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs) -> str:
+    """paddle.onnx.export analog: writes ``{path}.onnx`` and returns the
+    file path. ``input_spec``: InputSpec list or example Tensors/arrays."""
+    from paddle_tpu.static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (InputSpec list "
+                         "or example tensors)")
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            examples.append(np.asarray(spec.example().numpy()))
+        else:
+            examples.append(spec)
+    data = to_model_bytes(layer, examples, opset_version=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path

@@ -1,0 +1,129 @@
+"""Minimal protobuf wire-format writer/reader for the ONNX subset.
+
+The environment has no ``onnx`` package, and depending on one would be the
+reference's approach (paddle2onnx is an external wheel). Protobuf's wire
+format is simple — varint keys, length-delimited submessages — so the
+exporter writes ModelProto bytes directly. Field numbers follow the public
+onnx.proto schema (onnx/onnx.proto in the ONNX repo):
+
+  ModelProto:   ir_version=1 producer_name=2 producer_version=3 graph=7
+                opset_import=8
+  OperatorSetId: domain=1 version=2
+  GraphProto:   node=1 name=2 initializer=5 doc_string=10 input=11
+                output=12 value_info=13
+  NodeProto:    input=1 output=2 name=3 op_type=4 attribute=5
+  AttributeProto: name=1 f=2 i=3 s=4 t=5 floats=7 ints=8 type=20
+  TensorProto:  dims=1 data_type=2 name=8 raw_data=9
+  ValueInfoProto: name=1 type=2 ; TypeProto.tensor_type=1
+  TypeProto.Tensor: elem_type=1 shape=2
+  TensorShapeProto: dim=1 ; Dimension: dim_value=1 dim_param=2
+
+A matching tolerant reader (field tree) supports the round-trip tests and
+the numpy mini-runtime without any external dependency.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+__all__ = ["Msg", "varint", "encode", "decode"]
+
+
+def varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64  # two's complement, protobuf int64 convention
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Msg:
+    """Append-only protobuf message builder."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def _key(self, field: int, wire: int):
+        self._buf += varint((field << 3) | wire)
+
+    def int64(self, field: int, value: int) -> "Msg":
+        self._key(field, 0)
+        self._buf += varint(int(value))
+        return self
+
+    def float32(self, field: int, value: float) -> "Msg":
+        self._key(field, 5)
+        self._buf += struct.pack("<f", float(value))
+        return self
+
+    def bytes_(self, field: int, value: bytes) -> "Msg":
+        self._key(field, 2)
+        self._buf += varint(len(value))
+        self._buf += value
+        return self
+
+    def string(self, field: int, value: str) -> "Msg":
+        return self.bytes_(field, value.encode("utf-8"))
+
+    def msg(self, field: int, sub: "Msg") -> "Msg":
+        return self.bytes_(field, bytes(sub._buf))
+
+    def packed_int64(self, field: int, values) -> "Msg":
+        payload = b"".join(varint(int(v)) for v in values)
+        return self.bytes_(field, payload)
+
+    def __bytes__(self):
+        return bytes(self._buf)
+
+
+def encode(m: Msg) -> bytes:
+    return bytes(m)
+
+
+FieldTree = Dict[int, List[Union[int, float, bytes]]]
+
+
+def decode(data: bytes) -> FieldTree:
+    """Parse one message level into {field: [raw values]}; submessages stay
+    bytes (decode them recursively as needed)."""
+    out: FieldTree = {}
+    i = 0
+    n = len(data)
+    while i < n:
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+        elif wire == 5:
+            (v,) = struct.unpack_from("<f", data, i)
+            i += 4
+        elif wire == 1:
+            (v,) = struct.unpack_from("<d", data, i)
+            i += 8
+        elif wire == 2:
+            ln, i = _read_varint(data, i)
+            v = bytes(data[i:i + ln])
+            i += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    value = 0
+    while True:
+        b = data[i]
+        i += 1
+        value |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return value, i
+        shift += 7

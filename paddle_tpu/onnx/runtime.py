@@ -1,0 +1,225 @@
+"""Pure-numpy evaluator for the exported ONNX subset.
+
+No onnxruntime exists in this environment, so verification is in-tree: the
+tolerant wire reader (onnx/proto.py) decodes the ModelProto and this
+module executes the graph with numpy ops, covering exactly the node set
+the exporter emits. Used by the export tests to prove the serialized
+bytes are a faithful, runnable model — not just well-formed protobuf.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from paddle_tpu.onnx.proto import decode
+
+__all__ = ["run_model", "parse_model"]
+
+_NP_DTYPE = {1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16,
+             6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+             11: np.float64, 16: np.float32}
+
+
+def _tensor(data: bytes) -> np.ndarray:
+    f = decode(data)
+    dims = [int(d) for d in f.get(1, [])]
+    dt = _NP_DTYPE[int(f[2][0])]
+    raw = f.get(9, [b""])[0]
+    return np.frombuffer(raw, dtype=dt).reshape(dims).copy()
+
+
+def _attrs(node_fields) -> Dict[str, object]:
+    out = {}
+    for raw in node_fields.get(5, []):
+        a = decode(raw)
+        name = a[1][0].decode()
+        atype = int(a.get(20, [0])[0])
+        if atype == 1:
+            out[name] = float(a[2][0])
+        elif atype == 2:
+            out[name] = int(_signed(a[3][0]))
+        elif atype == 7:
+            out[name] = [int(_signed(v)) for v in a.get(8, [])]
+        elif atype == 4:
+            out[name] = a[4][0]
+    return out
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_model(data: bytes) -> dict:
+    model = decode(data)
+    graph = decode(model[7][0])
+    nodes = []
+    for raw in graph.get(1, []):
+        f = decode(raw)
+        nodes.append(dict(
+            op=f[4][0].decode(),
+            inputs=[s.decode() for s in f.get(1, [])],
+            outputs=[s.decode() for s in f.get(2, [])],
+            attrs=_attrs(f)))
+    inits = {}
+    for raw in graph.get(5, []):
+        f = decode(raw)
+        inits[f[8][0].decode()] = _tensor(raw)
+    def _names(field):
+        return [decode(raw)[1][0].decode() for raw in graph.get(field, [])]
+    return dict(
+        ir_version=int(model.get(1, [0])[0]),
+        producer=model.get(2, [b""])[0].decode(),
+        opset=int(decode(model[8][0]).get(2, [0])[0]),
+        nodes=nodes, initializers=inits,
+        inputs=_names(11), outputs=_names(12))
+
+
+def _pool2d(x, k, s, pads, mode):
+    n, c, h, w = x.shape
+    ph0, pw0, ph1, pw1 = pads
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=fill)
+    oh = (xp.shape[2] - k[0]) // s[0] + 1
+    ow = (xp.shape[3] - k[1]) // s[1] + 1
+    out = np.empty((n, c, oh, ow), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * s[0]:i * s[0] + k[0], j * s[1]:j * s[1] + k[1]]
+            out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+def _conv2d(x, w, b, strides, pads, dilations, group):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    if dilations != [1, 1] and tuple(dilations) != (1, 1):
+        kh_d = kh + (kh - 1) * (dilations[0] - 1)
+        kw_d = kw + (kw - 1) * (dilations[1] - 1)
+        wd_dil = np.zeros((cout, cin_g, kh_d, kw_d), w.dtype)
+        wd_dil[:, :, ::dilations[0], ::dilations[1]] = w
+        w, kh, kw = wd_dil, kh_d, kw_d
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    cout_g = cout // group
+    for gi in range(group):
+        xs = xp[:, gi * cin_g:(gi + 1) * cin_g]
+        wg = w[gi * cout_g:(gi + 1) * cout_g]
+        # im2col
+        cols = np.empty((n, cin_g * kh * kw, oh * ow), np.float64)
+        idx = 0
+        for ci in range(cin_g):
+            for ki in range(kh):
+                for kj in range(kw):
+                    patch = xs[:, ci, ki:ki + oh * strides[0]:strides[0],
+                               kj:kj + ow * strides[1]:strides[1]]
+                    cols[:, idx] = patch.reshape(n, -1)
+                    idx += 1
+        wmat = wg.reshape(cout_g, -1).astype(np.float64)
+        out[:, gi * cout_g:(gi + 1) * cout_g] = (
+            wmat @ cols).reshape(n, cout_g, oh, ow)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out.astype(x.dtype)
+
+
+def run_model(data: bytes, inputs: List[np.ndarray]) -> List[np.ndarray]:
+    m = parse_model(data)
+    env: Dict[str, np.ndarray] = dict(m["initializers"])
+    for name, arr in zip(m["inputs"], inputs):
+        env[name] = np.asarray(arr)
+
+    for nd in m["nodes"]:
+        op = nd["op"]
+        a = nd["attrs"]
+        x = [env[i] for i in nd["inputs"]]
+        if op == "Add":
+            r = x[0] + x[1]
+        elif op == "Sub":
+            r = x[0] - x[1]
+        elif op == "Mul":
+            r = x[0] * x[1]
+        elif op == "Div":
+            r = x[0] / x[1]
+        elif op == "MatMul":
+            r = x[0] @ x[1]
+        elif op == "Max":
+            r = np.maximum(x[0], x[1])
+        elif op == "Min":
+            r = np.minimum(x[0], x[1])
+        elif op == "Neg":
+            r = -x[0]
+        elif op == "Exp":
+            r = np.exp(x[0])
+        elif op == "Log":
+            r = np.log(x[0])
+        elif op == "Tanh":
+            r = np.tanh(x[0])
+        elif op == "Sqrt":
+            r = np.sqrt(x[0])
+        elif op == "Abs":
+            r = np.abs(x[0])
+        elif op == "Pow":
+            r = np.power(x[0], x[1])
+        elif op == "Sigmoid":
+            r = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == "Erf":
+            from math import erf
+            r = np.vectorize(erf)(x[0]).astype(x[0].dtype)
+        elif op == "Identity":
+            r = x[0]
+        elif op == "Reshape":
+            r = x[0].reshape([int(v) for v in x[1]])
+        elif op == "Transpose":
+            r = np.transpose(x[0], a["perm"])
+        elif op == "Expand":
+            r = np.broadcast_to(x[0], [int(v) for v in x[1]]).copy()
+        elif op == "Cast":
+            r = x[0].astype(_NP_DTYPE[a["to"]])
+        elif op == "Where":
+            r = np.where(x[0], x[1], x[2])
+        elif op == "ReduceSum":
+            axes = tuple(int(v) for v in x[1]) if len(x) > 1 else None
+            r = np.sum(x[0], axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod}[op]
+            r = fn(x[0], axis=tuple(a["axes"]),
+                   keepdims=bool(a.get("keepdims", 1)))
+        elif op == "Concat":
+            r = np.concatenate(x, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (x[1], x[2], x[3], x[4])
+            sl = [slice(None)] * x[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(st), int(en), int(sp))
+            r = x[0][tuple(sl)]
+        elif op == "Conv":
+            b = x[2] if len(x) > 2 else None
+            r = _conv2d(x[0], x[1], b, a["strides"], a["pads"],
+                        a["dilations"], a.get("group", 1))
+        elif op == "MaxPool":
+            r = _pool2d(x[0], a["kernel_shape"], a["strides"], a["pads"],
+                        "max")
+        elif op == "AveragePool":
+            r = _pool2d(x[0], a["kernel_shape"], a["strides"], a["pads"],
+                        "avg")
+        elif op == "ArgMax":
+            r = np.argmax(x[0], axis=a["axis"])
+        elif op in ("Equal", "Less", "Greater", "LessOrEqual",
+                    "GreaterOrEqual"):
+            fn = {"Equal": np.equal, "Less": np.less,
+                  "Greater": np.greater, "LessOrEqual": np.less_equal,
+                  "GreaterOrEqual": np.greater_equal}[op]
+            r = fn(x[0], x[1])
+        else:
+            raise NotImplementedError(f"runtime: op {op}")
+        env[nd["outputs"][0]] = r
+
+    return [env[n] for n in m["outputs"]]

@@ -11,6 +11,7 @@ from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
 from paddle_tpu.ops.comparison import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.schema_defs import *  # noqa: F401,F403 (schema-codegen ops)
 
 from paddle_tpu.ops import fused_ce as _fused_ce  # noqa: F401 (registers fused_linear_ce)
 from paddle_tpu.ops import methods as _methods
@@ -18,3 +19,4 @@ from paddle_tpu.ops import methods as _methods
 _methods.monkey_patch_tensor()
 
 from paddle_tpu.ops import math, reduction, manipulation, comparison, linalg, creation  # noqa: F401,E402
+from paddle_tpu.ops import schema, schema_defs, spmd_rules  # noqa: F401,E402

@@ -1,0 +1,99 @@
+"""Declarative op schemas + codegen fan-out (the ops.yaml analog).
+
+The reference defines each op ONCE in YAML (paddle/phi/ops/yaml/ops.yaml:
+args, output, infer_meta, kernel, backward) and generators fan that schema
+out into the C++ API, grad nodes, dist (auto-parallel-aware) API and docs
+(paddle/phi/api/yaml/generator/api_gen.py, backward_api_gen.py,
+dist_api_gen.py). TPU-native redesign: the schema is a Python dataclass and
+the "generators" are one function, because the targets collapsed —
+
+  schema.impl          -> registry entry (eager dispatch + tape + jit; the
+                          API/backward codegen: jax.vjp is the grad node)
+  schema.spmd          -> SPMD-rule binding (the dist_api_gen analog,
+                          ops/spmd_rules.py table)
+  schema doc fields    -> generated docstring on the public API
+  schema.sample        -> OpTest sweep inputs (tests/test_op_sweep.py),
+                          so every schema'd op is numerics+grad tested
+
+``describe(name)`` renders the schema as documentation; ``get_schema``
+gives programmatic access (OpMetaInfo introspection analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+__all__ = ["OpSchema", "build_ops", "get_schema", "describe"]
+
+_SCHEMAS: Dict[str, "OpSchema"] = {}
+
+
+class OpSchema:
+    """One op, declaratively.
+
+    name     — registry name (= public API name)
+    impl     — pure-JAX implementation (jax values in/out, traceable)
+    args     — signature string for docs, e.g. "x, label, delta=1.0"
+    doc      — one-paragraph description
+    ref      — reference citation (file:anchor in /root/reference)
+    spmd     — SPMD rule: a registered rule name ("elementwise",
+               "reduction", ...) or None for the replicate-all default
+    differentiable / n_outputs — registry dispatch properties
+    sample   — OpTest sweep spec: dict(in_=[input makers], kw={}, grad=[...],
+               jit=bool, rtol/atol) using the maker mini-language in
+               tests/test_op_sweep.py ("f"/"fneg"/"ii"/"bb" tuples)
+    """
+
+    def __init__(self, name: str, impl: Callable, args: str, doc: str,
+                 ref: str = "", spmd: Optional[str] = "elementwise",
+                 differentiable: bool = True, n_outputs: int = 1,
+                 sample: Optional[dict] = None):
+        self.name = name
+        self.impl = impl
+        self.args = args
+        self.doc = doc
+        self.ref = ref
+        self.spmd = spmd
+        self.differentiable = differentiable
+        self.n_outputs = n_outputs
+        self.sample = sample
+
+
+def get_schema(name: str) -> OpSchema:
+    return _SCHEMAS[name]
+
+
+def describe(name: str) -> str:
+    """Render a schema as documentation (the docs-generation target)."""
+    s = _SCHEMAS[name]
+    lines = [f"{s.name}({s.args})", "", s.doc]
+    lines.append("")
+    lines.append(f"    differentiable: {s.differentiable}")
+    lines.append(f"    sharding rule:  {s.spmd or 'default (replicate)'}")
+    if s.ref:
+        lines.append(f"    reference:      {s.ref}")
+    return "\n".join(lines)
+
+
+def build_ops(schemas: Sequence[OpSchema], namespace: Dict[str, Any]):
+    """The generator: one schema -> registered op + doc'd API + SPMD rule
+    binding. Returns the list of public names (for __all__)."""
+    from paddle_tpu.ops.registry import register_op
+    from paddle_tpu.ops import spmd_rules as R
+
+    names = []
+    for s in schemas:
+        if s.name in _SCHEMAS:
+            raise KeyError(f"op schema {s.name!r} defined twice")
+        _SCHEMAS[s.name] = s
+        api = register_op(s.name, ref=s.ref, n_outputs=s.n_outputs,
+                          differentiable=s.differentiable)(s.impl)
+        api.__name__ = s.name
+        api.__qualname__ = s.name
+        api.__doc__ = describe(s.name)
+        api.schema = s
+        if s.spmd is not None and s.name not in R.SPMD_RULES:
+            R.SPMD_RULES[s.name] = R.get_spmd_rule(s.spmd)
+        namespace[s.name] = api
+        names.append(s.name)
+    return names

@@ -1,0 +1,859 @@
+"""Schema-defined ops: the declarative table the codegen fans out.
+
+Every op here is defined ONCE as an OpSchema (impl + signature + doc +
+SPMD rule + OpTest sample) and built by ops/schema.build_ops — the
+TPU-native analog of adding a YAML entry to paddle/phi/ops/yaml/ops.yaml
+and letting api_gen/backward_api_gen/dist_api_gen produce the surfaces.
+The OpTest sweep (tests/test_op_sweep.py) picks the ``sample`` specs up
+automatically, so each schema'd op is numerics- and grad-tested.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.schema import OpSchema, build_ops
+
+__all__: list = []  # filled by build_ops
+
+
+def _f(*shape, lo=0.2, hi=0.9):
+    return ("f",) + shape + ({"lo": lo, "hi": hi},)
+
+
+def _fneg(*shape):
+    return ("f",) + shape + ({"lo": -0.9, "hi": 0.9},)
+
+
+def _ii(*shape, lo=0, hi=4):
+    return ("ii",) + shape + ({"lo": lo, "hi": hi},)
+
+
+def _S(v):
+    return ("S", v)
+
+
+def sample(in_, kw=None, grad=None, jit=True, rtol=1e-2, atol=1e-3):
+    return dict(in_=in_, kw=kw or {}, grad=grad or [], jit=jit,
+                rtol=rtol, atol=atol)
+
+
+# --------------------------------------------------------------------------
+# special functions / elementwise
+# --------------------------------------------------------------------------
+
+def _polygamma(x, n=1):
+    from jax.scipy.special import polygamma
+    return polygamma(n, x)
+
+
+def _kthvalue(x, k, axis=-1, keepdim=False):
+    idx = jnp.argsort(x, axis=axis)
+    kth_idx = jnp.take(idx, k - 1, axis=axis)
+    vals = jnp.take_along_axis(
+        x, jnp.expand_dims(kth_idx, axis), axis=axis)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis=axis)
+    return vals, kth_idx
+
+
+def _logcumsumexp(x, axis=-1):
+    return lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def _p_norm(x, p=2.0, axis=None, keepdim=False, epsilon=1e-12):
+    xf = jnp.abs(x.astype(jnp.float32))
+    if p == float("inf"):
+        out = jnp.max(xf, axis=axis, keepdims=keepdim)
+    elif p == float("-inf"):
+        out = jnp.min(xf, axis=axis, keepdims=keepdim)
+    elif p == 0:
+        out = jnp.sum((xf != 0).astype(jnp.float32), axis=axis,
+                      keepdims=keepdim)
+    else:
+        out = jnp.sum(xf ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return out.astype(x.dtype)
+
+
+def _frobenius_norm(x, axis=None, keepdim=False):
+    xf = x.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(xf * xf, axis=axis,
+                            keepdims=keepdim)).astype(x.dtype)
+
+
+def _renorm(x, p, axis, max_norm):
+    axes = tuple(d for d in range(x.ndim) if d != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x.astype(jnp.float32)) ** p, axis=axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return (x.astype(jnp.float32) * factor).astype(x.dtype)
+
+
+SPECIAL = [
+    OpSchema("erfc", lambda x: lax.erfc(x), "x",
+             "Complementary error function, 1 - erf(x).",
+             ref="paddle/phi/ops/yaml/ops.yaml (erf family)",
+             sample=sample([_fneg(2, 3)], grad=[0])),
+    OpSchema("gammaln", lambda x: lax.lgamma(x), "x",
+             "Natural log of the absolute value of the gamma function.",
+             ref="paddle/phi/ops/yaml/ops.yaml:gammaln",
+             sample=sample([_f(2, 3, lo=0.5, hi=2.0)], grad=[0])),
+    OpSchema("gammainc", lambda a, x: jax.scipy.special.gammainc(a, x),
+             "a, x", "Regularized lower incomplete gamma function P(a, x).",
+             ref="paddle/phi/kernels/impl/gammaincc_kernel_impl.h (family)",
+             sample=sample([_f(2, 3, lo=0.5, hi=2.0),
+                            _f(2, 3, lo=0.5, hi=2.0)], grad=[])),
+    OpSchema("gammaincc", lambda a, x: jax.scipy.special.gammaincc(a, x),
+             "a, x", "Regularized upper incomplete gamma function Q(a, x).",
+             ref="paddle/phi/ops/yaml/ops.yaml:gammaincc",
+             sample=sample([_f(2, 3, lo=0.5, hi=2.0),
+                            _f(2, 3, lo=0.5, hi=2.0)], grad=[])),
+    OpSchema("i0e", lambda x: jax.scipy.special.i0e(x), "x",
+             "Exponentially scaled modified Bessel function of order 0.",
+             ref="paddle/phi/ops/yaml/ops.yaml:i0e",
+             sample=sample([_fneg(2, 3)], grad=[0])),
+    OpSchema("i1", lambda x: jax.scipy.special.i1(x), "x",
+             "Modified Bessel function of the first kind, order 1.",
+             ref="paddle/phi/ops/yaml/ops.yaml:i1",
+             sample=sample([_fneg(2, 3)], grad=[0])),
+    OpSchema("i1e", lambda x: jax.scipy.special.i1e(x), "x",
+             "Exponentially scaled modified Bessel function of order 1.",
+             ref="paddle/phi/ops/yaml/ops.yaml:i1e",
+             sample=sample([_fneg(2, 3)], grad=[0])),
+    OpSchema("polygamma", _polygamma, "x, n=1",
+             "n-th derivative of the digamma function at x.",
+             ref="paddle/phi/ops/yaml/ops.yaml:polygamma",
+             sample=sample([_f(2, 3, lo=0.5, hi=2.0)], kw={"n": 1},
+                           grad=[0], rtol=5e-2, atol=5e-3)),
+    OpSchema("logaddexp2", lambda x, y: jnp.logaddexp2(x, y), "x, y",
+             "log2(2**x + 2**y), the base-2 stable log-sum-exp.",
+             ref="python/paddle/tensor/math.py:logaddexp (family)",
+             sample=sample([_fneg(2, 3), _fneg(2, 3)], grad=[0, 1])),
+    OpSchema("sinc", lambda x: jnp.sinc(x), "x",
+             "Normalized sinc, sin(pi x)/(pi x) with sinc(0)=1.",
+             ref="python/paddle/tensor/math.py:sinc",
+             sample=sample([_f(2, 3, lo=0.3)], grad=[0])),
+    OpSchema("ldexp", lambda x, y: jnp.ldexp(x, y), "x, y",
+             "x * 2**y (y integer exponents).",
+             ref="python/paddle/tensor/math.py:ldexp",
+             sample=sample([_f(2, 3), _ii(2, 3, lo=0, hi=3)], grad=[])),
+    OpSchema("xlogy", lambda x, y: jax.scipy.special.xlogy(x, y), "x, y",
+             "x * log(y), zero where x == 0.",
+             ref="python/paddle/tensor/math.py (xlogy family)",
+             sample=sample([_f(2, 3), _f(2, 3, lo=0.3)], grad=[0, 1])),
+    OpSchema("bitwise_left_shift",
+             lambda x, y: jnp.left_shift(x, y), "x, y",
+             "Elementwise x << y on integer tensors.",
+             ref="paddle/phi/ops/yaml/ops.yaml:bitwise_left_shift",
+             differentiable=False,
+             sample=sample([_ii(2, 3, lo=1, hi=7), _ii(2, 3, lo=0, hi=3)])),
+    OpSchema("bitwise_right_shift",
+             lambda x, y: jnp.right_shift(x, y), "x, y",
+             "Elementwise x >> y on integer tensors.",
+             ref="paddle/phi/ops/yaml/ops.yaml:bitwise_right_shift",
+             differentiable=False,
+             sample=sample([_ii(2, 3, lo=1, hi=7), _ii(2, 3, lo=0, hi=3)])),
+    OpSchema("signbit", lambda x: jnp.signbit(x), "x",
+             "True where the sign bit is set (negative, -0, -nan).",
+             ref="python/paddle/tensor/math.py:signbit",
+             differentiable=False, sample=sample([_fneg(2, 3)])),
+    OpSchema("isposinf", lambda x: jnp.isposinf(x), "x",
+             "True where x is +inf.", differentiable=False,
+             ref="python/paddle/tensor/math.py:isposinf",
+             sample=sample([_fneg(2, 3)])),
+    OpSchema("isneginf", lambda x: jnp.isneginf(x), "x",
+             "True where x is -inf.", differentiable=False,
+             ref="python/paddle/tensor/math.py:isneginf",
+             sample=sample([_fneg(2, 3)])),
+    OpSchema("isreal", lambda x: jnp.isreal(x), "x",
+             "True where x has zero imaginary part.", differentiable=False,
+             ref="python/paddle/tensor/math.py:isreal",
+             sample=sample([_fneg(2, 3)])),
+    OpSchema("positive", lambda x: +x, "x", "Identity (+x).",
+             ref="python/paddle/tensor/math.py:positive",
+             sample=sample([_fneg(2, 3)], grad=[0])),
+    OpSchema("negative", lambda x: -x, "x", "Elementwise negation.",
+             ref="python/paddle/tensor/math.py:negative",
+             sample=sample([_fneg(2, 3)], grad=[0])),
+    OpSchema("frexp", lambda x: jnp.frexp(x), "x",
+             "Decompose x into mantissa in [0.5, 1) and integer exponent.",
+             differentiable=False, n_outputs=2,
+             ref="python/paddle/tensor/math.py:frexp",
+             sample=sample([_f(2, 3, lo=0.3)])),
+]
+
+# --------------------------------------------------------------------------
+# reductions / norms
+# --------------------------------------------------------------------------
+
+REDUCTIONS = [
+    OpSchema("trace",
+             lambda x, offset=0, axis1=0, axis2=1:
+             jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2),
+             "x, offset=0, axis1=0, axis2=1",
+             "Sum along a diagonal of a matrix (or batch of matrices).",
+             ref="paddle/phi/ops/yaml/ops.yaml:trace", spmd="default",
+             sample=sample([_f(3, 3)], grad=[0])),
+    OpSchema("kthvalue", _kthvalue, "x, k, axis=-1, keepdim=False",
+             "k-th smallest value (and its index) along an axis.",
+             ref="paddle/phi/ops/yaml/ops.yaml:kthvalue", n_outputs=2,
+             spmd="default",
+             sample=sample([_f(2, 5)], kw={"k": 2}, grad=[0])),
+    OpSchema("logcumsumexp", _logcumsumexp, "x, axis=-1",
+             "Cumulative log-sum-exp along an axis (stable associative scan).",
+             ref="paddle/phi/ops/yaml/ops.yaml:logcumsumexp", spmd="default",
+             sample=sample([_fneg(2, 5)], grad=[0])),
+    OpSchema("p_norm", _p_norm,
+             "x, p=2.0, axis=None, keepdim=False, epsilon=1e-12",
+             "p-norm over an axis (p may be 0, +/-inf, or any real).",
+             ref="paddle/phi/ops/yaml/ops.yaml:p_norm", spmd="reduction",
+             sample=sample([_f(2, 4)], kw={"p": 3.0, "axis": 1}, grad=[0])),
+    OpSchema("frobenius_norm", _frobenius_norm, "x, axis=None, keepdim=False",
+             "Square root of the sum of squared entries.",
+             ref="paddle/phi/ops/yaml/ops.yaml:frobenius_norm",
+             spmd="reduction", sample=sample([_f(2, 4)], grad=[0])),
+    OpSchema("l1_norm", lambda x: jnp.sum(jnp.abs(x)), "x",
+             "Sum of absolute values of all entries.",
+             ref="paddle/fluid legacy l1_norm op", spmd="reduction",
+             sample=sample([_f(2, 4, lo=0.3)], grad=[0])),
+    OpSchema("squared_l2_norm", lambda x: jnp.sum(jnp.square(x)), "x",
+             "Sum of squared entries (the grad-clip workhorse).",
+             ref="paddle/phi/kernels/squared_l2_norm_kernel.h",
+             spmd="reduction", sample=sample([_fneg(2, 4)], grad=[0])),
+    OpSchema("numel", lambda x: jnp.asarray(jnp.size(x)), "x",
+             "Number of elements, as a 0-d int tensor.",
+             ref="paddle/phi/ops/yaml/ops.yaml:numel", differentiable=False,
+             spmd="default", sample=sample([_f(2, 4)])),
+    OpSchema("renorm", _renorm, "x, p, axis, max_norm",
+             "Clamp each slice along ``axis`` to p-norm <= max_norm.",
+             ref="paddle/phi/ops/yaml/ops.yaml:renorm", spmd="default",
+             sample=sample([_fneg(3, 4)], kw={"p": 2.0, "axis": 0,
+                                              "max_norm": 1.0}, grad=[0])),
+]
+
+# --------------------------------------------------------------------------
+# manipulation / indexing
+# --------------------------------------------------------------------------
+
+def _take(x, index, mode="raise"):
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    idx = jnp.asarray(index)
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:  # 'raise' cannot raise under jit; clip is the safe TPU semantic.
+        # negative indices address from the end (numpy semantics) — resolve
+        # them BEFORE clipping, since jnp's clip mode floors them to 0
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(flat, idx, mode="wrap" if mode == "wrap" else "clip")
+
+
+def _select_scatter(x, values, axis, index):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def _diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    # move the two diagonal axes last, scatter y (its last dim indexes the
+    # diagonal) with index grids, move back
+    n = min(x.shape[axis1], x.shape[axis2] - offset) if offset >= 0 else \
+        min(x.shape[axis1] + offset, x.shape[axis2])
+    r = jnp.arange(n)
+    i1 = r - min(0, offset)
+    i2 = r + max(0, offset)
+    xm = jnp.moveaxis(x, (axis1 % x.ndim, axis2 % x.ndim), (-2, -1))
+    out = xm.at[..., i1, i2].set(jnp.asarray(y))
+    return jnp.moveaxis(out, (-2, -1), (axis1 % x.ndim, axis2 % x.ndim))
+
+
+def _index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = jnp.asarray(index)
+    return x.at[tuple(idx)].set(value)
+
+
+def _masked_scatter(x, mask, value):
+    # positions where mask is True take value's leading elements in order:
+    # slot k gets value.ravel()[rank-of-k-th-True]; static shapes throughout
+    m = jnp.broadcast_to(mask, x.shape)
+    order = jnp.cumsum(m.ravel()) - 1
+    src = jnp.take(jnp.ravel(value), jnp.clip(order, 0, value.size - 1))
+    return jnp.where(m, src.reshape(x.shape), x)
+
+
+def _unique_consecutive(x, return_inverse=False, return_counts=False,
+                        axis=None):
+    v = jnp.ravel(x) if axis is None else x
+    if axis is not None:
+        raise NotImplementedError("unique_consecutive: axis TBD")
+    keep = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]])
+    out = v[keep]  # data-dependent size: eager / no-jit op
+    res = [out]
+    if return_inverse:
+        res.append(jnp.cumsum(keep) - 1)
+    if return_counts:
+        idx = jnp.nonzero(keep)[0]
+        res.append(jnp.diff(jnp.concatenate([idx, jnp.array([v.size])])))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def _fill_diagonal(x, value, offset=0, wrap=False):
+    rows, cols = x.shape[-2], x.shape[-1]
+    r = jnp.arange(rows)[:, None]
+    c = jnp.arange(cols)[None, :]
+    mask = (c - r) == offset
+    if wrap and rows > cols:
+        mask = (c - r) % (cols + 1) == offset
+    return jnp.where(mask, value, x)
+
+
+def _shard_index(ids, index_num, nshards, shard_id, ignore_value=-1):
+    # ceil, like the reference: every id in [0, index_num) maps to a shard
+    size = (index_num + nshards - 1) // nshards
+    in_shard = (ids // size) == shard_id
+    return jnp.where(in_shard, ids % size, ignore_value)
+
+
+def _multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)          # (K, B, ...)
+    idx = jnp.reshape(jnp.asarray(index), (-1,)) # (B,)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def _gather_tree(ids, parents):
+    """Beam-search backtrace: (T, B, beam) step ids + parent beam indices ->
+    full sequences (reference paddle/phi/kernels/cpu/gather_tree_kernel.cc)."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beam_idx = carry                        # (B, beam) current beams
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=1)
+        return parent, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                            ids.shape[1:]).astype(ids.dtype)
+    _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return toks[::-1]
+
+
+def _tensor_split(x, num_or_indices, axis=0):
+    if isinstance(num_or_indices, int):
+        return tuple(jnp.array_split(x, num_or_indices, axis=axis))
+    parts = []
+    prev = 0
+    for i in list(num_or_indices) + [x.shape[axis]]:
+        parts.append(lax.slice_in_dim(x, prev, i, axis=axis))
+        prev = i
+    return tuple(parts)
+
+
+def _unflatten(x, axis, shape):
+    new_shape = list(x.shape[:axis]) + list(shape) + list(x.shape[axis + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+def _vander(x, n=None, increasing=False):
+    n = x.shape[0] if n is None else n
+    powers = jnp.arange(n) if increasing else jnp.arange(n - 1, -1, -1)
+    return x[:, None] ** powers[None, :]
+
+
+def _cdist(x, y, p=2.0):
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-24)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def _pdist(x, p=2.0):
+    n = x.shape[0]
+    full = _cdist(x, x, p=p)
+    iu, ju = jnp.triu_indices(n, k=1)
+    return full[iu, ju]
+
+
+MANIP = [
+    OpSchema("take", _take, "x, index, mode='raise'",
+             "Gather from the flattened tensor by integer index "
+             "(mode: 'raise'->clip under jit, 'wrap', 'clip').",
+             ref="python/paddle/tensor/math.py:take", spmd="default",
+             sample=sample([_f(2, 4), _ii(3, lo=0, hi=7)], grad=[0])),
+    OpSchema("select_scatter", _select_scatter, "x, values, axis, index",
+             "Write ``values`` into the slice x[..., index, ...] at axis.",
+             ref="python/paddle/tensor/manipulation.py:select_scatter",
+             spmd="default",
+             sample=sample([_f(3, 4), _f(4)], kw={"axis": 0, "index": 1},
+                           grad=[0, 1])),
+    OpSchema("diagonal_scatter", _diagonal_scatter,
+             "x, y, offset=0, axis1=0, axis2=1",
+             "Write ``y`` onto a diagonal of x.",
+             ref="python/paddle/tensor/manipulation.py:diagonal_scatter",
+             spmd="default",
+             sample=sample([_f(3, 3), _f(3)], grad=[0, 1])),
+    OpSchema("index_fill", _index_fill, "x, index, axis, value",
+             "Set whole slices (rows/cols) selected by index to a scalar.",
+             ref="python/paddle/tensor/manipulation.py:index_fill",
+             spmd="default",
+             sample=sample([_f(3, 4), _ii(2, lo=0, hi=3), _S(0), _S(0.0)],
+                           grad=[0])),
+    OpSchema("masked_scatter", _masked_scatter, "x, mask, value",
+             "Fill True positions of mask (in order) from value's elements.",
+             ref="python/paddle/tensor/manipulation.py:masked_scatter",
+             spmd="default",
+             sample=sample([_f(2, 4), ("bb", 2, 4), _f(8)], grad=[0])),
+    OpSchema("bucketize",
+             lambda x, sorted_sequence, out_int32=False, right=False:
+             jnp.searchsorted(sorted_sequence, x,
+                              side="right" if right else "left").astype(
+                 jnp.int32 if out_int32 else jnp.int64),
+             "x, sorted_sequence, out_int32=False, right=False",
+             "Index of the bucket (from a 1-D sorted boundary list) each "
+             "element falls into.",
+             ref="python/paddle/tensor/search.py:bucketize",
+             differentiable=False, spmd="default",
+             sample=sample([_f(2, 3), ("sorted", 4)])),
+    OpSchema("unique_consecutive", _unique_consecutive,
+             "x, return_inverse=False, return_counts=False, axis=None",
+             "Collapse consecutive duplicate values (eager only: "
+             "data-dependent output size).",
+             ref="paddle/phi/ops/yaml/ops.yaml:unique_consecutive",
+             differentiable=False, spmd="default",
+             sample=sample([_ii(8, lo=0, hi=3)], jit=False)),
+    OpSchema("index_sample", _index_sample, "x, index",
+             "Per-row gather: out[i, j] = x[i, index[i, j]].",
+             ref="paddle/phi/ops/yaml/ops.yaml:index_sample", spmd="default",
+             sample=sample([_f(2, 4), _ii(2, 3, lo=0, hi=3)], grad=[0])),
+    OpSchema("fill_diagonal", _fill_diagonal,
+             "x, value, offset=0, wrap=False",
+             "Return x with its (batched) diagonal set to a scalar.",
+             ref="paddle/phi/ops/yaml/ops.yaml:fill_diagonal",
+             spmd="default", sample=sample([_f(3, 4), _S(0.5)], grad=[0])),
+    OpSchema("shard_index", _shard_index,
+             "ids, index_num, nshards, shard_id, ignore_value=-1",
+             "Recompute global ids into shard-local ids (ids outside this "
+             "shard become ignore_value) — the sharded-embedding helper.",
+             ref="paddle/phi/ops/yaml/ops.yaml:shard_index",
+             differentiable=False, spmd="default",
+             sample=sample([_ii(6, lo=0, hi=8), _S(8), _S(2), _S(0)])),
+    OpSchema("multiplex", _multiplex, "inputs, index",
+             "Row-wise select among K same-shape tensors by an index vector.",
+             ref="paddle/phi/ops/yaml/ops.yaml:multiplex", spmd="default",
+             sample=sample([("list_f", 2, (3, 4)), _ii(3, 1, lo=0, hi=2)],
+                           grad=[0])),
+    OpSchema("gather_tree", _gather_tree, "ids, parents",
+             "Backtrace beam-search parent pointers into full sequences.",
+             ref="paddle/phi/ops/yaml/ops.yaml:gather_tree",
+             differentiable=False, spmd="default",
+             sample=sample([_ii(4, 2, 3, lo=0, hi=9),
+                            _ii(4, 2, 3, lo=0, hi=2)])),
+    OpSchema("broadcast_tensors",
+             lambda inputs: tuple(jnp.broadcast_arrays(*inputs)),
+             "inputs",
+             "Broadcast a list of tensors to their common shape.",
+             ref="paddle/phi/ops/yaml/ops.yaml:broadcast_tensors",
+             n_outputs=-1, spmd="default",
+             sample=sample([("list_f", 2, (3, 1), (1, 4))], jit=False)),
+    OpSchema("add_n", lambda inputs: sum(inputs[1:], inputs[0]), "inputs",
+             "Elementwise sum of a list of tensors.",
+             ref="paddle/phi/ops/yaml/ops.yaml:add_n",
+             sample=sample([("list_f", 3, (2, 3))], grad=[0])),
+    OpSchema("column_stack",
+             lambda inputs: jnp.column_stack(inputs), "inputs",
+             "Stack 1-D/2-D tensors as columns of a 2-D tensor.",
+             ref="python/paddle/tensor/manipulation.py:column_stack",
+             spmd="default", sample=sample([("list_f", 2, (3, 2))], grad=[0])),
+    OpSchema("row_stack", lambda inputs: jnp.vstack(inputs), "inputs",
+             "Stack tensors vertically (alias of vstack).",
+             ref="python/paddle/tensor/manipulation.py:row_stack",
+             spmd="default", sample=sample([("list_f", 2, (2, 3))], grad=[0])),
+    OpSchema("hsplit", lambda x, num_or_indices: tuple(
+        jnp.split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)),
+             "x, num_or_indices", "Split along the horizontal axis.",
+             ref="python/paddle/tensor/manipulation.py:hsplit",
+             n_outputs=-1, spmd="default",
+             sample=sample([_f(2, 4), _S(2)], grad=[0])),
+    OpSchema("vsplit", lambda x, num_or_indices: tuple(
+        jnp.split(x, num_or_indices, axis=0)),
+             "x, num_or_indices", "Split along the vertical (first) axis.",
+             ref="python/paddle/tensor/manipulation.py:vsplit",
+             n_outputs=-1, spmd="default",
+             sample=sample([_f(4, 2), _S(2)], grad=[0])),
+    OpSchema("dsplit", lambda x, num_or_indices: tuple(
+        jnp.split(x, num_or_indices, axis=2)),
+             "x, num_or_indices", "Split along the depth (third) axis.",
+             ref="python/paddle/tensor/manipulation.py:dsplit",
+             n_outputs=-1, spmd="default",
+             sample=sample([_f(2, 2, 4), _S(2)], grad=[0])),
+    OpSchema("tensor_split", _tensor_split, "x, num_or_indices, axis=0",
+             "Split into (possibly uneven) sections or at given indices.",
+             ref="python/paddle/tensor/manipulation.py:tensor_split",
+             n_outputs=-1, spmd="default",
+             sample=sample([_f(5, 2), _S(2)], grad=[0])),
+    OpSchema("unflatten", _unflatten, "x, axis, shape",
+             "Expand one axis into the given shape.",
+             ref="python/paddle/tensor/manipulation.py:unflatten",
+             spmd="default",
+             sample=sample([_f(2, 6), _S(1), _S((2, 3))], grad=[0])),
+    OpSchema("vander", _vander, "x, n=None, increasing=False",
+             "Vandermonde matrix of a 1-D tensor.",
+             ref="python/paddle/tensor/creation.py:vander", spmd="default",
+             sample=sample([_f(4)], grad=[0])),
+    OpSchema("cdist", _cdist, "x, y, p=2.0",
+             "Pairwise p-norm distance between two point sets.",
+             ref="python/paddle/tensor/linalg.py:cdist", spmd="default",
+             sample=sample([_f(3, 4), _f(5, 4)], grad=[0, 1])),
+    OpSchema("pdist", _pdist, "x, p=2.0",
+             "Condensed pairwise distances of one point set (upper triangle).",
+             ref="python/paddle/nn/functional/distance.py (pdist family)",
+             spmd="default", sample=sample([_f(4, 3)], grad=[0])),
+]
+
+# --------------------------------------------------------------------------
+# creation
+# --------------------------------------------------------------------------
+
+CREATION = [
+    OpSchema("tril_indices",
+             lambda row, col=None, offset=0: jnp.stack(
+                 jnp.tril_indices(row, k=offset,
+                                  m=col if col is not None else row)),
+             "row, col=None, offset=0",
+             "Indices (2, N) of the lower triangle of a (row, col) matrix.",
+             ref="paddle/phi/ops/yaml/ops.yaml:tril_indices",
+             differentiable=False, spmd="default",
+             sample=sample([_S(3), _S(3)])),
+    OpSchema("triu_indices",
+             lambda row, col=None, offset=0: jnp.stack(
+                 jnp.triu_indices(row, k=offset,
+                                  m=col if col is not None else row)),
+             "row, col=None, offset=0",
+             "Indices (2, N) of the upper triangle of a (row, col) matrix.",
+             ref="paddle/phi/ops/yaml/ops.yaml:triu_indices",
+             differentiable=False, spmd="default",
+             sample=sample([_S(3), _S(3)])),
+]
+
+# --------------------------------------------------------------------------
+# losses (nn.functional surface)
+# --------------------------------------------------------------------------
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _huber_loss(input, label, delta=1.0, reduction="mean"):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce_loss(loss, reduction)
+
+
+def _log_loss(input, label, epsilon=1e-4):
+    lab = jnp.asarray(label).astype(input.dtype)
+    return (-lab * jnp.log(input + epsilon)
+            - (1.0 - lab) * jnp.log(1.0 - input + epsilon))
+
+
+def _soft_margin_loss(input, label, reduction="mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce_loss(loss, reduction)
+
+
+def _multi_label_soft_margin_loss(input, label, weight=None,
+                                  reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce_loss(loss, reduction)
+
+
+def _dice_loss(input, label, epsilon=1e-5):
+    # input (N, ..., C) probabilities; label (N, ..., 1) class ids
+    lab = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1],
+                         dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = 2.0 * jnp.sum(input * lab, axis=reduce_axes)
+    union = jnp.sum(input, axis=reduce_axes) + jnp.sum(lab, axis=reduce_axes)
+    return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
+
+
+def _npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = anchor @ positive.T                       # (B, B)
+    lab = jnp.asarray(labels)
+    same = (lab[:, None] == lab[None, :]).astype(anchor.dtype)
+    tgt = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive, axis=1))) / 2.0
+    return ce + reg
+
+
+LOSSES = [
+    OpSchema("huber_loss", _huber_loss,
+             "input, label, delta=1.0, reduction='mean'",
+             "Smooth-L1 (Huber) loss: quadratic below delta, linear above.",
+             ref="paddle/phi/ops/yaml/ops.yaml:huber_loss", spmd="default",
+             sample=sample([_fneg(2, 3), _fneg(2, 3)], grad=[0])),
+    OpSchema("log_loss", _log_loss, "input, label, epsilon=1e-4",
+             "Negative log likelihood of Bernoulli predictions (elementwise).",
+             ref="paddle/phi/ops/yaml/ops.yaml:log_loss", spmd="default",
+             sample=sample([_f(2, 3, lo=0.2, hi=0.8),
+                            ("bb", 2, 3)], grad=[0])),
+    OpSchema("soft_margin_loss", _soft_margin_loss,
+             "input, label, reduction='mean'",
+             "Two-class logistic loss over +/-1 labels.",
+             ref="python/paddle/nn/functional/loss.py:soft_margin_loss",
+             spmd="default",
+             sample=sample([_fneg(2, 3), _fneg(2, 3)], grad=[0])),
+    OpSchema("multi_label_soft_margin_loss", _multi_label_soft_margin_loss,
+             "input, label, weight=None, reduction='mean'",
+             "Per-class BCE-with-logits averaged over classes.",
+             ref="python/paddle/nn/functional/loss.py:"
+                 "multi_label_soft_margin_loss",
+             spmd="default",
+             sample=sample([_fneg(2, 3), ("bb", 2, 3)], grad=[0])),
+    OpSchema("dice_loss", _dice_loss, "input, label, epsilon=1e-5",
+             "1 - Dice coefficient between softmax probabilities and labels "
+             "(segmentation overlap loss).",
+             ref="python/paddle/nn/functional/loss.py:dice_loss",
+             spmd="default",
+             sample=sample([_f(2, 4, 3), _ii(2, 4, 1, lo=0, hi=3)],
+                           grad=[0])),
+    OpSchema("npair_loss", _npair_loss,
+             "anchor, positive, labels, l2_reg=0.002",
+             "N-pair metric-learning loss (softmax over pairwise "
+             "similarities + L2 regularization).",
+             ref="python/paddle/nn/functional/loss.py:npair_loss",
+             spmd="default",
+             sample=sample([_fneg(3, 4), _fneg(3, 4),
+                            _ii(3, lo=0, hi=2)], grad=[0, 1])),
+]
+
+# --------------------------------------------------------------------------
+# vision ops
+# --------------------------------------------------------------------------
+
+def _grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    """x (N, C, H, W), grid (N, Ho, Wo, 2) in [-1, 1] (xy order)."""
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * 0.5 * (W - 1)
+        fy = (gy + 1.0) * 0.5 * (H - 1)
+    else:
+        fx = ((gx + 1.0) * W - 1.0) * 0.5
+        fy = ((gy + 1.0) * H - 1.0) * 0.5
+
+    def gather(ix, iy):
+        """x[n, :, iy, ix] with padding; ix/iy (N, Ho, Wo) ints."""
+        inside = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+        ixc = jnp.clip(ix, 0, W - 1)
+        iyc = jnp.clip(iy, 0, H - 1)
+        n_idx = jnp.arange(N)[:, None, None]
+        vals = x[n_idx, :, iyc, ixc]            # (N, Ho, Wo, C)
+        if padding_mode == "zeros":
+            vals = jnp.where(inside[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:  # bilinear
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0)[..., None]
+        wy = (fy - y0)[..., None]
+        out = (gather(x0, y0) * (1 - wx) * (1 - wy)
+               + gather(x1, y0) * wx * (1 - wy)
+               + gather(x0, y1) * (1 - wx) * wy
+               + gather(x1, y1) * wx * wy)
+    return jnp.moveaxis(out, -1, 1)             # (N, C, Ho, Wo)
+
+
+def _affine_grid(theta, out_shape, align_corners=True):
+    """theta (N, 2, 3) -> sampling grid (N, H, W, 2) for grid_sample."""
+    N, _, H, W = out_shape
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, W)
+        ys = jnp.linspace(-1.0, 1.0, H)
+    else:
+        xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+    gx, gy = jnp.meshgrid(xs, ys)               # (H, W)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)   # (H, W, 3)
+    return jnp.einsum("hwk,njk->nhwj", base, theta)
+
+
+def _channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C, H, W = x.shape
+    out = x.reshape(N, groups, C // groups, H, W)
+    out = jnp.swapaxes(out, 1, 2).reshape(N, C, H, W)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+VISION = [
+    OpSchema("grid_sample", _grid_sample,
+             "x, grid, mode='bilinear', padding_mode='zeros', "
+             "align_corners=True",
+             "Sample input at normalized grid locations (bilinear/nearest, "
+             "zeros/border padding) — STN and deformable-conv building block.",
+             ref="paddle/phi/ops/yaml/ops.yaml:grid_sample", spmd="default",
+             sample=sample([_f(2, 3, 4, 4), _fneg(2, 5, 5, 2)], grad=[0, 1],
+                           rtol=3e-2, atol=3e-3)),
+    OpSchema("affine_grid", _affine_grid,
+             "theta, out_shape, align_corners=True",
+             "Generate the (N, H, W, 2) sampling grid of an affine transform.",
+             ref="paddle/phi/ops/yaml/ops.yaml:affine_grid", spmd="default",
+             sample=sample([_fneg(2, 2, 3), _S((2, 3, 4, 4))], grad=[0])),
+    OpSchema("channel_shuffle", _channel_shuffle,
+             "x, groups, data_format='NCHW'",
+             "Permute channels between groups (ShuffleNet block).",
+             ref="paddle/phi/ops/yaml/ops.yaml:channel_shuffle",
+             spmd="default",
+             sample=sample([_f(2, 4, 3, 3), _S(2)], grad=[0])),
+]
+
+# --------------------------------------------------------------------------
+# random sampling (global-generator keyed, like nn.functional.dropout)
+# --------------------------------------------------------------------------
+
+def _rng_key():
+    from paddle_tpu.framework import random as rnd
+    return rnd.split_key()
+
+
+def _bernoulli(x):
+    return jax.random.bernoulli(_rng_key(), x).astype(x.dtype)
+
+
+def _poisson(x):
+    return jax.random.poisson(_rng_key(), x).astype(x.dtype)
+
+
+def _standard_gamma(x):
+    return jax.random.gamma(_rng_key(), x).astype(x.dtype)
+
+
+def _multinomial(x, num_samples=1, replacement=False):
+    key = _rng_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(num_samples,) + x.shape[:-1]).T.astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape)
+    _, idx = lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+RANDOM = [
+    OpSchema("bernoulli", _bernoulli, "x",
+             "Sample 0/1 with per-element probability x (global generator).",
+             ref="paddle/phi/ops/yaml/ops.yaml:bernoulli",
+             differentiable=False, spmd="default",
+             sample=sample([_f(2, 3)], jit=False)),
+    OpSchema("poisson", _poisson, "x",
+             "Sample Poisson with per-element rate x.",
+             ref="paddle/phi/ops/yaml/ops.yaml:poisson",
+             differentiable=False, spmd="default",
+             sample=sample([_f(2, 3, lo=0.5, hi=3.0)], jit=False)),
+    OpSchema("standard_gamma", _standard_gamma, "x",
+             "Sample Gamma(shape=x, scale=1).",
+             ref="paddle/phi/ops/yaml/ops.yaml:standard_gamma",
+             differentiable=False, spmd="default",
+             sample=sample([_f(2, 3, lo=0.5, hi=3.0)], jit=False)),
+    OpSchema("multinomial", _multinomial,
+             "x, num_samples=1, replacement=False",
+             "Sample category indices from (batched) probability rows; "
+             "without replacement uses the Gumbel top-k trick.",
+             ref="paddle/phi/ops/yaml/ops.yaml:multinomial",
+             differentiable=False, spmd="default",
+             sample=sample([_f(2, 5)], kw={"num_samples": 2}, jit=False)),
+]
+
+# --------------------------------------------------------------------------
+# text metrics
+# --------------------------------------------------------------------------
+
+def _edit_distance(hyp, ref, hyp_lens, ref_lens, normalized=True):
+    """Batched Levenshtein distance over padded int sequences.
+
+    hyp (B, Th), ref (B, Tr) with per-sequence lengths. DP over ref
+    positions with a lax.scan carrying the DP row — O(Th*Tr) static work.
+    """
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    hl = jnp.asarray(hyp_lens)
+    rl = jnp.asarray(ref_lens)
+
+    # row_0: distance from empty ref prefix = hyp prefix length (masked)
+    init_row = jnp.broadcast_to(jnp.arange(Th + 1, dtype=jnp.float32),
+                                (B, Th + 1))
+
+    def outer(row, j):          # j over ref positions 1..Tr
+        rj = jnp.take_along_axis(ref, jnp.full((B, 1), j - 1), axis=1)[:, 0]
+
+        def inner(carry, i):    # i over hyp positions 1..Th
+            prev_row, new_row_prev, row_diag = carry
+            cost = (hyp[:, i - 1] != rj).astype(jnp.float32)
+            cand = jnp.minimum(
+                jnp.minimum(prev_row[:, i] + 1.0,   # deletion
+                            new_row_prev + 1.0),    # insertion
+                row_diag + cost)                    # substitution
+            return (prev_row, cand, prev_row[:, i]), cand
+
+        (_, _, _), cells = lax.scan(
+            inner, (row, jnp.full((B,), 1.0) * j, row[:, 0]),
+            jnp.arange(1, Th + 1))
+        new_row = jnp.concatenate(
+            [jnp.full((B, 1), 1.0) * j, cells.T], axis=1)
+        # rows beyond this sequence's ref length stay frozen
+        keep = (j <= rl)[:, None]
+        return jnp.where(keep, new_row, row), None
+
+    final_row, _ = lax.scan(outer, init_row, jnp.arange(1, Tr + 1))
+    dist = jnp.take_along_axis(final_row, hl[:, None], axis=1)[:, 0]
+    if normalized:
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return dist
+
+
+TEXT = [
+    OpSchema("edit_distance", _edit_distance,
+             "hyp, ref, hyp_lens, ref_lens, normalized=True",
+             "Batched Levenshtein distance between padded int sequences "
+             "(optionally normalized by reference length).",
+             ref="paddle/phi/kernels/cpu/edit_distance_kernel.cc",
+             differentiable=False, spmd="default",
+             sample=sample([_ii(2, 5, lo=0, hi=4), _ii(2, 6, lo=0, hi=4),
+                            _ii(2, lo=3, hi=6), _ii(2, lo=4, hi=7)])),
+]
+
+
+ALL_SCHEMAS = SPECIAL + REDUCTIONS + MANIP + CREATION + LOSSES + VISION \
+    + RANDOM + TEXT
+__all__ = build_ops(ALL_SCHEMAS, globals())

@@ -1,0 +1,423 @@
+"""Eager per-op SPMD (sharding propagation) rule table.
+
+Reference analog: paddle/phi/infermeta/spmd_rules/ — 42 C++ rule files
+(matmul.cc, elementwise.cc, embedding.cc, layer_norm.cc, ...) registered
+through SpmdRuleFactory (paddle/phi/core/distributed/auto_parallel/
+inferspmd_utils.h). Under jit, GSPMD already propagates shardings, so the
+compiled path gets rules "for free" (SURVEY §7.1); this table serves the
+EAGER layer: predicting/validating output placements (incl. Partial,
+which XLA never surfaces), planning reshards before a collective is paid,
+and documentation via get_spmd_rule().
+
+Representation follows the reference: a ``dims_mapping`` maps each tensor
+dim to a mesh axis or -1, plus a set of mesh axes the value is Partial
+over. Most rules are one line of einsum notation ("mk,kn->mn"); the
+propagation engine resolves conflicts (first writer wins, later
+conflicting inputs are marked for reshard-to-replicate) and converts
+contracted sharded letters into Partial(sum) on the output.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.parallel.placements import Partial, Placement, Replicate, Shard
+
+__all__ = [
+    "DistTensorSpec", "register_spmd_rule", "get_spmd_rule", "infer_spmd",
+    "einsum_rule", "SPMD_RULES", "placements_to_dims_mapping",
+    "dims_mapping_to_placements",
+]
+
+
+class DistTensorSpec:
+    """Shape + dims_mapping (+ partial mesh axes) — the rule-table currency
+    (reference DistTensorSpec in spmd-rule unit tests)."""
+
+    def __init__(self, shape: Sequence[int], dims_mapping: Sequence[int],
+                 partial_axes: Sequence[int] = ()):
+        if len(shape) != len(dims_mapping):
+            raise ValueError("shape and dims_mapping rank mismatch")
+        self.shape = tuple(shape)
+        self.dims_mapping = list(dims_mapping)
+        self.partial_axes = sorted(set(partial_axes))
+
+    @classmethod
+    def from_placements(cls, shape, placements: Sequence[Placement]):
+        dm, partial = placements_to_dims_mapping(placements, len(shape))
+        return cls(shape, dm, partial)
+
+    def placements(self, mesh_ndim: int) -> List[Placement]:
+        return dims_mapping_to_placements(self.dims_mapping,
+                                          self.partial_axes, mesh_ndim)
+
+    def __repr__(self):
+        p = f", partial={self.partial_axes}" if self.partial_axes else ""
+        return f"DistTensorSpec(shape={self.shape}, dims_mapping={self.dims_mapping}{p})"
+
+
+def placements_to_dims_mapping(placements, ndim: int):
+    dm = [-1] * ndim
+    partial = []
+    for mesh_axis, p in enumerate(placements):
+        if isinstance(p, Shard):
+            dm[p.dim] = mesh_axis
+        elif isinstance(p, Partial):
+            partial.append(mesh_axis)
+    return dm, partial
+
+
+def dims_mapping_to_placements(dims_mapping, partial_axes, mesh_ndim: int):
+    out: List[Placement] = [Replicate() for _ in range(mesh_ndim)]
+    for tdim, axis in enumerate(dims_mapping):
+        if axis >= 0:
+            out[axis] = Shard(tdim)
+    for axis in partial_axes:
+        out[axis] = Partial()
+    return out
+
+
+# rule: callable(specs: List[DistTensorSpec], **attrs)
+#   -> (resolved_input_specs, output_specs)
+SPMD_RULES: Dict[str, Callable] = {}
+
+
+def register_spmd_rule(name: str, rule=None):
+    """Register a propagation rule (SpmdRuleFactory::RegisterSpmdRule
+    analog). ``rule`` may be an einsum notation string or a callable;
+    usable as a decorator when ``rule`` is omitted."""
+    if isinstance(rule, str):
+        SPMD_RULES[name] = einsum_rule(rule)
+        return SPMD_RULES[name]
+    if rule is not None:
+        SPMD_RULES[name] = rule
+        return rule
+
+    def deco(fn):
+        SPMD_RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def get_spmd_rule(name: str) -> Callable:
+    if name not in SPMD_RULES:
+        raise KeyError(f"no SPMD rule registered for op {name!r}")
+    return SPMD_RULES[name]
+
+
+def infer_spmd(op_name: str, *specs: DistTensorSpec, **attrs):
+    """Run the op's rule: returns (resolved_input_specs, output_specs).
+    Resolved input specs tell the eager layer which inputs must be
+    resharded before the op (conflict losers become replicated)."""
+    return get_spmd_rule(op_name)(list(specs), **attrs)
+
+
+# --------------------------------------------------------------------------
+# the einsum propagation engine
+# --------------------------------------------------------------------------
+
+def _expand_ellipsis(terms: List[str], out: str, specs) -> Tuple[List[str], str]:
+    """Replace '...' with per-tensor broadcast letters (right-aligned)."""
+    max_extra = 0
+    for term, spec in zip(terms, specs):
+        if "..." in term:
+            max_extra = max(max_extra, len(spec.shape) - (len(term) - 3))
+    if max_extra == 0 and "..." not in out:
+        return [t.replace("...", "") for t in terms], out.replace("...", "")
+    # private uppercase letters for broadcast dims, outermost first
+    extra = [chr(ord("Z") - i) for i in range(max_extra)][::-1]
+    expanded = []
+    for term, spec in zip(terms, specs):
+        if "..." in term:
+            n = len(spec.shape) - (len(term) - 3)
+            expanded.append("".join(extra[max_extra - n:]) + term.replace("...", ""))
+        else:
+            expanded.append(term)
+    out = "".join(extra) + out.replace("...", "") if "..." in out else out
+    return expanded, out
+
+
+def einsum_rule(notation: str) -> Callable:
+    """Build a rule from einsum notation, e.g. "mk,kn->mn" (the reference's
+    einsum-notation-based rules, spmd_rules/matmul.cc)."""
+    lhs, rhs = notation.split("->")
+    in_terms = lhs.split(",")
+
+    def rule(specs: List[DistTensorSpec], **attrs):
+        if len(specs) != len(in_terms):
+            raise ValueError(
+                f"rule {notation!r} expects {len(in_terms)} inputs, "
+                f"got {len(specs)}")
+        terms, out_term = _expand_ellipsis(list(in_terms), rhs, specs)
+        # 1) letter -> mesh axis, first writer wins; track conflicts
+        letter_axis: Dict[str, int] = {}
+        used_axes: Dict[int, str] = {}
+        for term, spec in zip(terms, specs):
+            if len(term) != len(spec.shape):
+                raise ValueError(
+                    f"term {term!r} rank != tensor rank {len(spec.shape)}")
+            for letter, axis, size in zip(term, spec.dims_mapping, spec.shape):
+                if axis < 0:
+                    continue
+                if size == 1:
+                    continue  # broadcast dim: its sharding is meaningless
+                prev = letter_axis.get(letter)
+                if prev is None and axis not in used_axes:
+                    letter_axis[letter] = axis
+                    used_axes[axis] = letter
+                # else: conflict — resolved input drops this sharding
+        # 2) resolved inputs: each dim takes its letter's agreed axis, but
+        #    one mesh axis can shard only one letter
+        resolved_in = []
+        for term, spec in zip(terms, specs):
+            dm = []
+            for letter, size in zip(term, spec.shape):
+                axis = letter_axis.get(letter, -1)
+                dm.append(axis if (axis >= 0 and size != 1) else -1)
+            resolved_in.append(DistTensorSpec(spec.shape, dm))
+        # 3) output mapping + Partial for contracted sharded letters
+        out_shape = attrs.get("out_shape")
+        if out_shape is None:
+            sizes: Dict[str, int] = {}
+            for term, spec in zip(terms, specs):
+                for letter, size in zip(term, spec.shape):
+                    sizes[letter] = max(sizes.get(letter, 1), size)
+            out_shape = tuple(sizes[letter] for letter in out_term)
+        out_dm = [letter_axis.get(letter, -1) for letter in out_term]
+        contracted = [letter for letter in letter_axis
+                      if letter not in out_term]
+        partial = sorted(letter_axis[letter] for letter in contracted)
+        # inherit Partial already pending on inputs (e.g. chained matmuls)
+        for spec in specs:
+            for axis in spec.partial_axes:
+                if axis not in partial and axis not in out_dm:
+                    partial.append(axis)
+        out_spec = DistTensorSpec(out_shape, out_dm, sorted(partial))
+        return resolved_in, [out_spec]
+
+    return rule
+
+
+# --------------------------------------------------------------------------
+# the rule library (reference spmd_rules/*.cc)
+# --------------------------------------------------------------------------
+
+def _matmul(specs: List[DistTensorSpec], trans_x=False, trans_y=False, **attrs):
+    x, y = specs
+    nx, ny = len(x.shape), len(y.shape)
+    if trans_x:
+        x = DistTensorSpec(x.shape[:-2] + (x.shape[-1], x.shape[-2]),
+                           x.dims_mapping[:-2] + [x.dims_mapping[-1],
+                                                  x.dims_mapping[-2]],
+                           x.partial_axes)
+    if trans_y:
+        y = DistTensorSpec(y.shape[:-2] + (y.shape[-1], y.shape[-2]),
+                           y.dims_mapping[:-2] + [y.dims_mapping[-1],
+                                                  y.dims_mapping[-2]],
+                           y.partial_axes)
+    batch = max(nx, ny) - 2
+    letters = "abcdefgh"[:batch]
+    tx = ("..." if nx > 2 else "") + "mk"
+    ty = ("..." if ny > 2 else "") + "kn"
+    if nx == 1:
+        tx = "k"
+    if ny == 1:
+        ty = "k"
+    out = []
+    if batch > 0:
+        out.append("...")
+    if nx > 1:
+        out.append("m")
+    if ny > 1:
+        out.append("n")
+    notation = f"{tx},{ty}->{''.join(out)}"
+    rin, rout = einsum_rule(notation)([x, y], **attrs)
+    if trans_x:
+        s = rin[0]
+        rin[0] = DistTensorSpec(
+            s.shape[:-2] + (s.shape[-1], s.shape[-2]),
+            s.dims_mapping[:-2] + [s.dims_mapping[-1], s.dims_mapping[-2]],
+            s.partial_axes)
+    if trans_y:
+        s = rin[1]
+        rin[1] = DistTensorSpec(
+            s.shape[:-2] + (s.shape[-1], s.shape[-2]),
+            s.dims_mapping[:-2] + [s.dims_mapping[-1], s.dims_mapping[-2]],
+            s.partial_axes)
+    return rin, rout
+
+
+SPMD_RULES["matmul"] = _matmul
+
+
+def _elementwise(specs: List[DistTensorSpec], **attrs):
+    notation = ",".join("..." for _ in specs) + "->..."
+    return einsum_rule(notation)(specs, **attrs)
+
+
+for _name in ("elementwise", "add", "subtract", "multiply", "divide",
+              "maximum", "minimum", "pow", "where"):
+    SPMD_RULES[_name] = _elementwise
+
+
+@register_spmd_rule("reduction")
+def _reduction(specs, axis=None, keepdim=False, **attrs):
+    (x,) = specs
+    ndim = len(x.shape)
+    if axis is None:
+        axes = tuple(range(ndim))
+    else:
+        axes = tuple(a % ndim for a in
+                     (axis if isinstance(axis, (tuple, list)) else (axis,)))
+    out_dm, out_shape, partial = [], [], list(x.partial_axes)
+    for d in range(ndim):
+        if d in axes:
+            if x.dims_mapping[d] >= 0:
+                partial.append(x.dims_mapping[d])  # reduced sharded dim
+            if keepdim:
+                out_dm.append(-1)
+                out_shape.append(1)
+        else:
+            out_dm.append(x.dims_mapping[d])
+            out_shape.append(x.shape[d])
+    return [x], [DistTensorSpec(out_shape, out_dm, sorted(set(partial)))]
+
+
+for _name in ("sum", "mean", "max", "min", "prod"):
+    SPMD_RULES[_name] = _reduction
+
+
+@register_spmd_rule("embedding")
+def _embedding(specs, **attrs):
+    ids, table = specs
+    v_axis = table.dims_mapping[0]
+    e_axis = table.dims_mapping[1]
+    out_dm = list(ids.dims_mapping) + [e_axis]
+    out_shape = tuple(ids.shape) + (table.shape[1],)
+    # vocab-parallel: each shard contributes a masked partial lookup that
+    # must be summed (reference spmd_rules/embedding.cc)
+    partial = [v_axis] if v_axis >= 0 else []
+    return ([ids, table],
+            [DistTensorSpec(out_shape, out_dm, partial)])
+
+
+@register_spmd_rule("layer_norm")
+def _layer_norm(specs, begin_norm_axis=-1, **attrs):
+    x = specs[0]
+    ndim = len(x.shape)
+    axes = (begin_norm_axis % ndim,) if begin_norm_axis != -1 else (ndim - 1,)
+    dm = [a if d < min(axes) else -1 for d, a in enumerate(x.dims_mapping)]
+    rin = [DistTensorSpec(x.shape, dm, x.partial_axes)]
+    for s in specs[1:]:  # scale/bias replicated
+        rin.append(DistTensorSpec(s.shape, [-1] * len(s.shape)))
+    return rin, [DistTensorSpec(x.shape, dm, list(x.partial_axes))]
+
+
+SPMD_RULES["rms_norm"] = SPMD_RULES["layer_norm"]
+
+
+@register_spmd_rule("softmax")
+def _softmax(specs, axis=-1, **attrs):
+    (x,) = specs
+    ndim = len(x.shape)
+    a = axis % ndim
+    dm = [m if d != a else -1 for d, m in enumerate(x.dims_mapping)]
+    r = DistTensorSpec(x.shape, dm, x.partial_axes)
+    return [r], [DistTensorSpec(x.shape, dm, list(x.partial_axes))]
+
+
+@register_spmd_rule("transpose")
+def _transpose(specs, perm=None, **attrs):
+    (x,) = specs
+    ndim = len(x.shape)
+    perm = perm or list(range(ndim))[::-1]
+    out_dm = [x.dims_mapping[p] for p in perm]
+    out_shape = [x.shape[p] for p in perm]
+    return [x], [DistTensorSpec(out_shape, out_dm, list(x.partial_axes))]
+
+
+@register_spmd_rule("reshape")
+def _reshape(specs, shape=None, **attrs):
+    """Conservative: keep shardings of leading dims that survive unchanged
+    (prefix match by size); everything after the first changed dim drops to
+    replicated. Reference reshape.cc does full dim-transform inference."""
+    (x,) = specs
+    out_shape = list(shape)
+    out_dm = [-1] * len(out_shape)
+    for d in range(min(len(x.shape), len(out_shape))):
+        if x.shape[d] != out_shape[d]:
+            break
+        out_dm[d] = x.dims_mapping[d]
+    return [x], [DistTensorSpec(out_shape, out_dm, list(x.partial_axes))]
+
+
+@register_spmd_rule("concat")
+def _concat(specs, axis=0, **attrs):
+    ndim = len(specs[0].shape)
+    a = axis % ndim
+    dm = [-1] * ndim
+    for d in range(ndim):
+        if d == a:
+            continue
+        axes = {s.dims_mapping[d] for s in specs}
+        if len(axes) == 1 and (v := axes.pop()) >= 0:
+            dm[d] = v
+    rin = [DistTensorSpec(s.shape, [m if d != a else -1
+                                    for d, m in enumerate(dm)])
+           for s in specs]
+    out_shape = list(specs[0].shape)
+    out_shape[a] = sum(s.shape[a] for s in specs)
+    return rin, [DistTensorSpec(out_shape, dm)]
+
+
+@register_spmd_rule("split")
+def _split(specs, num_or_sections=1, axis=0, **attrs):
+    (x,) = specs
+    ndim = len(x.shape)
+    a = axis % ndim
+    dm = [m if d != a else -1 for d, m in enumerate(x.dims_mapping)]
+    n = (num_or_sections if isinstance(num_or_sections, int)
+         else len(num_or_sections))
+    sizes = ([x.shape[a] // n] * n if isinstance(num_or_sections, int)
+             else list(num_or_sections))
+    outs = []
+    for s in sizes:
+        shp = list(x.shape)
+        shp[a] = s
+        outs.append(DistTensorSpec(shp, list(dm), list(x.partial_axes)))
+    return [DistTensorSpec(x.shape, dm, x.partial_axes)], outs
+
+
+@register_spmd_rule("flash_attention")
+def _flash_attention(specs, **attrs):
+    """(B, S, H, D) q/k/v: batch and head shardings propagate; sequence and
+    head_dim must be local (ring attention handles sharded S separately).
+    Reference spmd_rules/flash_attention.cc."""
+    q = specs[0]
+    keep = {0: q.dims_mapping[0], 2: q.dims_mapping[2]}
+    dm = [keep.get(d, -1) for d in range(4)]
+    rin = [DistTensorSpec(s.shape, [keep.get(d, -1) for d in range(4)])
+           for s in specs]
+    return rin, [DistTensorSpec(q.shape, dm)]
+
+
+@register_spmd_rule("cross_entropy_with_softmax")
+def _cross_entropy(specs, **attrs):
+    """Vocab-parallel logits (last dim sharded on axis a) produce a loss
+    that is Partial(sum) over a — the Megatron trick the reference encodes
+    in cross_entropy_with_softmax.cc."""
+    logits, label = specs
+    v_axis = logits.dims_mapping[-1]
+    out_shape = tuple(logits.shape[:-1])
+    out_dm = list(logits.dims_mapping[:-1])
+    partial = [v_axis] if v_axis >= 0 else []
+    return ([logits, label],
+            [DistTensorSpec(out_shape, out_dm, partial)])
+
+
+@register_spmd_rule("default")
+def _default(specs, **attrs):
+    """Fallback: inputs and outputs fully replicated."""
+    rin = [DistTensorSpec(s.shape, [-1] * len(s.shape)) for s in specs]
+    return rin, [DistTensorSpec(s.shape, [-1] * len(s.shape)) for s in specs]

@@ -158,3 +158,108 @@ def nnz(x) -> int:
 
 def transpose(x, perm):
     return _wrap_sparse(_sp(x).transpose(tuple(perm)))
+
+
+# --------------------------------------------------------------------------
+# value-wise unary math (sparsity-preserving; python/paddle/sparse/unary.py)
+# --------------------------------------------------------------------------
+
+def _valuewise(name, fn):
+    def op(x, *args):
+        m = _sp(x)
+        return _wrap_sparse(jsparse.BCOO((fn(m.data, *args), m.indices),
+                                         shape=m.shape))
+
+    op.__name__ = name
+    op.__doc__ = (f"sparse.{name}: apply {name} to the stored values; "
+                  "zero entries stay zero (sparsity-preserving unary, "
+                  "python/paddle/sparse/unary.py analog).")
+    globals()[name] = op
+    __all__.append(name)
+    return op
+
+
+for _n, _f in [
+    ("sin", jnp.sin), ("tan", jnp.tan), ("asin", jnp.arcsin),
+    ("atan", jnp.arctan), ("sinh", jnp.sinh), ("tanh", jnp.tanh),
+    ("asinh", jnp.arcsinh), ("atanh", jnp.arctanh), ("sqrt", jnp.sqrt),
+    ("square", jnp.square), ("log1p", jnp.log1p), ("abs", jnp.abs),
+    ("expm1", jnp.expm1), ("neg", lambda v: -v),
+    ("leaky_relu", lambda v, slope=0.01: jnp.where(v >= 0, v, slope * v)),
+    ("relu6", lambda v: jnp.clip(v, 0.0, 6.0)),
+]:
+    _valuewise(_n, _f)
+
+
+def pow(x, factor):  # noqa: A001 - paddle API name
+    m = _sp(x)
+    return _wrap_sparse(jsparse.BCOO((m.data ** factor, m.indices),
+                                     shape=m.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    m = _sp(x)
+    data = m.data.astype(value_dtype) if value_dtype else m.data
+    idx = m.indices.astype(index_dtype) if index_dtype else m.indices
+    return _wrap_sparse(jsparse.BCOO((data, idx), shape=m.shape))
+
+
+def divide(x, y):
+    xm = _sp(x)
+    ym = _sp(y)
+    yd = ym.todense() if isinstance(ym, jsparse.BCOO) else jnp.asarray(ym)
+    if jnp.ndim(yd) == 0:
+        return _wrap_sparse(jsparse.BCOO((xm.data / yd, xm.indices),
+                                         shape=xm.shape))
+    picked = yd[tuple(xm.indices.T)]
+    return _wrap_sparse(jsparse.BCOO((xm.data / picked, xm.indices),
+                                     shape=xm.shape))
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over the STORED entries only (implicit zeros are
+    excluded), the reference's sparse softmax semantics
+    (paddle/phi/kernels/sparse/cpu/softmax_kernel.cc). Rows are identified
+    by ALL leading index dims (batched sparse inputs normalize per row,
+    not per dim-0 slab)."""
+    import jax
+    m = _sp(x).sum_duplicates()
+    if axis not in (-1, m.ndim - 1):
+        raise NotImplementedError("sparse softmax: last axis only")
+    lead = m.indices[:, :-1]                   # (nnz, ndim-1)
+    strides = []
+    acc = 1
+    for d in m.shape[:-1][::-1]:
+        strides.append(acc)
+        acc *= d
+    strides = jnp.asarray(strides[::-1], lead.dtype)
+    rows = jnp.sum(lead * strides[None, :], axis=1) if lead.shape[1] else \
+        jnp.zeros((m.indices.shape[0],), m.indices.dtype)
+    n_rows = int(acc)
+    row_max = jax.ops.segment_max(m.data, rows, num_segments=n_rows)
+    shifted = jnp.exp(m.data - row_max[rows])
+    denom = jax.ops.segment_sum(shifted, rows, num_segments=n_rows)
+    return _wrap_sparse(jsparse.BCOO((shifted / denom[rows], m.indices),
+                                     shape=m.shape))
+
+
+__all__ += ["pow", "cast", "divide", "softmax", "matmul_values", "nn"]
+
+
+def matmul_values(values, indices, shape, dense):
+    """sparse @ dense, differentiable wrt the sparse VALUES (the sparse
+    training story): ``values`` is a (possibly trainable Parameter) value
+    vector, ``indices`` the (2, nnz) COO pattern closed over as static, so
+    ``backward()`` lands grads directly on the persistent values tensor."""
+    idx = jnp.asarray(_sp(indices)).T if jnp.ndim(_sp(indices)) == 2 and \
+        jnp.shape(_sp(indices))[0] == 2 else jnp.asarray(_sp(indices))
+    shape = tuple(shape)
+
+    def impl(v, d):
+        return jsparse.BCOO((v, idx), shape=shape) @ d
+
+    opdef = OpDef("sparse_matmul_values", impl)
+    return apply_op(opdef, (values, dense), {})
+
+
+from paddle_tpu.sparse import nn  # noqa: E402,F401

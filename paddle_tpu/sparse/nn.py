@@ -1,0 +1,116 @@
+"""paddle_tpu.sparse.nn — layers over sparse tensors
+(python/paddle/sparse/nn/ analog).
+
+Activation layers apply sparsity-preserving value-wise ops; BatchNorm
+normalizes the stored values per channel (last dim), matching the
+reference's sparse BatchNorm semantics (statistics over non-zero entries,
+paddle/phi/kernels/sparse/batch_norm_kernel.cc); Linear is a trainable
+fixed-pattern sparse weight trained via sparse.matmul_values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.sparse as sparse
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SparseLinear"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return sparse.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return sparse.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return sparse.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return sparse.softmax(x, axis=self.axis)
+
+
+class BatchNorm(Layer):
+    """Normalize stored values per channel (the trailing dense dim of an
+    (N, ..., C)-shaped sparse tensor)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([num_features])
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        from jax.experimental import sparse as jsparse
+
+        m = x._value
+        ch = m.indices[:, -1]
+        vals = m.data
+        if self.training:
+            mean = jnp.zeros((self.num_features,)).at[ch].add(vals)
+            cnt = jnp.zeros((self.num_features,)).at[ch].add(1.0)
+            mean = mean / jnp.maximum(cnt, 1.0)
+            var = jnp.zeros((self.num_features,)).at[ch].add(
+                (vals - mean[ch]) ** 2) / jnp.maximum(cnt, 1.0)
+            self._mean._set_value(self.momentum * self._mean.value
+                                  + (1 - self.momentum) * mean)
+            self._variance._set_value(self.momentum * self._variance.value
+                                      + (1 - self.momentum) * var)
+        else:
+            mean, var = self._mean.value, self._variance.value
+        normed = (vals - mean[ch]) / jnp.sqrt(var[ch] + self.epsilon)
+        out_vals = normed * self.weight.value[ch] + self.bias.value[ch]
+        out = Tensor.__new__(type(x))
+        Tensor.__init__(out, 0.0)
+        out._value = jsparse.BCOO((out_vals, m.indices), shape=m.shape)
+        return out
+
+
+class SparseLinear(Layer):
+    """Fixed-sparsity-pattern linear layer: a trainable value vector over a
+    static COO pattern (the sparse TRAINING story — grads land on values
+    through sparse.matmul_values)."""
+
+    def __init__(self, in_features, out_features, density=0.1, seed=0):
+        super().__init__()
+        rng = np.random.default_rng(seed)
+        nnz = max(1, int(in_features * out_features * density))
+        flat = rng.choice(in_features * out_features, size=nnz, replace=False)
+        idx = np.stack([flat // out_features, flat % out_features])
+        self.indices = Tensor(jnp.asarray(idx))
+        self.shape = (in_features, out_features)
+        scale = float(np.sqrt(1.0 / max(1, in_features * density)))
+        self.values = self.create_parameter(
+            [nnz], default_initializer=lambda shape, dtype: jnp.asarray(
+                rng.normal(0, scale, shape[0]).astype(np.float32)))
+
+    def forward(self, x):
+        # (B, in) @ sparse(in, out): transpose trick keeps the sparse
+        # operand on the left of the sparse kernel
+        out_t = sparse.matmul_values(
+            self.values, Tensor(self.indices.value[::-1]),
+            (self.shape[1], self.shape[0]), x.transpose([1, 0]))
+        return out_t.transpose([1, 0])

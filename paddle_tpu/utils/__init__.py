@@ -1,3 +1,4 @@
-"""paddle_tpu.utils — extension/loading utilities."""
+"""paddle_tpu.utils — extension/loading/debugging utilities."""
 
 from paddle_tpu.utils import cpp_extension  # noqa: F401
+from paddle_tpu.utils.subgraph_checker import SubgraphReport, check_layer  # noqa: F401

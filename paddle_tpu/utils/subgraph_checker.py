@@ -1,0 +1,149 @@
+"""Subgraph checker: eager-vs-compiled parity localization (N37).
+
+Reference analog: the subgraph/accuracy checking tooling
+(paddle/fluid/framework/details + test/legacy_test precision checks, and
+the paddle.amp.debugging accuracy-compare flow): when a compiled model
+diverges from eager, find WHICH sublayer first disagrees instead of
+bisecting by hand.
+
+``check_layer(layer, inputs)`` runs one eager forward with hooks capturing
+every sublayer's inputs/outputs, then re-runs each sublayer's forward under
+``jax.jit`` on the captured inputs and compares. Reports per-sublayer max
+abs/rel error, worst-first, and flags the first divergence beyond
+tolerance. Works on any Layer tree (leaf sublayers by default).
+
+Divergence sources it localizes: non-traceable Python in forward (runs
+differently under trace), dtype promotion differences, XLA fusion
+reassociation at low precision, stale buffers mutated outside the tape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["check_layer", "SubgraphReport"]
+
+
+class SubgraphReport:
+    """Per-sublayer parity entries: (name, max_abs, max_rel, ok)."""
+
+    def __init__(self, entries: List[dict], rtol: float, atol: float):
+        self.entries = entries
+        self.rtol = rtol
+        self.atol = atol
+
+    @property
+    def failures(self) -> List[dict]:
+        return [e for e in self.entries if not e["ok"]]
+
+    @property
+    def first_divergence(self) -> Optional[dict]:
+        return self.failures[0] if self.failures else None
+
+    def __str__(self):
+        lines = [f"subgraph check: {len(self.entries)} sublayers, "
+                 f"{len(self.failures)} diverging "
+                 f"(rtol={self.rtol}, atol={self.atol})"]
+        worst = sorted(self.entries, key=lambda e: -e["max_abs"])
+        for e in worst[:20]:
+            mark = "FAIL" if not e["ok"] else " ok "
+            lines.append(f"  [{mark}] {e['name']:<40} "
+                         f"max_abs={e['max_abs']:.3e} "
+                         f"max_rel={e['max_rel']:.3e}")
+        return "\n".join(lines)
+
+
+def _leaves(out):
+    from paddle_tpu.framework.tensor import Tensor
+    import jax
+
+    return [x for x in jax.tree_util.tree_leaves(
+        out, is_leaf=lambda v: isinstance(v, Tensor))
+        if isinstance(x, Tensor)]
+
+
+def check_layer(layer, inputs: Sequence, rtol: float = 1e-4,
+                atol: float = 1e-5, leaf_only: bool = True,
+                verbose: bool = False) -> SubgraphReport:
+    """Run ``layer(*inputs)`` eagerly, then re-run every sublayer compiled
+    on its captured inputs; compare outputs sublayer by sublayer."""
+    import jax
+
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.framework.tensor import Tensor
+
+    captured: Dict[str, dict] = {}
+    removers = []
+    for name, sub in layer.named_sublayers(include_self=True):
+        if leaf_only and any(True for _ in sub.sublayers(include_self=False)):
+            continue
+
+        def make_hook(nm):
+            def post_hook(lyr, hook_inputs, output):
+                if nm not in captured:  # first call only (shared modules)
+                    captured[nm] = {"layer": lyr, "inputs": hook_inputs,
+                                    "output": output}
+                return output
+
+            return post_hook
+
+        removers.append(sub.register_forward_post_hook(make_hook(name or
+                                                                 "<root>")))
+    try:
+        with tape.no_grad():
+            layer(*[x if isinstance(x, Tensor) else Tensor(x)
+                    for x in inputs])
+    finally:
+        for r in removers:
+            r.remove()
+
+    entries = []
+    for name, rec in captured.items():
+        sub = rec["layer"]
+        in_tensors = [x for x in rec["inputs"] if isinstance(x, Tensor)]
+        statics = [x for x in rec["inputs"] if not isinstance(x, Tensor)]
+
+        def fwd(*vals):
+            with tape.no_grad():
+                rebuilt, k = [], 0
+                for x in rec["inputs"]:
+                    if isinstance(x, Tensor):
+                        rebuilt.append(Tensor(vals[k]))
+                        k += 1
+                    else:
+                        rebuilt.append(x)
+                out = sub(*rebuilt)
+                return [t._value for t in _leaves(out)]
+
+        del statics
+        try:
+            jit_out = jax.jit(fwd)(*[t._value for t in in_tensors])
+        except Exception as e:  # non-traceable forward IS the finding
+            entries.append(dict(name=name, max_abs=float("inf"),
+                                max_rel=float("inf"), ok=False,
+                                error=f"not traceable: {e!r}"[:200]))
+            continue
+        eager_leaves = _leaves(rec["output"])
+        max_abs = max_rel = 0.0
+        for e_t, j_v in zip(eager_leaves, jit_out):
+            a = np.asarray(e_t.numpy(), dtype=np.float64)
+            b = np.asarray(j_v, dtype=np.float64)
+            if a.shape != b.shape:
+                max_abs = max_rel = float("inf")
+                break
+            if a.size == 0 or not np.issubdtype(a.dtype, np.floating):
+                continue
+            diff = np.abs(a - b)
+            max_abs = max(max_abs, float(diff.max(initial=0.0)))
+            denom = np.maximum(np.abs(a), 1e-12)
+            max_rel = max(max_rel, float((diff / denom).max(initial=0.0)))
+        ok = (max_abs <= atol) or (max_rel <= rtol)
+        entries.append(dict(name=name, max_abs=max_abs, max_rel=max_rel,
+                            ok=ok))
+
+    report = SubgraphReport(entries, rtol, atol)
+    if verbose:
+        print(report)
+    return report

@@ -161,3 +161,36 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, width=128, pretrained=pretrained,
                    **kwargs)
+
+
+def _resnext(depth, groups, width, pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, depth, width=width, pretrained=pretrained,
+                   groups=groups, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)
+
+
+__all__ += ["resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+            "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d"]

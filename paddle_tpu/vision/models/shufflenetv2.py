@@ -1,0 +1,115 @@
+"""ShuffleNetV2 (python/paddle/vision/models/shufflenetv2.py analog).
+
+Uses the schema-codegen'd channel_shuffle op (ops/schema_defs.py)."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _act(name):
+    return nn.Hardswish() if name == "swish" else nn.ReLU()
+
+
+def _conv_bn_act(in_c, out_c, k, stride, pad, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act is not None:
+        layers.append(_act(act))
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(branch_c, branch_c, 1, 1, 0, act=act),
+                _conv_bn_act(branch_c, branch_c, 3, 1, 1, groups=branch_c,
+                             act=None),
+                _conv_bn_act(branch_c, branch_c, 1, 1, 0, act=act))
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn_act(in_c, in_c, 3, stride, 1, groups=in_c,
+                             act=None),
+                _conv_bn_act(in_c, branch_c, 1, 1, 0, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(in_c, branch_c, 1, 1, 0, act=act),
+                _conv_bn_act(branch_c, branch_c, 3, stride, 1,
+                             groups=branch_c, act=None),
+                _conv_bn_act(branch_c, branch_c, 1, 1, 0, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn_act(3, c0, 3, 2, 1, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = c0
+        for out_c, repeat in zip((c1, c2, c3), (4, 8, 4)):
+            stages.append(_InvertedResidual(in_c, out_c, 2, act))
+            for _ in range(repeat - 1):
+                stages.append(_InvertedResidual(out_c, out_c, 1, act))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn_act(in_c, c_last, 1, 1, 0, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _make(scale, act="relu", name=""):
+    def f(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("pretrained weights: use paddle.hub")
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+    f.__name__ = name
+    return f
+
+
+shufflenet_v2_x0_25 = _make(0.25, name="shufflenet_v2_x0_25")
+shufflenet_v2_x0_33 = _make(0.33, name="shufflenet_v2_x0_33")
+shufflenet_v2_x0_5 = _make(0.5, name="shufflenet_v2_x0_5")
+shufflenet_v2_x1_0 = _make(1.0, name="shufflenet_v2_x1_0")
+shufflenet_v2_x1_5 = _make(1.5, name="shufflenet_v2_x1_5")
+shufflenet_v2_x2_0 = _make(2.0, name="shufflenet_v2_x2_0")
+shufflenet_v2_swish = _make(1.0, act="swish", name="shufflenet_v2_swish")

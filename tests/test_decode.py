@@ -158,6 +158,7 @@ def test_sampled_decode_topk_topp():
     np.testing.assert_array_equal(greedy, cold)
 
 
+@pytest.mark.slow
 def test_model_generate_api_llama_and_gpt():
     """GenerationMixin surface: model.generate on both families; Llama
     rides the KV-cache decoder, GPT the no-cache fallback — same tokens."""

@@ -16,6 +16,7 @@ def clean():
     set_mesh(None)
 
 
+@pytest.mark.slow
 def test_pp_trainer_loss_decreases_and_matches_eager_init():
     cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
                       num_hidden_layers=4, num_attention_heads=4,

@@ -1,0 +1,87 @@
+"""ONNX export tests (P20): wire-format round trip + numpy-runtime
+numerics parity for MLP / conv / softmax models, and the error paths."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import onnx
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_proto_roundtrip_structure():
+    net = _mlp()
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    data = onnx.to_model_bytes(net, [x])
+    m = onnx.parse_model(data)
+    assert m["producer"] == "paddle_tpu"
+    assert m["opset"] == 13 and m["ir_version"] == 8
+    assert m["inputs"] == ["input_0"] and m["outputs"] == ["output_0"]
+    ops = [n["op"] for n in m["nodes"]]
+    assert "MatMul" in ops and "Max" in ops  # relu lowers to Max(x, 0)
+    # weights became initializers under their parameter names
+    assert any(k.endswith("weight") for k in m["initializers"])
+
+
+def test_mlp_numerics_parity():
+    net = _mlp()
+    x = np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32)
+    data = onnx.to_model_bytes(net, [x])
+    (got,) = onnx.run_model(data, [x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lenet_conv_pool_parity():
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    net = LeNet(num_classes=10)
+    x = np.random.default_rng(2).normal(size=(2, 1, 28, 28)).astype(np.float32)
+    data = onnx.to_model_bytes(net, [x])
+    ops = {n["op"] for n in onnx.parse_model(data)["nodes"]}
+    assert "Conv" in ops and "MaxPool" in ops
+    (got,) = onnx.run_model(data, [x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_and_layernorm_parity():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 6), nn.LayerNorm(6), nn.Softmax())
+    x = np.random.default_rng(3).normal(size=(3, 8)).astype(np.float32)
+    data = onnx.to_model_bytes(net, [x])
+    (got,) = onnx.run_model(data, [x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_writes_file(tmp_path):
+    net = _mlp()
+    from paddle_tpu.static import InputSpec
+    path = onnx.export(net, str(tmp_path / "model"),
+                       input_spec=[InputSpec([2, 8], "float32")])
+    assert path.endswith(".onnx")
+    data = open(path, "rb").read()
+    assert onnx.parse_model(data)["nodes"]
+
+
+def test_export_requires_input_spec():
+    with pytest.raises(ValueError):
+        onnx.export(_mlp(), "m")
+
+
+def test_unsupported_primitive_is_named():
+    class WithSort(nn.Layer):
+        def forward(self, x):
+            import jax.numpy as jnp
+            from paddle_tpu.framework.tensor import Tensor
+            return Tensor(jnp.sort(x._value, axis=-1))
+
+    x = np.random.default_rng(4).normal(size=(2, 8)).astype(np.float32)
+    with pytest.raises(NotImplementedError, match="sort"):
+        onnx.to_model_bytes(WithSort(), [x])

@@ -551,6 +551,47 @@ def _loss_value(out, sel):
     return total
 
 
+def _schema_specs():
+    """Translate OpSchema.sample mini-language specs (ops/schema.py) into
+    sweep specs — every schema-codegen'd op is swept automatically."""
+    from paddle_tpu.ops.schema import _SCHEMAS
+
+    def maker(item):
+        kind = item[0]
+        if kind == "S":
+            return S(item[1])
+        if kind == "f":
+            *shape, opts = item[1:]
+            return f(*shape, lo=opts.get("lo", 0.2), hi=opts.get("hi", 0.9))
+        if kind == "ii":
+            *shape, opts = item[1:]
+            return ii(*shape, lo=opts.get("lo", 0), hi=opts.get("hi", 4))
+        if kind == "bb":
+            return bb(*item[1:])
+        if kind == "sorted":
+            n = item[1]
+            return lambda r: np.sort(r.uniform(0, 1, n).astype(np.float32))
+        if kind == "list_f":
+            k = item[1]
+            shapes = item[2:]
+            if len(shapes) == 1:
+                shapes = shapes * k
+            return [f(*s) for s in shapes]
+        raise KeyError(f"unknown sample maker kind {kind!r}")
+
+    out = {}
+    for name, sch in _SCHEMAS.items():
+        if name in SPECS or sch.sample is None:
+            continue
+        sp = sch.sample
+        out[name] = spec([maker(i) for i in sp["in_"]], kw=sp["kw"],
+                         grad=sp["grad"], jit=sp["jit"],
+                         rtol=sp["rtol"], atol=sp["atol"])
+    return out
+
+
+SPECS.update(_schema_specs())
+
 SWEPT = sorted(set(SPECS) & set(OPS))
 
 

@@ -29,6 +29,7 @@ def _make_stages(n, d, rng):
              "b": jnp.zeros((d,), jnp.float32)} for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential(mesh):
     rng = np.random.default_rng(0)
     d, M, B = 8, 6, 4

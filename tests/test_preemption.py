@@ -32,14 +32,21 @@ def test_preemption_detect_and_resume(tmp_path):
     loss_fn = lambda m, x, y: paddle.nn.functional.cross_entropy(m(x), y)
 
     # --- epoch 0: two elastic members training; one gets preempted -------
+    # generous ttl: under a fully loaded machine (suite runs many compile
+    # jobs) heartbeat threads can starve for hundreds of ms; a tight ttl
+    # makes healthy members expire spuriously
     store = TCPStore(is_master=True, world_size=1)
     survivor = ElasticManager(store, "node0", np_range="1:2",
-                              heartbeat_s=0.1, ttl_s=0.5)
+                              heartbeat_s=0.2, ttl_s=3.0)
     victim = ElasticManager(store, "node1", np_range="1:2",
-                            heartbeat_s=0.1, ttl_s=0.5)
+                            heartbeat_s=0.2, ttl_s=3.0)
     survivor.start()
     victim.start()
-    time.sleep(0.3)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sorted(survivor.members) == ["node0", "node1"]:
+            break
+        time.sleep(0.1)
     assert sorted(survivor.members) == ["node0", "node1"]
 
     mesh = init_mesh((8,), ("dp",))
@@ -59,7 +66,7 @@ def test_preemption_detect_and_resume(tmp_path):
         victim._stop.set()
         victim._thread.join(timeout=2)
         # wait for its TTL to lapse and the survivor to notice
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             if survivor.members == ["node0"]:
                 break

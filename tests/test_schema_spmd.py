@@ -1,0 +1,155 @@
+"""Op schema codegen (ops/schema.py) + eager SPMD rule table
+(ops/spmd_rules.py) tests.
+
+Reference capability: paddle/phi/ops/yaml + api generators (N7) and
+paddle/phi/infermeta/spmd_rules (N9, unit-tested upstream in
+test/auto_parallel/spmd_rules/). The GSPMD cross-check validates the rule
+table against what XLA actually propagates on a virtual 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import OPS
+from paddle_tpu.ops.schema import describe, get_schema
+from paddle_tpu.ops.spmd_rules import (DistTensorSpec, SPMD_RULES,
+                                       dims_mapping_to_placements,
+                                       get_spmd_rule, infer_spmd,
+                                       placements_to_dims_mapping)
+from paddle_tpu.parallel.placements import Partial, Replicate, Shard
+
+
+def test_schema_codegen_fanout():
+    # one schema produced: registry entry, doc'd API, SPMD binding, sample
+    s = get_schema("huber_loss")
+    assert "huber_loss" in OPS
+    assert OPS["huber_loss"].ref == s.ref
+    assert "Smooth-L1" in paddle.nn.functional.huber_loss.__doc__
+    assert "sharding rule" in describe("huber_loss")
+    assert s.sample is not None
+    assert "trace" in SPMD_RULES  # spmd binding happened at build time
+
+
+def test_schema_ops_callable_with_defaults():
+    x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    assert float(paddle.trace(x).numpy()) == 0 + 4 + 8
+    vals, idx = paddle.kthvalue(x, 2, axis=1)
+    np.testing.assert_array_equal(vals.numpy(), [1.0, 4.0, 7.0])
+    out = paddle.nn.functional.huber_loss(x, x)
+    assert float(out.numpy()) == 0.0
+
+
+def test_schema_duplicate_name_rejected():
+    from paddle_tpu.ops.schema import OpSchema, build_ops
+    with pytest.raises(KeyError):
+        build_ops([OpSchema("trace", lambda x: x, "x", "dup")], {})
+
+
+def test_dims_mapping_roundtrip():
+    pls = [Shard(1), Replicate(), Partial()]
+    dm, partial = placements_to_dims_mapping(pls, ndim=3)
+    assert dm == [-1, 0, -1] and partial == [2]
+    back = dims_mapping_to_placements(dm, partial, mesh_ndim=3)
+    assert back[0] == Shard(1) and back[1] == Replicate() \
+        and back[2] == Partial()
+
+
+def test_matmul_rule_basic_and_partial():
+    x = DistTensorSpec((8, 4), [0, -1])
+    y = DistTensorSpec((4, 6), [-1, 1])
+    _, outs = infer_spmd("matmul", x, y)
+    assert outs[0].dims_mapping == [0, 1] and not outs[0].partial_axes
+
+    # contracted dim sharded -> Partial(sum) on that mesh axis
+    x = DistTensorSpec((8, 4), [-1, 0])
+    y = DistTensorSpec((4, 6), [0, -1])
+    _, outs = infer_spmd("matmul", x, y)
+    assert outs[0].dims_mapping == [-1, -1] and outs[0].partial_axes == [0]
+
+
+def test_matmul_rule_conflict_resolution():
+    # same mesh axis claimed by two letters: first writer wins, the losing
+    # input is resolved to replicated on that dim (needs reshard)
+    x = DistTensorSpec((8, 4), [0, -1])
+    y = DistTensorSpec((4, 6), [-1, 0])
+    rin, outs = infer_spmd("matmul", x, y)
+    assert rin[0].dims_mapping == [0, -1]
+    assert rin[1].dims_mapping == [-1, -1]
+    assert outs[0].dims_mapping == [0, -1]
+
+
+def test_embedding_vocab_parallel_partial():
+    ids = DistTensorSpec((2, 16), [0, -1])
+    table = DistTensorSpec((100, 8), [1, -1])
+    _, outs = infer_spmd("embedding", ids, table)
+    assert outs[0].dims_mapping == [0, -1, -1]
+    assert outs[0].partial_axes == [1]
+
+
+def test_reduction_rule_partial():
+    x = DistTensorSpec((8, 4), [0, 1])
+    _, outs = infer_spmd("sum", x, axis=1)
+    assert outs[0].dims_mapping == [0] and outs[0].partial_axes == [1]
+    _, outs = infer_spmd("sum", x, axis=1, keepdim=True)
+    assert outs[0].dims_mapping == [0, -1]
+
+
+def test_cross_entropy_vocab_parallel():
+    logits = DistTensorSpec((8, 1000), [-1, 1])
+    label = DistTensorSpec((8,), [-1])
+    _, outs = infer_spmd("cross_entropy_with_softmax", logits, label)
+    assert outs[0].partial_axes == [1]
+
+
+def test_default_rule_and_missing_op():
+    x = DistTensorSpec((3, 3), [0, -1])
+    _, outs = get_spmd_rule("default")([x])
+    assert outs[0].dims_mapping == [-1, -1]
+    with pytest.raises(KeyError):
+        get_spmd_rule("definitely_not_an_op")
+
+
+def test_rule_predictions_match_gspmd():
+    """The table's predictions must agree with what XLA GSPMD actually
+    propagates (for the non-Partial cases XLA can express)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("x", "y"))
+    axis_name = {0: "x", 1: "y"}
+
+    def place(arr, dims_mapping):
+        spec = P(*[axis_name.get(a) for a in dims_mapping])
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    rng = np.random.default_rng(0)
+
+    # matmul m/n sharded
+    x = place(rng.normal(size=(8, 4)).astype(np.float32), [0, -1])
+    y = place(rng.normal(size=(4, 8)).astype(np.float32), [-1, 1])
+    out = jax.jit(jnp.matmul)(x, y)
+    _, pred = infer_spmd("matmul", DistTensorSpec((8, 4), [0, -1]),
+                         DistTensorSpec((4, 8), [-1, 1]))
+    got = out.sharding.spec
+    want = tuple(axis_name.get(a) for a in pred[0].dims_mapping)
+    assert tuple(got) == want, (got, want)
+
+    # elementwise propagates the common sharding
+    a = place(rng.normal(size=(8, 4)).astype(np.float32), [0, 1])
+    b = place(rng.normal(size=(8, 4)).astype(np.float32), [0, 1])
+    out = jax.jit(jnp.add)(a, b)
+    _, pred = infer_spmd("add", DistTensorSpec((8, 4), [0, 1]),
+                         DistTensorSpec((8, 4), [0, 1]))
+    assert tuple(out.sharding.spec) == tuple(
+        axis_name.get(m) for m in pred[0].dims_mapping)
+
+    # reduction over an unsharded axis keeps the row sharding
+    out = jax.jit(lambda v: jnp.sum(v, axis=1))(
+        place(rng.normal(size=(8, 4)).astype(np.float32), [0, -1]))
+    _, pred = infer_spmd("sum", DistTensorSpec((8, 4), [0, -1]), axis=1)
+    got = tuple(out.sharding.spec) + (None,) * (
+        1 - len(tuple(out.sharding.spec)))
+    assert got[0] == axis_name.get(pred[0].dims_mapping[0])

@@ -1,0 +1,123 @@
+"""Sparse breadth (value-wise ops, softmax, nn layers, trainable sparse
+weight) + TensorArray tests (N5/P18)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _coo(rng=None, shape=(4, 5), nnz=6):
+    rng = rng or np.random.default_rng(0)
+    flat = rng.choice(shape[0] * shape[1], size=nnz, replace=False)
+    idx = np.stack([flat // shape[1], flat % shape[1]])
+    vals = rng.normal(size=(nnz,)).astype(np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, shape), idx, vals
+
+
+def test_valuewise_unary_preserves_pattern():
+    sp, idx, vals = _coo()
+    out = sparse.tanh(sp)
+    assert out.nnz() == len(vals)
+    np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                               np.tanh(vals), rtol=1e-6)
+    dense = out.to_dense().numpy()
+    assert np.count_nonzero(dense) <= len(vals)
+
+
+def test_divide_and_pow_and_cast():
+    sp, idx, vals = _coo()
+    d = sparse.divide(sp, 2.0)
+    np.testing.assert_allclose(d.values().numpy(), vals / 2.0, rtol=1e-6)
+    p = sparse.pow(sp, 2)
+    np.testing.assert_allclose(p.values().numpy(), vals ** 2, rtol=1e-6)
+    c = sparse.cast(sp, value_dtype="float64")
+    assert "float" in str(c.values().dtype)
+
+
+def test_sparse_softmax_rows_sum_to_one():
+    sp, idx, vals = _coo()
+    sm = sparse.softmax(sp)
+    dense = sm.to_dense().numpy()
+    for r in range(dense.shape[0]):
+        nz = dense[r][dense[r] != 0]
+        if nz.size:
+            np.testing.assert_allclose(nz.sum(), 1.0, rtol=1e-5)
+
+
+def test_sparse_nn_activations_and_batchnorm():
+    import paddle_tpu.sparse.nn as snn
+    sp, idx, vals = _coo()
+    out = snn.ReLU()(sp)
+    assert np.all(out.values().numpy() >= 0)
+    out = snn.LeakyReLU(0.1)(sp)
+    assert out.nnz() == len(vals)
+
+    bn = snn.BatchNorm(num_features=5)
+    bn.train()
+    out = bn(sp)
+    assert out.nnz() == len(vals)
+    bn.eval()
+    out2 = bn(sp)
+    assert np.all(np.isfinite(out2.values().numpy()))
+
+
+def test_sparse_linear_trains():
+    """The sparse training story: grads land on the fixed-pattern value
+    vector and SGD reduces the loss."""
+    import paddle_tpu.sparse.nn as snn
+
+    rng = np.random.default_rng(0)
+    lin = snn.SparseLinear(8, 4, density=0.5, seed=1)
+    x = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(16, 4)).astype(np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    losses = []
+    for _ in range(30):
+        out = lin(x)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        assert lin.values.grad is not None
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_tensor_array_append_read_write_stack():
+    ta = paddle.TensorArray()
+    for i in range(3):
+        ta.append(paddle.to_tensor(np.full((2,), i, np.float32)))
+    assert len(ta) == 3
+    assert float(ta.read(1).numpy()[0]) == 1.0
+    ta.write(1, paddle.to_tensor(np.full((2,), 9.0, np.float32)))
+    stacked = ta.stack()
+    assert tuple(stacked.shape) == (3, 2)
+    np.testing.assert_allclose(stacked.numpy()[1], [9.0, 9.0])
+    cat = ta.concat()
+    assert tuple(cat.shape) == (6,)
+
+
+def test_tensor_array_functional_api_and_grow():
+    arr = paddle.create_array()
+    paddle.array_write(paddle.to_tensor(np.ones((2,), np.float32)),
+                       paddle.to_tensor(np.asarray(0)), arr)
+    # write past the end grows with zeros (paddle semantics)
+    arr.write(3, paddle.to_tensor(np.full((2,), 5.0, np.float32)))
+    assert int(paddle.array_length(arr).numpy()) == 4
+    np.testing.assert_allclose(arr.read(2).numpy(), [0.0, 0.0])
+    got = paddle.array_read(arr, 3)
+    np.testing.assert_allclose(got.numpy(), [5.0, 5.0])
+
+
+def test_tensor_array_grad_flows_through_stack():
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    x.stop_gradient = False
+    ta = paddle.TensorArray()
+    ta.append(x * 2.0)
+    ta.append(x * 3.0)
+    loss = ta.stack().sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])

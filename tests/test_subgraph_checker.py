@@ -1,0 +1,87 @@
+"""Subgraph checker tests (utils/subgraph_checker.py, N37)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.utils.subgraph_checker import check_layer
+
+
+class _CleanNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.norm = nn.LayerNorm(16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.norm(self.act(self.fc1(x))))
+
+
+def test_clean_model_passes():
+    net = _CleanNet()
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32))
+    report = check_layer(net, [x])
+    assert len(report.entries) >= 4
+    assert not report.failures, str(report)
+    assert report.first_divergence is None
+
+
+class _NoisyLayer(nn.Layer):
+    """Bakes fresh host randomness into every call: eager and the compiled
+    replay see different constants — exactly the bug class the checker
+    exists to localize."""
+
+    def forward(self, x):
+        noise = paddle.to_tensor(
+            np.random.default_rng().normal(size=(1,)).astype(np.float32))
+        return x + noise * 10.0
+
+
+class _DirtyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.bad = _NoisyLayer()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.bad(self.fc1(x)))
+
+
+def test_divergent_sublayer_localized():
+    net = _DirtyNet()
+    x = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(4, 8)).astype(np.float32))
+    report = check_layer(net, [x])
+    bad = [e["name"] for e in report.failures]
+    assert any("bad" in n for n in bad), str(report)
+    # the clean layers must NOT be flagged
+    assert not any("fc1" in n or "fc2" in n for n in bad), str(report)
+    assert "FAIL" in str(report)
+
+
+class _Untraceable(nn.Layer):
+    def forward(self, x):
+        if float(x.sum().numpy()) > 0:  # concrete branch: breaks tracing
+            return x * 2.0
+        return x
+
+
+def test_untraceable_forward_reported():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.u = _Untraceable()
+
+        def forward(self, x):
+            return self.u(self.fc(x))
+
+    x = paddle.to_tensor(np.abs(np.random.default_rng(2).normal(
+        size=(2, 4))).astype(np.float32))
+    report = check_layer(Net(), [x])
+    entry = next(e for e in report.entries if "u" in e["name"])
+    assert not entry["ok"] and "not traceable" in entry.get("error", "")

@@ -383,6 +383,7 @@ def test_hub_local(tmp_path):
     assert layer.weight.shape == (5, 5)
 
 
+@pytest.mark.slow
 def test_ctc_loss_matches_torch():
     """CTC alpha-recursion vs torch's reference implementation
     (warpctc_kernel_impl.h capability analog)."""

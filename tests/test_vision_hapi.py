@@ -7,6 +7,7 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 
 
+@pytest.mark.slow
 def test_resnet_variants_forward():
     from paddle_tpu.vision.models import resnet18, resnet50
     x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))

@@ -1,0 +1,80 @@
+"""Smoke tests for the round-2 vision model families (P16 breadth):
+alexnet, squeezenet, densenet, shufflenetv2, mobilenetv3, googlenet,
+inceptionv3, resnext. Forward shape + one train step on tiny inputs."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import models as M
+
+
+def _smoke(model, side=64, n_classes=10, batch=2, train_step=True):
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(batch, 3, side, side))
+                         .astype(np.float32))
+    model.train()
+    out = model(x)
+    main = out[0] if isinstance(out, tuple) else out
+    assert tuple(main.shape) == (batch, n_classes), main.shape
+    if train_step:
+        y = paddle.to_tensor(rng.integers(0, n_classes, (batch,)))
+        loss = F.cross_entropy(main, y)
+        loss.backward()
+        g = next(p for p in model.parameters() if p.grad is not None)
+        assert np.all(np.isfinite(g.grad.numpy()))
+    return main
+
+
+def test_alexnet():
+    _smoke(M.alexnet(num_classes=10), side=64)
+
+
+@pytest.mark.slow
+def test_squeezenet_both_versions():
+    _smoke(M.squeezenet1_0(num_classes=10), side=64)
+    _smoke(M.squeezenet1_1(num_classes=10), side=64, train_step=False)
+
+
+def test_shufflenetv2_smallest():
+    _smoke(M.shufflenet_v2_x0_25(num_classes=10), side=64)
+
+
+@pytest.mark.slow
+def test_mobilenet_v3_small():
+    _smoke(M.mobilenet_v3_small(num_classes=10, scale=0.5), side=64)
+
+
+@pytest.mark.slow
+def test_mobilenet_v3_large():
+    _smoke(M.mobilenet_v3_large(num_classes=10), side=64, train_step=False)
+
+
+@pytest.mark.slow
+def test_densenet121():
+    _smoke(M.densenet121(num_classes=10), side=64)
+
+
+def test_googlenet_aux_heads():
+    model = M.googlenet(num_classes=10)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(2, 3, 64, 64)).astype(np.float32))
+    out, aux1, aux2 = model(x)
+    assert tuple(out.shape) == (2, 10)
+    assert tuple(aux1.shape) == (2, 10) and tuple(aux2.shape) == (2, 10)
+
+
+@pytest.mark.slow
+def test_inception_v3():
+    _smoke(M.inception_v3(num_classes=10), side=128, train_step=False)
+
+
+@pytest.mark.slow
+def test_resnext50():
+    _smoke(M.resnext50_32x4d(num_classes=10), side=64, train_step=False)
+
+
+def test_pretrained_flag_raises():
+    with pytest.raises(NotImplementedError):
+        M.alexnet(pretrained=True)
