@@ -11,6 +11,9 @@ from __future__ import annotations
 from typing import Optional
 
 from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet import utils_fs as utils  # noqa: F401
+from paddle_tpu.distributed.fleet.utils_fs import (  # noqa: F401
+    HDFSClient, LocalFS)
 from paddle_tpu.distributed.fleet.topology import (
     CommunicateTopology, HybridCommunicateGroup,
 )

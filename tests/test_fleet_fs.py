@@ -1,0 +1,48 @@
+"""fleet.utils fs clients (D18 gap): LocalFS full surface + HDFS probe."""
+
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fleet import HDFSClient, LocalFS
+
+
+def test_localfs_full_surface(tmp_path):
+    fs = LocalFS()
+    root = str(tmp_path / "ckpt")
+    fs.mkdirs(root)
+    assert fs.is_dir(root) and fs.is_exist(root)
+
+    f = os.path.join(root, "model.pdparams")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with pytest.raises(FileExistsError):
+        fs.touch(f, exist_ok=False)
+
+    sub = os.path.join(root, "epoch_0")
+    fs.mkdirs(sub)
+    dirs, files = fs.ls_dir(root)
+    assert dirs == ["epoch_0"] and files == ["model.pdparams"]
+
+    dst = os.path.join(root, "model_final.pdparams")
+    fs.mv(f, dst)
+    assert fs.is_file(dst) and not fs.is_exist(f)
+    fs.touch(f)
+    with pytest.raises(FileExistsError):
+        fs.mv(f, dst)  # no overwrite by default
+    fs.mv(f, dst, overwrite=True)
+
+    up = str(tmp_path / "up.bin")
+    open(up, "w").write("payload")
+    fs.upload(up, os.path.join(root, "up.bin"))
+    fs.download(os.path.join(root, "up.bin"), str(tmp_path / "down.bin"))
+    assert open(tmp_path / "down.bin").read() == "payload"
+
+    fs.delete(root)
+    assert not fs.is_exist(root)
+
+
+def test_hdfs_client_clear_error_without_hadoop():
+    client = HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(RuntimeError, match="hadoop"):
+        client.mkdirs("/tmp/x")

@@ -133,3 +133,57 @@ def test_moe_lm_trains_under_jit_with_ep2():
                     for s in w1._value.addressable_shards}
     full = tuple(w1.shape)
     assert shard_shapes == {(full[0] // 2,) + full[1:]}, shard_shapes
+
+
+def test_ragged_dispatch_matches_capacity_path():
+    """Dropless ragged (lax.ragged_dot) vs the capacity path with ample
+    capacity: same math, no drops -> outputs and grads agree."""
+    rng = np.random.default_rng(0)
+    paddle.seed(0)
+    cap = MoEMLP(16, 32, n_experts=4, top_k=2, capacity_factor=100.0)
+    paddle.seed(0)
+    rag = MoEMLP(16, 32, n_experts=4, top_k=2, dispatch="ragged")
+    rag.set_state_dict(cap.state_dict())
+
+    x = paddle.to_tensor(rng.normal(size=(2, 8, 16)).astype(np.float32))
+    x.stop_gradient = False
+    y1 = cap(x)
+    y2 = rag(x)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-5, atol=1e-5)
+
+    y1.sum().backward()
+    gx1 = x.grad.numpy().copy()
+    gw1 = cap.w1.grad.numpy().copy()
+    x.clear_grad()
+    x2 = paddle.to_tensor(x.numpy())
+    x2.stop_gradient = False
+    rag(x2).sum().backward()
+    np.testing.assert_allclose(gx1, x2.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gw1, rag.w1.grad.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ragged_dispatch_never_drops_tokens():
+    """All tokens routed to one expert: the capacity path would drop the
+    overflow; ragged must process every token, matching expert-0's FFN run
+    on the full token set."""
+    rng = np.random.default_rng(1)
+    paddle.seed(1)
+    rag = MoEMLP(8, 16, n_experts=4, top_k=1, dispatch="ragged",
+                 normalize_topk=False, activation="relu")
+    # bias the gate hard toward expert 0
+    w = np.zeros((8, 4), np.float32)
+    w[:, 0] = 10.0
+    rag.gate.weight.set_value(paddle.to_tensor(w))
+    # positive tokens: logit_0 = 10*sum(x) > 0 beats the 0-logit others,
+    # so expert 0 really is top-1 for every token
+    x = paddle.to_tensor(np.abs(rng.normal(
+        size=(2, 16, 8))).astype(np.float32))
+    out = rag(x).numpy().reshape(-1, 8)
+
+    tokens = x.numpy().reshape(-1, 8)
+    logits = tokens @ w
+    gate = np.exp(logits[:, 0]) / np.exp(logits).sum(axis=1)  # softmax top1
+    h = np.maximum(tokens @ rag.w1.numpy()[0] + rag.b1.numpy()[0, 0], 0.0)
+    expect = (h @ rag.w2.numpy()[0] + rag.b2.numpy()[0, 0]) * gate[:, None]
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
