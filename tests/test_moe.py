@@ -182,8 +182,9 @@ def test_ragged_dispatch_never_drops_tokens():
     out = rag(x).numpy().reshape(-1, 8)
 
     tokens = x.numpy().reshape(-1, 8)
-    logits = tokens @ w
-    gate = np.exp(logits[:, 0]) / np.exp(logits).sum(axis=1)  # softmax top1
+    logits = (tokens @ w).astype(np.float64)
+    z = np.exp(logits - logits.max(axis=1, keepdims=True))  # stable softmax
+    gate = (z / z.sum(axis=1, keepdims=True))[:, 0]
     h = np.maximum(tokens @ rag.w1.numpy()[0] + rag.b1.numpy()[0, 0], 0.0)
     expect = (h @ rag.w2.numpy()[0] + rag.b2.numpy()[0, 0]) * gate[:, None]
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
