@@ -57,7 +57,7 @@ def __getattr__(name):
         mod = _lazy(name)
         globals()[name] = mod
         return mod
-    if name in ("Model", "summary"):  # paddle.Model / paddle.summary
+    if name in ("Model", "summary", "flops"):  # paddle.Model / paddle.summary / paddle.flops
         from paddle_tpu import hapi
         val = getattr(hapi, name)
         globals()[name] = val

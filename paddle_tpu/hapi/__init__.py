@@ -4,4 +4,4 @@ from paddle_tpu.hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
 )
 from paddle_tpu.hapi.model import Model  # noqa: F401
-from paddle_tpu.hapi.summary import summary  # noqa: F401
+from paddle_tpu.hapi.summary import flops, summary  # noqa: F401

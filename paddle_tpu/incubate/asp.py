@@ -3,6 +3,11 @@
 calculate_density / prune_model (magnitude-based 2:4 mask) + the
 `decorate` optimizer wrapper that re-applies masks after each step
 (asp.py OptimizerWithSparsityGuarantee analog).
+
+Honesty note: the reference's 2:4 payoff is NVIDIA sparse tensor cores;
+TPU MXUs have no structured-sparsity execution path, so here ASP provides
+the masking/training workflow only (model-compression semantics, same
+checkpoint compatibility) with dense compute underneath.
 """
 
 from __future__ import annotations

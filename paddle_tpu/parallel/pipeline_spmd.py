@@ -77,40 +77,61 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: dict, x,
         raise ValueError(f"x leading dim {M} != n_micro {n_micro}")
 
     param_specs = {k: P(axis) for k in stacked_params}
-    x_spec = P()          # micro-batches replicated; tiny vs activations
-    out_spec = P()
+    # per-stage micro-batch IO (the scalability fix): when M divides by S,
+    # inputs/outputs are sharded over the pp axis (each rank holds M/S
+    # micro-batches) and single micro-batches ride ppermutes to/from the
+    # ring ends — per-rank IO memory is M/S x activation, not M x. With
+    # M % S != 0 the replicated fallback keeps correctness.
+    shard_io = S > 1 and M % S == 0
+    per = M // S if shard_io else M
+    x_spec = P(axis) if shard_io else P()
+    out_spec = P(axis) if shard_io else P()
 
     def local(params_loc, x_all):
-        # params_loc[k]: (1, ...) this rank's stage slice
+        # params_loc[k]: (1, ...) this rank's stage slice;
+        # x_all: (per, ...) local micro-batches (sharded) or (M, ...) (repl)
         r = jax.lax.axis_index(axis)
         p_here = {k: v[0] for k, v in params_loc.items()}
         state = jnp.zeros_like(x_all[0])
-        outputs = jnp.zeros((M,) + x_all.shape[1:], x_all.dtype)
+        outputs = jnp.zeros((per,) + x_all.shape[1:], x_all.dtype)
 
         # checkpoint ONLY the stage compute: the accumulator ops (.at.set,
         # where, ppermute) are linear and need no residuals — wrapping the
-        # whole tick would keep T copies of the (M, ...) buffer live
+        # whole tick would keep T copies of the output buffer live
         compute = jax.checkpoint(stage_fn) if checkpoint_ticks else stage_fn
 
         def tick(t, state, outputs):
             # stage 0 ingests micro-batch t (while t < M); others take the
             # state handed over the ring last tick
-            inject = x_all[jnp.minimum(t, M - 1)]
-            state = jnp.where(r == 0, inject if t < M else state, state)
+            if t < M:
+                if shard_io:
+                    # owner rank t//per ships micro-batch t to the ring head
+                    send = x_all[t % per]
+                    inject = jax.lax.ppermute(send, axis, [(t // per, 0)])
+                else:
+                    inject = x_all[t]
+                state = jnp.where(r == 0, inject, state)
             y = compute(p_here, state)
             # last stage emits micro-batch t-(S-1) once the pipe is full
             mb = t - (S - 1)
             if 0 <= mb < M:
-                emit = jnp.where(r == S - 1, y, jnp.zeros_like(y))
-                outputs = outputs.at[mb].set(emit)
+                if shard_io:
+                    dst = mb // per
+                    moved = jax.lax.ppermute(y, axis, [(S - 1, dst)])
+                    outputs = outputs.at[mb % per].set(
+                        jnp.where(r == dst, moved, outputs[mb % per]))
+                else:
+                    emit = jnp.where(r == S - 1, y, jnp.zeros_like(y))
+                    outputs = outputs.at[mb].set(emit)
             state = jax.lax.ppermute(
                 y, axis, [(j, (j + 1) % S) for j in range(S)])
             return state, outputs
 
         for t in range(M + S - 1):
             state, outputs = tick(t, state, outputs)
-        # outputs live on the last ring rank only; share them ringwide
-        outputs = jax.lax.psum(outputs, axis)
+        if not shard_io:
+            # outputs live on the last ring rank only; share them ringwide
+            outputs = jax.lax.psum(outputs, axis)
         return outputs
 
     kwargs = dict(mesh=mesh.jax_mesh,
@@ -147,6 +168,12 @@ def _spmd_pipeline_interleaved(stage_fn, stacked_params, x, mesh, n_micro,
     T = M + L - 1
 
     param_specs = {k: P(None, axis) for k in stacked_params}
+    # same per-stage micro-batch IO as the base pipeline: shard M over the
+    # pp axis when divisible (owner rank ships mb t to the ring head at its
+    # injection tick; the last global stage ships results back to owners)
+    shard_io = S > 1 and M % S == 0
+    per = M // S if shard_io else M
+    io_spec = P(axis) if shard_io else P()
 
     def local(params_loc, x_all):
         r = jax.lax.axis_index(axis)
@@ -154,12 +181,19 @@ def _spmd_pipeline_interleaved(stage_fn, stacked_params, x, mesh, n_micro,
         p_chunks = [{k: p[j, 0] for k, p in params_loc.items()}
                     for j in range(v)]
         zero = jnp.zeros_like(x_all[0])
-        outputs = jnp.zeros((M,) + x_all.shape[1:], x_all.dtype)
+        outputs = jnp.zeros((per,) + x_all.shape[1:], x_all.dtype)
         fs = [zero] * v  # per-chunk ring payload
 
         compute = jax.checkpoint(stage_fn) if checkpoint_ticks else stage_fn
 
         for t in range(T):
+            # global stage 0 (j=0, r=0) consumes micro-batch t this tick
+            if shard_io:
+                if t < M:
+                    send = x_all[t % per]
+                    inject_t = jax.lax.ppermute(send, axis, [(t // per, 0)])
+                else:
+                    inject_t = zero
             ys = []
             for j in range(v):
                 # micro-batch at global stage j*S + r this tick
@@ -169,7 +203,7 @@ def _spmd_pipeline_interleaved(stage_fn, stacked_params, x, mesh, n_micro,
                 # payload of chunk j-1 (stage (j-1)*S + S-1 -> j*S); the
                 # j==0 wrap value is dead — global stage 0 injects x below
                 state_in = jnp.where(r == 0, fs[j - 1], fs[j])
-                inject = x_all[jnp.clip(m, 0, M - 1)]
+                inject = inject_t if shard_io else x_all[jnp.clip(m, 0, M - 1)]
                 state_in = jnp.where((r == 0) & (j == 0), inject, state_in)
                 if partial_manual:
                     # masked, not cond: GSPMD inserts mp/dp collectives
@@ -186,18 +220,28 @@ def _spmd_pipeline_interleaved(stage_fn, stacked_params, x, mesh, n_micro,
                 if j == v - 1:
                     mb = t - (L - 1)
                     if 0 <= mb < M:
-                        emit = jnp.where(r == S - 1, y, jnp.zeros_like(y))
-                        outputs = outputs.at[mb].set(emit)
+                        if shard_io:
+                            dst = mb // per
+                            moved = jax.lax.ppermute(y, axis, [(S - 1, dst)])
+                            outputs = outputs.at[mb % per].set(
+                                jnp.where(r == dst, moved,
+                                          outputs[mb % per]))
+                        else:
+                            emit = jnp.where(r == S - 1, y,
+                                             jnp.zeros_like(y))
+                            outputs = outputs.at[mb].set(emit)
             # one permute per chunk ring, all ranks, outside the conds
             fs = [jax.lax.ppermute(
                 ys[j], axis, [(i, (i + 1) % S) for i in range(S)])
                 for j in range(v)]
-        outputs = jax.lax.psum(outputs, axis)
+        if not shard_io:
+            outputs = jax.lax.psum(outputs, axis)
         return outputs
 
     kwargs = dict(mesh=mesh.jax_mesh,
-                  in_specs=({k: param_specs[k] for k in stacked_params}, P()),
-                  out_specs=P(), check_vma=False)
+                  in_specs=({k: param_specs[k] for k in stacked_params},
+                            io_spec),
+                  out_specs=io_spec, check_vma=False)
     if partial_manual:
         kwargs["axis_names"] = {axis}
     fn = shard_map(local, **kwargs)

@@ -73,12 +73,15 @@ class ShardedTrainer:
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  mesh: ProcessMesh, plan: Optional[Dict[str, Sequence]] = None,
                  data_spec: Optional[P] = None, donate: bool = True,
-                 amp_dtype: Optional[str] = None):
+                 amp_dtype: Optional[str] = None, pass_rules=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.plan = plan or {}
+        # optional jaxpr rewrite rules (passes/) applied to the whole
+        # compiled train step — the auto-parallel pass pipeline hook
+        self.pass_rules = list(pass_rules) if pass_rules else []
         # bf16-native AMP: params stay f32 (master weights), MXU ops run in
         # amp_dtype via the auto_cast dispatch hook (no loss scaling needed
         # for bf16 on TPU — SURVEY §7.1 AMP row)
@@ -204,6 +207,9 @@ class ShardedTrainer:
             self.opt_shardings,
             NamedSharding(self.mesh.jax_mesh, P()),
         )
+        if self.pass_rules:
+            from paddle_tpu.passes.rewrite import rewrite as _rewrite
+            step = _rewrite(step, self.pass_rules)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
                        donate_argnums=(0, 2))

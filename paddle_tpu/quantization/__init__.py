@@ -20,7 +20,8 @@ import paddle_tpu.nn as nn
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.ops.registry import register_op
 
-__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "quantize",
+__all__ = ["weight_quantize", "weight_only_linear", "llm_int8_linear",
+           "QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "quantize",
            "dequantize", "fake_quantize", "QuantedLinear", "QuantedConv2D"]
 
 
@@ -234,3 +235,65 @@ def paddle_reshape_bias(bias, ndim):
 class QAT(PTQ):
     """Quant-aware training: same wrappers, calibration stays live so the
     STE fake-quant trains through (quantization/qat.py)."""
+
+
+# --------------------------------------------------------------------------
+# weight-only quantization for inference (paddle.nn.quant analogs:
+# ops.yaml weight_quantize / weight_only_linear / llm_int8_linear)
+# --------------------------------------------------------------------------
+
+@register_op("weight_quantize",
+             ref="paddle/phi/ops/yaml/ops.yaml:weight_quantize",
+             n_outputs=2, differentiable=False)
+def weight_quantize(w, algo="weight_only_int8"):
+    """Per-output-channel int8 quantization of a (in, out) weight matrix.
+    Returns (int8 weight, f32 per-channel scale)."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise NotImplementedError(f"weight_quantize algo {algo!r}")
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@register_op("weight_only_linear",
+             ref="paddle/phi/ops/yaml/ops.yaml:weight_only_linear")
+def weight_only_linear(x, weight, weight_scale, bias=None,
+                       weight_dtype="int8"):
+    """x @ dequant(int8 weight): weights stay int8 in HBM (half the
+    memory traffic of bf16 — the decode-bandwidth lever the reference's
+    weight_only_linear kernel exists for). Per-output-channel scales apply
+    AFTER the matmul, so no dequantized weight copy is materialized (the
+    same form inference/generate._mm uses)."""
+    out = jnp.matmul(x, weight.astype(x.dtype)) \
+        * weight_scale.astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("llm_int8_linear",
+             ref="paddle/phi/ops/yaml/ops.yaml:llm_int8_linear")
+def llm_int8_linear(x, weight, weight_scale, bias=None, threshold=6.0):
+    """LLM.int8()-style mixed decomposition (x (..., in) @ int8 (in, out)):
+    inlier input-feature columns quantize per-row to int8 and run an
+    int8 x int8 matmul with int32 accumulation (the MXU int8 path);
+    outlier columns (any |x| > threshold) run in f32 against the
+    dequantized weight rows, and the two halves sum."""
+    xf = x.astype(jnp.float32)
+    lead = tuple(range(x.ndim - 1))
+    outlier = jnp.any(jnp.abs(xf) > threshold, axis=lead)      # (in,)
+    x_main = jnp.where(outlier, 0.0, xf)
+    x_scale = jnp.max(jnp.abs(x_main), axis=-1, keepdims=True) / 127.0
+    x_scale = jnp.maximum(x_scale, 1e-8)
+    xq = jnp.clip(jnp.round(x_main / x_scale), -127, 127).astype(jnp.int8)
+    main = jnp.matmul(xq, weight, preferred_element_type=jnp.int32)
+    main = main.astype(jnp.float32) * x_scale \
+        * weight_scale.astype(jnp.float32)[None, :]
+    x_out = jnp.where(outlier, xf, 0.0)
+    wf = weight.astype(jnp.float32) * weight_scale.astype(jnp.float32)[None, :]
+    out = main + jnp.matmul(x_out, wf)
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)

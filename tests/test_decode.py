@@ -182,3 +182,33 @@ def test_model_generate_api_llama_and_gpt():
     gout = gpt.generate(prompt, max_new_tokens=4)
     assert gout.shape == (1, 7)
     assert np.all((gout >= 0) & (gout < 64))
+
+
+def test_int8_weight_only_decoder_runs_and_tracks_full_precision():
+    """weight_dtype='int8' decoder: logits stay close to the bf16 path
+    (per-channel int8 round-trip error), shapes/compile behavior intact."""
+    import jax.numpy as jnp
+    from paddle_tpu.inference.generate import LlamaDecoder
+
+    cfg = LlamaConfig(**CFG)
+    model = _model()
+    B, S = 2, 8
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (B, S))
+
+    full = LlamaDecoder(model, max_len=32)
+    q = LlamaDecoder(model, max_len=32, weight_dtype="int8")
+    kc, vc = full._empty_cache(B)
+    lf, _, _ = full._prefill(full.params, jnp.asarray(prompt), kc, vc)
+    kc, vc = q._empty_cache(B)
+    lq, _, _ = q._prefill(q.params, jnp.asarray(prompt), kc, vc)
+    lf, lq = np.asarray(lf), np.asarray(lq)
+    # int8 weight round-trip: logits correlate strongly with full precision
+    corr = np.corrcoef(lf.ravel(), lq.ravel())[0, 1]
+    assert corr > 0.99, corr
+
+    out = q.generate(prompt, max_new_tokens=4)
+    assert out.shape == (B, S + 4)
+
+    with pytest.raises(ValueError):
+        LlamaDecoder(model, max_len=32, weight_dtype="int4")

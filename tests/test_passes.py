@@ -228,3 +228,41 @@ def test_to_static_build_strategy_applies_fusion():
                                rtol=1e-6, atol=1e-6)
     # at least one of the strategy's rules fired during tracing
     assert any(getattr(r, "hits", 0) > 0 for r in static_layer._pass_rules)
+
+
+def test_sharded_trainer_pass_rules_numerics_parity():
+    """Pass rules plug into the compiled SPMD train step (the auto-parallel
+    pass-pipeline hook): losses match the un-rewritten trainer."""
+    from paddle_tpu.models.llama import TINY_CONFIG, LlamaForCausalLM
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, TINY_CONFIG.vocab_size, (2, 16))
+    labels = rng.integers(0, TINY_CONFIG.vocab_size, (2, 16))
+
+    def run(rules):
+        paddle.seed(0)
+        model = LlamaForCausalLM(TINY_CONFIG)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        mesh = init_mesh((1, 1, 1), ("dp", "sep", "mp"))
+        tr = ShardedTrainer(model, opt, lambda m, i, l: m.loss(i, l),
+                            mesh, {}, pass_rules=rules)
+        with mesh:
+            return [float(np.asarray(tr.train_step(ids, labels).value))
+                    for _ in range(3)]
+
+    # op-level fusion off: the traced step contains the raw rms_norm
+    # composition, so the PASS layer is what fuses it (otherwise
+    # F.rms_norm emits the custom-vjp unit directly and there is nothing
+    # for the rule to match)
+    paddle.set_flags({"use_fused_rms_norm": False})
+    try:
+        base = run(None)
+        rule = P.fuse_rms_norm_rule()
+        fused = run([rule])
+    finally:
+        paddle.set_flags({"use_fused_rms_norm": True})
+    assert rule.hits > 0  # the hook really rewrote the compiled step
+    np.testing.assert_allclose(base, fused, rtol=1e-5, atol=1e-6)

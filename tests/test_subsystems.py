@@ -548,3 +548,56 @@ def test_nan_check_skip_list():
     finally:
         paddle.set_flags({"check_nan_inf": False,
                           "check_nan_inf_skip_ops": ""})
+
+
+def test_paddle_flops_counts_compiled_forward():
+    """paddle.flops (hapi dynamic_flops analog): XLA cost analysis of the
+    traced forward — matmul-dominated nets match the analytic count."""
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    total = paddle.flops(net, input_size=(2, 8))
+    # analytic matmul flops: 2*B*(8*16 + 16*4) = 768; bias/relu add a bit
+    assert 768 <= total <= 1200, total
+    with pytest.raises(ValueError):
+        paddle.flops(net)
+
+
+def test_weight_only_quant_roundtrip_and_linear():
+    """weight_quantize / weight_only_linear / llm_int8_linear (the
+    reference's weight-only inference ops, ops.yaml entries)."""
+    import paddle_tpu.quantization as Q
+
+    rng = np.random.default_rng(0)
+    w = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+    qw, scale = Q.weight_quantize(w)
+    assert str(qw.dtype) in ("paddle.int8", "int8")
+    deq = qw.numpy().astype(np.float32) * scale.numpy()[None, :]
+    # int8 per-channel round trip: worst-case error is scale/2 per entry
+    assert np.abs(deq - w.numpy()).max() <= scale.numpy().max() / 2 + 1e-6
+
+    x = paddle.to_tensor(rng.normal(size=(4, 16)).astype(np.float32))
+    out = Q.weight_only_linear(x, qw, scale)
+    ref = x.numpy() @ deq
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    b = paddle.to_tensor(np.ones((8,), np.float32))
+    out_b = Q.weight_only_linear(x, qw, scale, bias=b)
+    np.testing.assert_allclose(out_b.numpy(), ref + 1.0, rtol=1e-4,
+                               atol=1e-4)
+
+    # llm.int8: with no outliers the int8 path alone must approximate the
+    # dense product; with a huge outlier column accuracy must HOLD (the
+    # outlier runs in f32) rather than degrade
+    out8 = Q.llm_int8_linear(x, qw, scale, threshold=6.0)
+    np.testing.assert_allclose(out8.numpy(), x.numpy() @ deq,
+                               rtol=0.1, atol=0.1)
+    x_out = x.numpy().copy()
+    x_out[:, 3] = 100.0  # outlier feature
+    got = Q.llm_int8_linear(paddle.to_tensor(x_out), qw, scale,
+                            threshold=6.0).numpy()
+    ref_out = x_out @ deq
+    rel = np.abs(got - ref_out).max() / np.abs(ref_out).max()
+    assert rel < 0.05, rel
+
+    with pytest.raises(NotImplementedError):
+        Q.weight_quantize(w, algo="int4")
