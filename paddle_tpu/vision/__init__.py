@@ -2,4 +2,5 @@
 (python/paddle/vision/ analog, SURVEY P16)."""
 
 from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401
 from paddle_tpu.vision.models import *  # noqa: F401,F403

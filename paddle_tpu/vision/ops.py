@@ -1,0 +1,474 @@
+"""paddle.vision.ops — detection operator family.
+
+Analog of python/paddle/vision/ops.py (nms, roi_align, roi_pool,
+box_coder, prior_box, yolo_box, deform_conv2d, distribute_fpn_proposals)
+over the phi detection kernels (paddle/phi/kernels/*nms*, roi_align_kernel,
+box_coder_kernel, prior_box_kernel, yolo_box_kernel,
+deformable_conv_kernel, distribute_fpn_proposals_kernel).
+
+TPU-native shapes: everything except final NMS selection is static-shaped
+dense math (MXU/VPU friendly). NMS keeps XLA-compatible control flow by
+computing a fixed-iteration suppression matrix; the trailing
+data-dependent compaction happens on concrete values (eager), mirroring
+where the reference syncs to the host for proposal counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
+           "prior_box", "yolo_box", "deform_conv2d", "DeformConv2D",
+           "distribute_fpn_proposals"]
+
+
+def _box_iou_impl(boxes1, boxes2):
+    a1, a2 = boxes1[:, None, :2], boxes1[:, None, 2:]
+    b1, b2 = boxes2[None, :, :2], boxes2[None, :, 2:]
+    lt = jnp.maximum(a1, b1)
+    rb = jnp.minimum(a2, b2)
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.prod(jnp.clip(boxes1[:, 2:] - boxes1[:, :2], 0, None), -1)
+    area_b = jnp.prod(jnp.clip(boxes2[:, 2:] - boxes2[:, :2], 0, None), -1)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op("box_iou", ref="paddle/phi/kernels/impl/box_clip_kernel_impl.h "
+             "(iou family)")
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU of (N, 4) and (M, 4) xyxy boxes -> (N, M)."""
+    return _box_iou_impl(boxes1, boxes2)
+
+
+@register_op("nms_mask", differentiable=False,
+             ref="paddle/phi/kernels/impl/nms_kernel_impl.h")
+def _nms_mask(boxes, scores, iou_threshold):
+    """Static-shaped greedy NMS: keep mask over score-sorted boxes.
+
+    The classic O(N^2) suppression computed as a fixed-length fori_loop
+    over the sorted order — jit-safe (no dynamic shapes); callers compact
+    the mask on concrete values."""
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _box_iou_impl(b, b)
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms(boxes, scores=None, iou_threshold: float = 0.3,
+        score_threshold: Optional[float] = None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None):
+    """Greedy NMS returning kept indices by descending score
+    (python/paddle/vision/ops.py:nms parity, incl. categorical batching)."""
+    bx = boxes if isinstance(boxes, Tensor) else Tensor(jnp.asarray(boxes))
+    n = bx.shape[0]
+    sc = scores if scores is not None else Tensor(jnp.ones((n,)))
+    if not isinstance(sc, Tensor):
+        sc = Tensor(jnp.asarray(sc))
+    if category_idxs is not None:
+        # per-category NMS via the coordinate-offset trick: boxes from
+        # different categories can never overlap
+        cat = jnp.asarray(category_idxs.value if isinstance(
+            category_idxs, Tensor) else category_idxs)
+        span = jnp.max(bx.value) - jnp.min(bx.value) + 1.0
+        bx = Tensor(bx.value + (cat[:, None] * span).astype(bx.value.dtype))
+    keep = _nms_mask(bx, sc, iou_threshold)
+    mask = np.asarray(keep.value)
+    scn = np.asarray(sc.value)
+    if score_threshold is not None:
+        mask = mask & (scn > score_threshold)
+    idx = np.nonzero(mask)[0]
+    idx = idx[np.argsort(-scn[idx], kind="stable")]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return Tensor(jnp.asarray(idx.astype(np.int64)))
+
+
+@register_op("roi_align", ref="paddle/phi/kernels/roi_align_kernel.h")
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign: x (N, C, H, W), boxes (R, 4) xyxy in input coords with
+    boxes_num giving rois per image. Bilinear-sampled (R, C, oh, ow)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if sampling_ratio > 0:
+        ratio_h = ratio_w = sampling_ratio
+    else:
+        # reference: adaptive ceil(roi_size/output) samples per bin. The
+        # per-roi count is dynamic; the static-shape form uses the
+        # worst-case bound (whole-image roi), which SUPERSETS the
+        # reference's sample grid on every roi
+        ratio_h = max(1, -(-H // oh))
+        ratio_w = max(1, -(-W // ow))
+    off = 0.5 if aligned else 0.0
+    if boxes_num is None:
+        img_of_roi = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of_roi = jnp.repeat(jnp.arange(len(boxes_num)),
+                                jnp.asarray(boxes_num),
+                                total_repeat_length=R).astype(jnp.int32)
+
+    b = boxes * spatial_scale
+    x1, y1, x2, y2 = b[:, 0] - off, b[:, 1] - off, b[:, 2] - off, b[:, 3] - off
+    rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+    bin_w = rw / ow
+    bin_h = rh / oh
+    # sample grid: (R, oh*ratio_h) x (R, ow*ratio_w)
+    sy = (y1[:, None] + (jnp.arange(oh * ratio_h) + 0.5)[None, :]
+          * (bin_h / ratio_h)[:, None])                     # (R, oh*ratio_h)
+    sx = (x1[:, None] + (jnp.arange(ow * ratio_w) + 0.5)[None, :]
+          * (bin_w / ratio_w)[:, None])                     # (R, ow*ratio_w)
+
+    def bilinear(img, ys, xs):
+        """img (C, H, W); ys (P,), xs (Q,) -> (C, P, Q)."""
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy = jnp.clip(ys - y0, 0.0, 1.0)
+        wx = jnp.clip(xs - x0, 0.0, 1.0)
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                + v11 * wy[None, :, None] * wx[None, None, :])
+
+    def per_roi(r):
+        img = x[img_of_roi[r]]
+        samp = bilinear(img, sy[r], sx[r])    # (C, oh*ratio_h, ow*ratio_w)
+        samp = samp.reshape(C, oh, ratio_h, ow, ratio_w)
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+@register_op("roi_pool", ref="paddle/phi/kernels/roi_pool_kernel.h")
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0):
+    """RoI max pooling via a dense oversampled grid (static shapes)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if boxes_num is None:
+        img_of_roi = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of_roi = jnp.repeat(jnp.arange(len(boxes_num)),
+                                jnp.asarray(boxes_num),
+                                total_repeat_length=R).astype(jnp.int32)
+    b = jnp.round(boxes * spatial_scale)
+    # dense integer sampling, masked max per bin. PER-AXIS worst-case
+    # ratios: H/oh and W/ow independently, so a wide-but-short roi still
+    # visits every pixel column of each bin
+    ratio_h = max(4, -(-H // oh))
+    ratio_w = max(4, -(-W // ow))
+
+    def per_roi(r):
+        x1, y1, x2, y2 = b[r]
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        ys = y1 + (jnp.arange(oh * ratio_h)) * (rh / (oh * ratio_h))
+        xs = x1 + (jnp.arange(ow * ratio_w)) * (rw / (ow * ratio_w))
+        yi = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+        img = x[img_of_roi[r]]
+        samp = img[:, yi][:, :, xi]         # (C, oh*ratio_h, ow*ratio_w)
+        samp = samp.reshape(C, oh, ratio_h, ow, ratio_w)
+        return samp.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+@register_op("box_coder", differentiable=False,
+             ref="paddle/phi/kernels/box_coder_kernel.h")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode/decode boxes against priors (SSD-style)."""
+    pb = prior_box
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    var = (prior_box_var if prior_box_var is not None
+           else jnp.ones((1, 4), pb.dtype))
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                         (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                         jnp.log(tw[:, None] / pw[None, :]),
+                         jnp.log(th[:, None] / ph[None, :])], axis=-1)
+        return out / jnp.reshape(var, (1, -1, 4))
+    # decode_center_size: target (A, B, 4) deltas; ``axis`` names the dim
+    # matched against the priors (reference DecodeCenterSize: prior index =
+    # dim ``axis``), and the per-prior variance broadcasts along that SAME
+    # dim
+    if axis == 0:
+        expand = lambda v: v[:, None]                       # noqa: E731
+        var_b = jnp.reshape(var, (-1, 1, 4)) if var.ndim == 2 else var
+    else:
+        expand = lambda v: v[None, :]                       # noqa: E731
+        var_b = jnp.reshape(var, (1, -1, 4)) if var.ndim == 2 else var
+    d = target_box * var_b
+    pw, ph, pcx, pcy = expand(pw), expand(ph), expand(pcx), expand(pcy)
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+@register_op("prior_box", differentiable=False,
+             ref="paddle/phi/kernels/prior_box_kernel.h")
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """SSD prior (anchor) boxes over the feature map grid."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for s_i, ms in enumerate(min_sizes):
+        # reference pairing: max_sizes[s] belongs to min_sizes[s]
+        mx_box = None
+        if max_sizes:
+            s = np.sqrt(ms * max_sizes[s_i])
+            mx_box = (s, s)
+        ar_boxes = [(ms * np.sqrt(ar), ms / np.sqrt(ar)) for ar in ars]
+        if min_max_aspect_ratios_order and mx_box is not None:
+            # [min (ar=1), max, remaining ars] — the MobileNet-SSD layout
+            boxes.append(ar_boxes[0])
+            boxes.append(mx_box)
+            boxes.extend(ar_boxes[1:])
+        else:
+            boxes.extend(ar_boxes)
+            if mx_box is not None:
+                boxes.append(mx_box)
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    gx, gy = jnp.meshgrid(cx, cy)                 # (fh, fw)
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([(gx - bw / 2) / iw, (gy - bh / 2) / ih,
+                              (gx + bw / 2) / iw, (gy + bh / 2) / ih], -1))
+    pb = jnp.stack(out, axis=2)                   # (fh, fw, n_prior, 4)
+    if clip:
+        pb = jnp.clip(pb, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, pb.dtype), pb.shape)
+    return pb, var
+
+
+@register_op("yolo_box", differentiable=False,
+             ref="paddle/phi/kernels/yolo_box_kernel.h")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """Decode YOLOv3 head output (N, A*(5+C), H, W) into boxes + scores."""
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    feat = x.reshape(N, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(feat[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / W
+    by = (sig(feat[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / H
+    bw = jnp.exp(feat[:, :, 2]) * an[None, :, 0, None, None] / (
+        W * downsample_ratio)
+    bh = jnp.exp(feat[:, :, 3]) * an[None, :, 1, None, None] / (
+        H * downsample_ratio)
+    conf = sig(feat[:, :, 4])
+    probs = sig(feat[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, A * H * W, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(N, A * H * W, class_num)
+    keep = (conf.reshape(N, A * H * W) > conf_thresh)[..., None]
+    return boxes * keep, scores * keep
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 public API (paddle.vision.ops signature).
+    ``mask`` is forwarded POSITIONALLY into the registered op — kwarg
+    Tensors are non-differentiable attrs in the registry, and the DCNv2
+    modulation mask must receive gradients."""
+    if mask is None:
+        return _deform_conv2d_op(x, offset, weight, bias, stride=stride,
+                                 padding=padding, dilation=dilation,
+                                 deformable_groups=deformable_groups,
+                                 groups=groups)
+    return _deform_conv2d_masked_op(
+        x, offset, weight, mask, bias, stride=stride, padding=padding,
+        dilation=dilation, deformable_groups=deformable_groups,
+        groups=groups)
+
+
+@register_op("deform_conv2d",
+             ref="paddle/phi/kernels/deformable_conv_kernel.h")
+def _deform_conv2d_op(x, offset, weight, bias=None, stride=1, padding=0,
+                      dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2: bilinear-sample x at kernel positions shifted
+    by learned offsets, then a dense matmul with the kernel (the im2col
+    formulation; v2 when ``mask`` modulation is given)."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("deform_conv2d: groups > 1 TBD")
+    N, C, H, W = x.shape
+    Co, _, kh, kw = weight.shape
+    oh = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+    ow = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+    # base sampling grids per kernel tap and output pixel, plus offsets
+    off = offset.reshape(N, kh * kw, 2, oh, ow)
+    off_y = off[:, :, 0].reshape(N, kh, kw, oh, ow)
+    off_x = off[:, :, 1].reshape(N, kh, kw, oh, ow)
+    by = (jnp.arange(oh)[None, :] * stride[0] - padding[0]
+          + jnp.arange(kh)[:, None] * dilation[0])           # (kh, oh)
+    bx = (jnp.arange(ow)[None, :] * stride[1] - padding[1]
+          + jnp.arange(kw)[:, None] * dilation[1])           # (kw, ow)
+    py = by[None, :, None, :, None] + off_y                  # (N,kh,kw,oh,ow)
+    px = bx[None, None, :, None, :] + off_x
+
+    def bilin(img, ys, xs):
+        """img (C, H, W); ys/xs (...,) -> (C, ...)."""
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        out = 0.0
+        for dy, sy in ((0, 1 - wy), (1, wy)):
+            for dx, sx in ((0, 1 - wx), (1, wx)):
+                yi = y0 + dy
+                xi = x0 + dx
+                valid = ((yi >= 0) & (yi <= H - 1)
+                         & (xi >= 0) & (xi <= W - 1))
+                yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                v = img[:, yc, xc] * valid[None]
+                out = out + v * (sy * sx)[None]
+        return out
+
+    def per_image(img, pyi, pxi, m):
+        samp = bilin(img, pyi, pxi)              # (C, kh, kw, oh, ow)
+        if m is not None:
+            samp = samp * m[None]
+        cols = samp.reshape(C * kh * kw, oh * ow)
+        wmat = weight.reshape(Co, C * kh * kw)
+        return (wmat @ cols).reshape(Co, oh, ow)
+
+    msk = (mask.reshape(N, kh, kw, oh, ow) if mask is not None
+           else None)
+    out = jax.vmap(per_image)(x, py, px, msk) if msk is not None else \
+        jax.vmap(lambda i, a, b: per_image(i, a, b, None))(x, py, px)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register_op("deform_conv2d_v2",
+             ref="paddle/phi/kernels/deformable_conv_kernel.h (modulated)")
+def _deform_conv2d_masked_op(x, offset, weight, mask, bias=None, stride=1,
+                             padding=0, dilation=1, deformable_groups=1,
+                             groups=1):
+    """DCNv2 with the modulation mask as a differentiable positional."""
+    return _deform_conv2d_op.op.impl(
+        x, offset, weight, bias, stride=stride, padding=padding,
+        dilation=dilation, deformable_groups=deformable_groups,
+        groups=groups, mask=mask)
+
+
+class DeformConv2D(paddle.nn.Layer):
+    """Layer wrapper over deform_conv2d (paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size, kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]], attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], is_bias=True,
+                                           attr=bias_attr))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self.stride, padding=self.padding,
+                             dilation=self.dilation,
+                             deformable_groups=self.deformable_groups,
+                             groups=self.groups, mask=mask)
+
+
+@register_op("distribute_fpn_proposals", differentiable=False,
+             ref="paddle/phi/kernels/distribute_fpn_proposals_kernel.h")
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False):
+    """Assign each RoI to an FPN level by scale: returns per-level index
+    masks (static shapes: boolean masks per level + restore order)."""
+    off = 1.0 if pixel_offset else 0.0
+    w = fpn_rois[:, 2] - fpn_rois[:, 0] + off
+    h = fpn_rois[:, 3] - fpn_rois[:, 1] + off
+    scale = jnp.sqrt(jnp.clip(w * h, 0, None))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    masks = tuple((lvl == i) for i in range(min_level, max_level + 1))
+    # restore index: position of each roi in the level-grouped concat order
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    return masks + (restore,)

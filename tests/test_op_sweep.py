@@ -515,6 +515,18 @@ SKIP = {
                           "dedicated tests in test_subsystems.py",
     "llm_int8_linear": "needs int8 weight + outlier-structured activations; "
                        "dedicated tests in test_subsystems.py",
+    # detection family: structured box/roi/anchor inputs; dedicated
+    # reference-parity tests in test_vision_ops.py
+    "box_iou": "detection family; test_vision_ops.py",
+    "nms_mask": "detection family; test_vision_ops.py",
+    "roi_align": "detection family; test_vision_ops.py",
+    "roi_pool": "detection family; test_vision_ops.py",
+    "box_coder": "detection family; test_vision_ops.py",
+    "prior_box": "detection family; test_vision_ops.py",
+    "yolo_box": "detection family; test_vision_ops.py",
+    "deform_conv2d": "detection family; test_vision_ops.py",
+    "deform_conv2d_v2": "detection family (modulated); test_vision_ops.py",
+    "distribute_fpn_proposals": "detection family; test_vision_ops.py",
 }
 
 

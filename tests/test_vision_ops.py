@@ -1,0 +1,226 @@
+"""paddle.vision.ops detection family tests: reference-parity against
+hand-computed numpy implementations (phi detection kernel analogs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _ref_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or sup[j]:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            b = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a + b - inter) > thr:
+                sup[j] = True
+    return np.asarray(keep)
+
+
+def test_box_iou_and_nms_match_reference():
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(0, 10, (12, 2)).astype(np.float32)
+    wh = rng.uniform(1, 5, (12, 2)).astype(np.float32)
+    boxes = np.concatenate([xy, xy + wh], axis=1)
+    scores = rng.uniform(0, 1, 12).astype(np.float32)
+
+    iou = V.box_iou(paddle.to_tensor(boxes), paddle.to_tensor(boxes)).numpy()
+    assert np.allclose(np.diag(iou), 1.0, atol=1e-5)
+
+    kept = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                 iou_threshold=0.3).numpy()
+    ref = _ref_nms(boxes, scores, 0.3)
+    np.testing.assert_array_equal(kept, ref)
+
+    top = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                iou_threshold=0.3, top_k=2).numpy()
+    np.testing.assert_array_equal(top, ref[:2])
+
+
+def test_nms_categorical_keeps_cross_category_overlaps():
+    # two identical boxes in different categories must BOTH survive
+    boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    kept = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                 iou_threshold=0.5, category_idxs=paddle.to_tensor(cats),
+                 categories=[0, 1]).numpy()
+    assert set(kept.tolist()) == {0, 1}
+
+
+def test_roi_align_constant_input_and_grad():
+    # constant image: any aligned average equals the constant
+    x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+    out = V.roi_align(x, boxes, output_size=4, spatial_scale=1.0)
+    assert tuple(out.shape) == (1, 2, 4, 4)
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+    xv = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(1, 2, 8, 8)).astype(np.float32))
+    xv.stop_gradient = False
+    V.roi_align(xv, boxes, output_size=2).sum().backward()
+    g = xv.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    img = np.zeros((1, 1, 8, 8), np.float32)
+    img[0, 0, 2, 2] = 7.0
+    out = V.roi_pool(paddle.to_tensor(img),
+                     paddle.to_tensor(np.array([[0., 0., 7., 7.]],
+                                               np.float32)),
+                     output_size=1)
+    assert float(out.numpy().max()) == 7.0
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.default_rng(1)
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], np.float32)
+    var = np.ones((2, 4), np.float32) * 0.1
+    targets = np.array([[1, 1, 5, 5], [3, 3, 6, 7]], np.float32)
+    enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      paddle.to_tensor(targets),
+                      code_type="encode_center_size").numpy()
+    assert enc.shape == (2, 2, 4)
+    # decode the matched (diagonal) codes back: must reproduce the targets
+    diag = np.stack([enc[i, i] for i in range(2)])[None]  # (1, 2, 4) ->
+    dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      paddle.to_tensor(np.repeat(diag, 1, 0)),
+                      code_type="decode_center_size", axis=1).numpy()
+    np.testing.assert_allclose(dec[0], targets, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_shapes_and_range():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    pb, var = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                          aspect_ratios=[2.0], flip=True, clip=True)
+    # priors: 1 (ar=1) + 2 (ar=2, flipped) + 1 (max_size) = 4
+    assert tuple(pb.shape) == (4, 4, 4, 4)
+    p = pb.numpy()
+    assert p.min() >= 0.0 and p.max() <= 1.0
+    assert tuple(var.shape) == tuple(pb.shape)
+
+
+def test_yolo_box_decodes_center_anchor():
+    N, A, C, H, W = 1, 2, 3, 2, 2
+    x = np.zeros((N, A * (5 + C), H, W), np.float32)
+    img_size = np.array([[64, 64]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                               paddle.to_tensor(img_size),
+                               anchors=[10, 14, 23, 27], class_num=C,
+                               conf_thresh=0.0, downsample_ratio=32)
+    assert tuple(boxes.shape) == (1, A * H * W, 4)
+    assert tuple(scores.shape) == (1, A * H * W, C)
+    b = boxes.numpy()
+    assert np.isfinite(b).all() and b.min() >= 0 and b.max() <= 63
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
+    w = paddle.to_tensor(rng.normal(size=(4, 3, 3, 3)).astype(np.float32))
+    offset = paddle.to_tensor(np.zeros((2, 2 * 9, 8, 8), np.float32))
+    out = V.deform_conv2d(x, offset, w, padding=1)
+    ref = F.conv2d(x, w, None, stride=1, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_grad():
+    rng = np.random.default_rng(3)
+    layer = V.DeformConv2D(3, 4, 3, padding=1)
+    x = paddle.to_tensor(rng.normal(size=(1, 3, 6, 6)).astype(np.float32))
+    offset = paddle.to_tensor(
+        0.1 * rng.normal(size=(1, 18, 6, 6)).astype(np.float32))
+    offset.stop_gradient = False
+    out = layer(x, offset)
+    assert tuple(out.shape) == (1, 4, 6, 6)
+    out.sum().backward()
+    assert offset.grad is not None and layer.weight.grad is not None
+
+
+def test_distribute_fpn_proposals_levels_and_restore():
+    rois = np.array([[0, 0, 16, 16],      # small -> low level
+                     [0, 0, 224, 224],    # refer scale -> refer level
+                     [0, 0, 500, 500]],   # large -> high level
+                    np.float32)
+    *masks, restore = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    lv = np.stack([m.numpy() for m in masks])
+    assert lv.sum() == 3  # every roi assigned exactly one level
+    assert lv[0, 0] and lv[2, 1] and lv[3, 2]
+    r = restore.numpy()
+    assert sorted(r.tolist()) == [0, 1, 2]
+
+
+def test_box_coder_decode_axis0_default_layout():
+    """axis=0: priors match dim 0 of the (P, B, 4) deltas (reference
+    DecodeCenterSize convention)."""
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 8], [1, 1, 3, 3]], np.float32)
+    var = np.full((3, 4), 0.1, np.float32)
+    deltas = np.zeros((3, 2, 4), np.float32)  # zero deltas -> priors back
+    dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      paddle.to_tensor(deltas),
+                      code_type="decode_center_size", axis=0).numpy()
+    assert dec.shape == (3, 2, 4)
+    for b in range(2):
+        np.testing.assert_allclose(dec[:, b], priors, rtol=1e-5, atol=1e-5)
+
+
+def test_prior_box_max_size_index_pairing_and_order():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    # two min sizes, two max sizes: INDEX pairing -> (1 ar + 1 max) * 2 = 4
+    pb, _ = V.prior_box(feat, img, min_sizes=[8.0, 12.0],
+                        max_sizes=[16.0, 24.0], aspect_ratios=[1.0])
+    assert pb.shape[2] == 4, pb.shape
+    # min_max order: per min_size the MAX box comes second
+    pb2, _ = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                         aspect_ratios=[2.0], flip=False,
+                         min_max_aspect_ratios_order=True)
+    w = (pb2.numpy()[0, 0, :, 2] - pb2.numpy()[0, 0, :, 0]) * 32
+    # order: [min(ar=1)=8, max=sqrt(8*16)~11.3, ar=2 box]
+    np.testing.assert_allclose(w[0], 8.0, rtol=1e-5)
+    np.testing.assert_allclose(w[1], np.sqrt(8 * 16), rtol=1e-5)
+
+
+def test_deform_conv2d_mask_receives_gradients():
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.normal(size=(1, 2, 6, 6)).astype(np.float32))
+    w = paddle.to_tensor(rng.normal(size=(3, 2, 3, 3)).astype(np.float32))
+    offset = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    mask = paddle.to_tensor(np.full((1, 9, 6, 6), 0.5, np.float32))
+    mask.stop_gradient = False
+    out = V.deform_conv2d(x, offset, w, padding=1, mask=mask)
+    out.sum().backward()
+    assert mask.grad is not None
+    assert np.abs(mask.grad.numpy()).sum() > 0
+
+
+def test_roi_pool_wide_narrow_output_finds_max():
+    # W >> H with a 1-wide output: per-axis ratios must still visit the max
+    img = np.zeros((1, 1, 8, 64), np.float32)
+    img[0, 0, 4, 37] = 9.0
+    out = V.roi_pool(paddle.to_tensor(img),
+                     paddle.to_tensor(np.array([[0., 0., 63., 7.]],
+                                               np.float32)),
+                     output_size=(8, 1))
+    assert float(out.numpy().max()) == 9.0
