@@ -81,10 +81,19 @@ class GPTForCausalLM(nn.Layer):
         x = self.ln_f(x)
         return paddle.matmul(x, self.wte.weight.t())  # tied head
 
-    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
-        """Greedy/sampled decode (no-cache fallback; GenerationMixin
-        analog)."""
-        from paddle_tpu.nn.generation import generate_tokens
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 decode_strategy: str = "greedy_search", **kwargs):
+        """Greedy/sampled/beam decode (no-cache fallback; GenerationMixin
+        analog). decode_strategy: greedy_search | sampling | beam_search."""
+        from paddle_tpu.nn.generation import beam_search, generate_tokens
+        if decode_strategy not in ("greedy_search", "sampling",
+                                   "beam_search"):
+            raise ValueError(f"unknown decode_strategy {decode_strategy!r}")
+        if decode_strategy == "beam_search":
+            return beam_search(self, input_ids,
+                               max_new_tokens=max_new_tokens, **kwargs)
+        if decode_strategy == "sampling":
+            kwargs.setdefault("do_sample", True)
         return generate_tokens(self, input_ids,
                                max_new_tokens=max_new_tokens, **kwargs)
 

@@ -243,16 +243,28 @@ class LlamaForCausalLM(nn.Layer):
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 max_len: Optional[int] = None, **kwargs):
+                 max_len: Optional[int] = None,
+                 decode_strategy: str = "greedy_search", **kwargs):
         """Decode with the compile-once KV-cache engine (GenerationMixin
         surface; inference/generate.py). The decoder is cached on the
-        model, so repeated calls reuse the compiled executables."""
+        model, so repeated calls reuse the compiled executables.
+        decode_strategy='beam_search' routes to the no-cache beam decoder
+        (nn/generation.py — the cached engine is greedy/sampling-only)."""
         import numpy as np
         from paddle_tpu.inference.generate import LlamaDecoder
+        if decode_strategy not in ("greedy_search", "sampling",
+                                   "beam_search"):
+            raise ValueError(f"unknown decode_strategy {decode_strategy!r}")
         need = int(np.asarray(input_ids).shape[1]) + max_new_tokens
         if max_len is not None and max_len < need:
             raise ValueError(f"max_len {max_len} < prompt + new tokens "
                              f"({need})")
+        if decode_strategy == "beam_search":
+            from paddle_tpu.nn.generation import beam_search
+            return beam_search(self, input_ids,
+                               max_new_tokens=max_new_tokens, **kwargs)
+        if decode_strategy == "sampling":
+            kwargs.setdefault("do_sample", True)
         ml = max(64, need) if max_len is None else max_len
         # the decoder snapshots weights: rebuild when any param buffer has
         # been swapped since (optimizer step / set_state_dict)

@@ -12,7 +12,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["generate_tokens"]
+__all__ = ["generate_tokens", "beam_search"]
 
 
 def generate_tokens(model, input_ids, max_new_tokens: int = 32,
@@ -75,3 +75,103 @@ def _sublayers_with_self(model):
     if hasattr(model, "sublayers"):
         out.extend(model.sublayers(include_self=False))
     return out
+
+
+def beam_search(model, input_ids, beam_size: int = 4,
+                max_new_tokens: int = 32,
+                eos_token_id: Optional[int] = None,
+                length_penalty: float = 1.0) -> np.ndarray:
+    """Beam-search decode (the reference GenerationMixin beam path,
+    python/paddle BeamSearchDecoder + gather_tree capability).
+
+    Works on any eager causal LM with forward(ids) -> (B, S, V) logits
+    (no-cache fallback, like generate_tokens). Keeps (B, beam) running
+    hypotheses; finished beams (eos) are frozen with their score; the
+    backtrace runs through the gather_tree op. Returns (B, S + new) int
+    ids of the best beam."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import tape
+
+    ids = np.asarray(input_ids)
+    B, S = ids.shape
+    K = beam_size
+    if max_new_tokens <= 0:
+        return ids
+    max_pos = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+    if max_pos is not None and S + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt {S} + {max_new_tokens} new tokens exceeds "
+            f"max_position_embeddings {max_pos}")
+
+    mode_snapshot = [(m, m.training) for m in _sublayers_with_self(model)
+                     if hasattr(m, "training")]
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        with tape.no_grad():
+            # expand prompts to (B*K, S); beam 0 starts live, others -inf
+            # so the first step seeds K DISTINCT continuations
+            flat = np.repeat(ids, K, axis=0)
+            scores = jnp.where(
+                jnp.arange(K)[None, :] == 0, 0.0, -jnp.inf)     # (B, K)
+            scores = jnp.broadcast_to(scores, (B, K))
+            step_tokens = []    # list of (B, K) chosen token per step
+            step_parents = []   # list of (B, K) parent beam per step
+            done = jnp.zeros((B, K), bool)
+            for _ in range(max_new_tokens):
+                logits = model(paddle.to_tensor(flat)).value[:, -1]
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1)        # (B*K, V)
+                V = logp.shape[-1]
+                logp = logp.reshape(B, K, V)
+                # frozen beams contribute exactly one continuation (eos)
+                if eos_token_id is not None:
+                    frozen = jnp.full((V,), -jnp.inf).at[eos_token_id].set(0.0)
+                    logp = jnp.where(done[..., None], frozen[None, None, :],
+                                     logp)
+                cand = scores[..., None] + logp                 # (B, K, V)
+                flat_cand = cand.reshape(B, K * V)
+                top_scores, top_idx = jax.lax.top_k(flat_cand, K)
+                parent = top_idx // V                           # (B, K)
+                token = top_idx % V
+                scores = top_scores
+                step_tokens.append(token)
+                step_parents.append(parent)
+                done = jnp.take_along_axis(done, parent, axis=1)
+                if eos_token_id is not None:
+                    done = done | (token == eos_token_id)
+                # reorder running sequences and append the new token
+                seqs = flat.reshape(B, K, -1)
+                seqs = np.take_along_axis(
+                    seqs, np.asarray(parent)[..., None], axis=1)
+                flat = np.concatenate(
+                    [seqs, np.asarray(token)[..., None]],
+                    axis=-1).reshape(B * K, -1)
+                if eos_token_id is not None and bool(done.all()):
+                    break
+            # backtrace through the taped gather_tree op: (T, B, K) layout
+            toks = jnp.stack(step_tokens)                       # (T, B, K)
+            parents = jnp.stack(step_parents)
+            full = paddle.gather_tree(paddle.to_tensor(toks),
+                                      paddle.to_tensor(parents)).numpy()
+            # pick the best beam by length-penalized final score
+            T = full.shape[0]
+            lengths = jnp.full((B, K), float(T))
+            if eos_token_id is not None:
+                is_eos = jnp.asarray(full) == eos_token_id      # (T, B, K)
+                first_eos = jnp.argmax(is_eos, axis=0)          # (T of eos)
+                has_eos = jnp.any(is_eos, axis=0)
+                lengths = jnp.where(has_eos, first_eos + 1.0, lengths)
+            final = scores / (lengths ** length_penalty)
+            best = np.asarray(jnp.argmax(final, axis=1))        # (B,)
+            chosen = np.stack([full[:, b, best[b]] for b in range(B)],
+                              axis=0)                           # (B, T)
+            return np.concatenate([ids, chosen], axis=1)
+    finally:
+        for m, was in mode_snapshot:
+            m.training = was
+
