@@ -13,3 +13,4 @@ from paddle_tpu.nn.clip import (  # noqa: F401
 from paddle_tpu.nn import functional  # noqa: F401
 from paddle_tpu.nn import initializer  # noqa: F401
 from paddle_tpu.nn import utils  # noqa: F401
+from paddle_tpu.nn.layers_extra import *  # noqa: F401,F403,E402

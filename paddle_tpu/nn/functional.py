@@ -396,23 +396,32 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode=F
 
 
 @register_op("max_pool1d")
-def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCL"):
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL"):
+    if return_mask:
+        from paddle_tpu.nn.functional_extra import max_pool_with_index
+        return max_pool_with_index(x, kernel_size, stride, padding, nd=1)
     return _pool(x, kernel_size, stride, padding, 1, lax.max, -jnp.inf,
                  data_format, ceil_mode)
 
 
 @register_op("max_pool2d")
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCHW"):
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW"):
+    if return_mask:
+        from paddle_tpu.nn.functional_extra import max_pool_with_index
+        return max_pool_with_index(x, kernel_size, stride, padding, nd=2)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     return _pool(x, kernel_size, stride, padding, 2, lax.max, init,
                  data_format, ceil_mode)
 
 
 @register_op("max_pool3d")
-def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCDHW"):
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    if return_mask:
+        from paddle_tpu.nn.functional_extra import max_pool_with_index
+        return max_pool_with_index(x, kernel_size, stride, padding, nd=3)
     return _pool(x, kernel_size, stride, padding, 3, lax.max, -jnp.inf,
                  data_format, ceil_mode)
 
@@ -1158,4 +1167,22 @@ __all__ += [
     "affine_grid", "channel_shuffle", "dice_loss", "grid_sample",
     "huber_loss", "log_loss", "multi_label_soft_margin_loss", "npair_loss",
     "pdist", "soft_margin_loss",
+]
+
+
+# functional surface round-out (see nn/functional_extra.py)
+from paddle_tpu.nn.functional_extra import (  # noqa: E402
+    adaptive_avg_pool3d, adaptive_max_pool1d, adaptive_max_pool3d, bilinear,
+    fold, fractional_max_pool2d, fractional_max_pool3d, gaussian_nll_loss,
+    hsigmoid_loss, max_unpool1d, max_unpool2d, max_unpool3d,
+    multi_margin_loss, poisson_nll_loss, rnnt_loss, spectral_norm,
+    thresholded_relu, triplet_margin_with_distance_loss)
+
+__all__ += [
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool3d",
+    "bilinear", "fold", "fractional_max_pool2d", "fractional_max_pool3d",
+    "gaussian_nll_loss", "hsigmoid_loss", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "multi_margin_loss", "poisson_nll_loss", "rnnt_loss",
+    "spectral_norm", "thresholded_relu",
+    "triplet_margin_with_distance_loss",
 ]

@@ -1,0 +1,462 @@
+"""Functional surface round-out: fold/unpool/adaptive-3D pooling,
+fractional pooling, bilinear, spectral norm, hierarchical sigmoid,
+RNN-T loss, and the remaining loss family.
+
+Analog of the corresponding python/paddle/nn/functional entries over phi
+kernels (fold_kernel, unpool_kernel, fractional pooling via
+max_pool*_with_index, hsigmoid_loss_kernel, warprnnt). Everything is
+traceable jnp/lax math (the RNN-T alpha recursion is a lax.scan, the
+hierarchical-sigmoid tree walk is a static-depth bit chain).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.registry import register_op
+
+__all__ = [
+    "fold", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d", "bilinear",
+    "spectral_norm", "thresholded_relu", "poisson_nll_loss",
+    "gaussian_nll_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss", "rnnt_loss",
+]
+
+
+def _pair(v, n=2):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@register_op("fold", ref="paddle/phi/kernels/fold_kernel.h")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """Inverse of unfold: scatter-add (N, C*kh*kw, L) columns back into
+    (N, C, H, W)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    N = x.shape[0]
+    C = x.shape[1] // (kh * kw)
+    lh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    lw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(N, C, kh, kw, lh, lw)
+    out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            patch = cols[:, :, i, j]             # (N, C, lh, lw)
+            out = out.at[:, :,
+                         i * dh:i * dh + lh * sh:sh,
+                         j * dw:j * dw + lw * sw:sw].add(patch)
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def _max_unpool(x, indices, ndim_spatial, output_size):
+    """Scatter values to the argmax flat positions recorded by
+    max_pool*(return_mask=True)."""
+    lead = x.shape[:2]
+    out_spatial = tuple(output_size)
+    flat_out = 1
+    for d in out_spatial:
+        flat_out *= d
+    xv = x.reshape(lead + (-1,))
+    idx = indices.reshape(lead + (-1,))
+    out = jnp.zeros(lead + (flat_out,), x.dtype)
+    b = jnp.arange(lead[0])[:, None, None]
+    c = jnp.arange(lead[1])[None, :, None]
+    out = out.at[b, c, jnp.clip(idx, 0, flat_out - 1)].add(xv)
+    return out.reshape(lead + out_spatial)
+
+
+@register_op("max_unpool1d", ref="paddle/phi/kernels/unpool_kernel.h")
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    stride = stride or kernel_size
+    if output_size is None:
+        L = (x.shape[-1] - 1) * stride + kernel_size - 2 * padding
+        output_size = (L,)
+    return _max_unpool(x, indices, 1, output_size[-1:])
+
+
+@register_op("max_unpool2d", ref="paddle/phi/kernels/unpool_kernel.h")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    if output_size is None:
+        output_size = tuple((x.shape[2 + i] - 1) * s[i] + k[i] - 2 * p[i]
+                            for i in range(2))
+    return _max_unpool(x, indices, 2, tuple(output_size)[-2:])
+
+
+@register_op("max_unpool3d", ref="paddle/phi/kernels/unpool_kernel.h")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    k = _pair(kernel_size, 3)
+    s = _pair(stride, 3) if stride is not None else k
+    p = _pair(padding, 3)
+    if output_size is None:
+        output_size = tuple((x.shape[2 + i] - 1) * s[i] + k[i] - 2 * p[i]
+                            for i in range(3))
+    return _max_unpool(x, indices, 3, tuple(output_size)[-3:])
+
+
+@register_op("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    od, oh, ow = output_size
+    n_, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        r = x.reshape(n_, c, od, d // od, oh, h // oh, ow, w // ow)
+        return r.mean(axis=(3, 5, 7))
+    from paddle_tpu.nn.functional import _adaptive_pool_matrix
+    cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    md = _adaptive_pool_matrix(d, od, cdt)
+    mh = _adaptive_pool_matrix(h, oh, cdt)
+    mw = _adaptive_pool_matrix(w, ow, cdt)
+    out = jnp.einsum("ncdhw,ed,oh,pw->nceop", x.astype(cdt), md, mh, mw,
+                     precision="highest")
+    return out.astype(x.dtype)
+
+
+def _adaptive_max(x, axis, n_out):
+    """Adaptive max along one axis via per-bin dynamic slices (n_out is a
+    static int, so the python loop unrolls)."""
+    n_in = x.shape[axis]
+    outs = []
+    for i in range(n_out):
+        s = (i * n_in) // n_out
+        e = -(-((i + 1) * n_in) // n_out)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(s, e)
+        outs.append(jnp.max(x[tuple(sl)], axis=axis, keepdims=True))
+    return jnp.concatenate(outs, axis=axis)
+
+
+@register_op("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    out = _adaptive_max(x, -1, int(output_size))
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d: return_mask TBD")
+    return out
+
+
+@register_op("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    out = x
+    for ax, n_out in zip((-3, -2, -1), output_size):
+        out = _adaptive_max(out, ax, int(n_out))
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d: return_mask TBD")
+    return out
+
+
+def _fractional_bounds(n_in, n_out, u):
+    """Pseudo-random fractional pooling boundaries (deterministic given u):
+    b_i = ceil(alpha * (i + u)) - ceil(alpha * u), b_{n_out} = n_in."""
+    alpha = n_in / n_out
+    idx = np.ceil(alpha * (np.arange(n_out + 1) + u)) - np.ceil(alpha * u)
+    idx[-1] = n_in
+    return idx.astype(int)
+
+
+import numpy as np  # noqa: E402  (host-side boundary computation)
+
+
+def _fractional_pool(x, axes, out_sizes, us):
+    out = x
+    for ax, n_out, u in zip(axes, out_sizes, us):
+        n_in = out.shape[ax]
+        b = _fractional_bounds(n_in, int(n_out), float(u))
+        pieces = []
+        for i in range(int(n_out)):
+            sl = [slice(None)] * out.ndim
+            sl[ax] = slice(int(b[i]), max(int(b[i + 1]), int(b[i]) + 1))
+            pieces.append(jnp.max(out[tuple(sl)], axis=ax, keepdims=True))
+        out = jnp.concatenate(pieces, axis=ax)
+    return out
+
+
+@register_op("fractional_max_pool2d",
+             ref="python/paddle/nn/functional/pooling.py:fractional_max_pool2d")
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    """Fractional max pooling (Graham 2014): pseudo-random variable-size
+    bins from a single u in (0,1); deterministic given ``random_u``."""
+    if return_mask:
+        raise NotImplementedError("fractional_max_pool2d: return_mask TBD")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 2
+    if random_u is None:
+        from paddle_tpu.framework import random as rnd
+        random_u = float(jax.random.uniform(rnd.split_key(), ()))
+    return _fractional_pool(x, (-2, -1), output_size, (random_u, random_u))
+
+
+@register_op("fractional_max_pool3d",
+             ref="python/paddle/nn/functional/pooling.py:fractional_max_pool3d")
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    if return_mask:
+        raise NotImplementedError("fractional_max_pool3d: return_mask TBD")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    if random_u is None:
+        from paddle_tpu.framework import random as rnd
+        random_u = float(jax.random.uniform(rnd.split_key(), ()))
+    return _fractional_pool(x, (-3, -2, -1), output_size, (random_u,) * 3)
+
+
+@register_op("bilinear", ref="paddle/phi/kernels/bilinear_kernel.h")
+def bilinear(x1, x2, weight, bias=None):
+    """out[b, k] = x1[b]^T W[k] x2[b] (paddle.nn.functional.bilinear)."""
+    out = jnp.einsum("bi,kij,bj->bk", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("spectral_norm_op",
+             ref="paddle/phi/kernels/spectral_norm_kernel.h")
+def spectral_norm(weight, weight_u, weight_v, dim=0, power_iters=1,
+                  eps=1e-12):
+    """Normalize weight by its largest singular value (power iteration)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    mat = w.reshape(w.shape[0], -1)
+    u, v = weight_u, weight_v
+    for _ in range(max(0, power_iters)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (mat @ v)
+    return jnp.moveaxis(w / sigma, 0, dim)
+
+
+@register_op("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:  # Stirling approximation for the label! term
+        stir = (label * jnp.log(label + epsilon) - label
+                + 0.5 * jnp.log(2 * jnp.pi * (label + epsilon)))
+        loss = loss + jnp.where(label > 1, stir, 0.0)
+    return _reduce(loss, reduction)
+
+
+@register_op("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.clip(variance, epsilon, None)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, input.dtype))
+    return _reduce(loss, reduction)
+
+
+@register_op("multi_margin_loss")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    """Multi-class margin loss: mean_j max(0, margin - x_y + x_j)^p."""
+    B, C = input.shape
+    lab = jnp.asarray(label)
+    x_y = jnp.take_along_axis(input, lab[:, None], axis=1)     # (B, 1)
+    m = jnp.clip(margin - x_y + input, 0.0, None) ** p
+    if weight is not None:
+        m = m * jnp.asarray(weight)[lab][:, None]
+    m = m * (jnp.arange(C)[None, :] != lab[:, None])            # drop j == y
+    loss = jnp.sum(m, axis=1) / C
+    return _reduce(loss, reduction)
+
+
+@register_op("triplet_margin_with_distance_loss", differentiable=True)
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    dist = distance_function or (
+        lambda a, b: jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.clip(dp - dn + margin, 0.0, None), reduction)
+
+
+@register_op("hsigmoid_loss",
+             ref="paddle/phi/kernels/hsigmoid_loss_kernel.h")
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree (or a
+    custom tree via path_table/path_code). weight: (num_classes-1, F).
+
+    Default-tree walk, traceably: leaf node id = label + num_classes in a
+    1-indexed heap; ancestors are successive halvings (static depth
+    ceil(log2)), code bit = child parity; levels past the root are masked.
+    """
+    import math
+    B = input.shape[0]
+    if path_table is not None:
+        codes = jnp.asarray(path_code).astype(jnp.float32)
+        nodes = jnp.asarray(path_table)
+        valid = (nodes >= 0)
+        nodes = jnp.clip(nodes, 0, num_classes - 2)
+    else:
+        depth = max(1, math.ceil(math.log2(max(2, num_classes))))
+        n = jnp.asarray(label) + num_classes                    # heap leaf id
+        node_list, code_list, valid_list = [], [], []
+        for _ in range(depth):
+            parent = n // 2
+            code_list.append((n % 2).astype(jnp.float32))
+            node_list.append(parent - 1)       # internal node row in weight
+            valid_list.append(parent >= 1)
+            n = parent
+        nodes = jnp.stack(node_list, axis=1)                    # (B, D)
+        codes = jnp.stack(code_list, axis=1)
+        valid = jnp.stack(valid_list, axis=1) & (nodes < num_classes - 1)
+        nodes = jnp.clip(nodes, 0, num_classes - 2)
+    w = jnp.asarray(weight)[nodes]                              # (B, D, F)
+    logits = jnp.einsum("bdf,bf->bd", w, input)
+    if bias is not None:
+        logits = logits + jnp.asarray(bias).reshape(-1)[nodes]
+    # BCE with target = code bit, masked to the real path
+    ls = jax.nn.log_sigmoid(logits)
+    lns = jax.nn.log_sigmoid(-logits)
+    bce = -(codes * ls + (1.0 - codes) * lns)
+    loss = jnp.sum(bce * valid, axis=1, keepdims=True)          # (B, 1)
+    return loss
+
+
+@register_op("rnnt_loss", ref="paddle warprnnt integration "
+             "(paddle/phi/kernels/gpu/warprnnt_kernel.cu analog)")
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean"):
+    """RNN-Transducer loss: log-space alpha recursion over the (T, U+1)
+    lattice as a lax.scan over time (the warprnnt capability in pure
+    traceable form; gradients come from autodiff of the recursion).
+
+    logits: (B, T, U+1, V); labels: (B, U) int; lengths per sample.
+    """
+    B, T, U1, V = logits.shape
+    U = U1 - 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lab = jnp.asarray(labels)
+    blank_lp = logp[..., blank]                                # (B, T, U+1)
+    # label transition log-prob at (t, u): emit labels[u] from state u
+    lab_idx = jnp.concatenate([lab, jnp.zeros((B, 1), lab.dtype)], 1)
+    emit_lp = jnp.take_along_axis(
+        logp, lab_idx[:, None, :, None], axis=-1)[..., 0]      # (B, T, U+1)
+
+    neg_inf = jnp.float32(-1e30)
+    u_range = jnp.arange(U1)
+
+    def step(alpha_prev, t):
+        # alpha[t, u] = logsumexp(alpha[t-1, u] + blank[t-1, u],
+        #                         alpha[t, u-1] + emit[t, u-1])
+        from_blank = alpha_prev + blank_lp[:, t - 1, :]
+        # within-t label moves: sequential over u — scan over U1
+        def inner(carry, u):
+            prev_u = carry
+            val = jnp.where(
+                u == 0, from_blank[:, 0],
+                jnp.logaddexp(from_blank[:, u],
+                              prev_u + emit_lp[:, t, u - 1]))
+            return val, val
+
+        _, cols = lax.scan(inner, jnp.full((B,), neg_inf), u_range)
+        alpha_t = jnp.moveaxis(cols, 0, 1)                     # (B, U+1)
+        return alpha_t, alpha_t
+
+    # alpha[0, u]: only label moves at t=0
+    def init_inner(carry, u):
+        val = jnp.where(u == 0, 0.0, carry + emit_lp[:, 0, u - 1])
+        return val, val
+
+    _, cols0 = lax.scan(init_inner, jnp.full((B,), jnp.float32(0.0)),
+                        u_range)
+    alpha0 = jnp.moveaxis(cols0, 0, 1)
+    alphas = [alpha0]
+    alpha = alpha0
+    for t in range(1, T):
+        alpha, _ = step(alpha, t)
+        alphas.append(alpha)
+    all_alpha = jnp.stack(alphas, axis=1)                      # (B, T, U+1)
+    tl = jnp.asarray(input_lengths).astype(jnp.int32)
+    ul = jnp.asarray(label_lengths).astype(jnp.int32)
+    b_idx = jnp.arange(B)
+    final_alpha = all_alpha[b_idx, tl - 1, ul]
+    final_blank = blank_lp[b_idx, tl - 1, ul]
+    nll = -(final_alpha + final_blank)
+    return _reduce(nll, reduction)
+
+
+def max_pool_with_index(x, kernel_size, stride=None, padding=0, nd=2):
+    """(pooled, flat-input indices) — the return_mask machinery behind
+    max_pool1d/2d/3d(..., return_mask=True) and the unpool inputs
+    (reference max_pool2d_with_index kernel).
+
+    Patch extraction of both the values and an input-position iota, argmax
+    over the patch axis, gather the winning position."""
+    k = _pair(kernel_size, nd)
+    s = _pair(stride, nd) if stride is not None else k
+    p = _pair(padding, nd)
+    N, C = x.shape[:2]
+    spatial = x.shape[2:]
+    pads = [(0, 0), (0, 0)] + [(p[i], p[i]) for i in range(nd)]
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, pads, constant_values=neg)
+    flat_size = 1
+    for d in spatial:
+        flat_size *= d
+    iota = jnp.arange(flat_size, dtype=jnp.float32).reshape(
+        (1, 1) + spatial)
+    iota_p = jnp.pad(iota, pads, constant_values=-1.0)
+
+    dn = lax.conv_dimension_numbers(
+        xp.shape, (1, 1) + k,
+        ("NC" + "DHW"[-nd:], "OI" + "DHW"[-nd:], "NC" + "DHW"[-nd:]))
+
+    def patches(v):
+        return lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s, padding="VALID",
+            dimension_numbers=dn)
+
+    vp = patches(xp)                    # (N, C*prod(k), out...)
+    ip = patches(iota_p)                # (1, prod(k), out...)
+    kk = 1
+    for d in k:
+        kk *= d
+    out_spatial = vp.shape[2:]
+    vp = vp.reshape(N, C, kk, *out_spatial)
+    arg = jnp.argmax(vp, axis=2)        # (N, C, out...)
+    pooled = jnp.max(vp, axis=2)
+    ip = ip.reshape(1, 1, kk, *out_spatial)
+    ip = jnp.broadcast_to(ip, (N, C, kk) + out_spatial)
+    idx = jnp.take_along_axis(ip, arg[:, :, None], axis=2)[:, :, 0]
+    return pooled, idx.astype(jnp.int32)

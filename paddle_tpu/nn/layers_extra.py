@@ -1,0 +1,503 @@
+"""nn layer-class surface round-out (python/paddle/nn/__init__ parity).
+
+Thin Layer wrappers over existing functionals plus the handful that carry
+state (Bilinear, SpectralNorm, HSigmoidLoss, BiRNN, BeamSearchDecoder).
+Every class here exists in the reference's paddle.nn export list; the
+compute all lives in nn/functional*.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = [
+    "CELU", "Softsign", "LogSigmoid", "Tanhshrink", "Maxout",
+    "ThresholdedReLU", "RReLU", "Softmax2D",
+    "Dropout3D", "AlphaDropout",
+    "Unfold", "Fold", "Unflatten",
+    "MaxPool3D", "AvgPool3D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "UpsamplingNearest2D", "UpsamplingBilinear2D",
+    "PixelUnshuffle", "ChannelShuffle",
+    "Conv1DTranspose", "Conv3DTranspose",
+    "InstanceNorm1D", "InstanceNorm3D", "SpectralNorm", "Bilinear",
+    "CTCLoss", "RNNTLoss", "PoissonNLLLoss", "GaussianNLLLoss",
+    "MultiLabelSoftMarginLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
+    "MultiMarginLoss", "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+    "SoftMarginLoss", "HSigmoidLoss",
+    "RNNCellBase", "BiRNN", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+def _fn_layer(name, fn_name, arg_names=(), defaults=()):
+    """Build a Layer class whose forward calls F.<fn_name>(x, *ctor_args)."""
+
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        vals = dict(zip(arg_names, defaults))
+        vals.update(dict(zip(arg_names, args)))
+        vals.update({k: v for k, v in kwargs.items() if k in arg_names})
+        for k, v in vals.items():
+            setattr(self, k, v)
+        self._argnames = arg_names
+
+    def forward(self, x):
+        kw = {k: getattr(self, k) for k in self._argnames}
+        return getattr(F, fn_name)(x, **kw)
+
+    cls = type(name, (Layer,), {"__init__": __init__, "forward": forward,
+                                "__doc__": f"paddle.nn.{name} analog over "
+                                           f"F.{fn_name}."})
+    return cls
+
+
+CELU = _fn_layer("CELU", "celu", ("alpha",), (1.0,))
+Softsign = _fn_layer("Softsign", "softsign")
+LogSigmoid = _fn_layer("LogSigmoid", "log_sigmoid")
+Tanhshrink = _fn_layer("Tanhshrink", "tanhshrink")
+Maxout = _fn_layer("Maxout", "maxout", ("groups", "axis"), (2, 1))
+ThresholdedReLU = _fn_layer("ThresholdedReLU", "thresholded_relu",
+                            ("threshold", "value"), (1.0, 0.0))
+PixelUnshuffle = _fn_layer("PixelUnshuffle", "pixel_unshuffle",
+                           ("downscale_factor",), (2,))
+ChannelShuffle = _fn_layer("ChannelShuffle", "channel_shuffle",
+                           ("groups",), (2,))
+Unflatten = _fn_layer("Unflatten", "unflatten", ("axis", "shape"), (1, ()))
+AdaptiveAvgPool3D = _fn_layer("AdaptiveAvgPool3D", "adaptive_avg_pool3d",
+                              ("output_size",), (1,))
+AdaptiveMaxPool1D = _fn_layer("AdaptiveMaxPool1D", "adaptive_max_pool1d",
+                              ("output_size",), (1,))
+AdaptiveMaxPool3D = _fn_layer("AdaptiveMaxPool3D", "adaptive_max_pool3d",
+                              ("output_size",), (1,))
+FractionalMaxPool2D = _fn_layer("FractionalMaxPool2D",
+                                "fractional_max_pool2d",
+                                ("output_size", "kernel_size", "random_u"),
+                                (1, None, None))
+FractionalMaxPool3D = _fn_layer("FractionalMaxPool3D",
+                                "fractional_max_pool3d",
+                                ("output_size", "kernel_size", "random_u"),
+                                (1, None, None))
+MaxPool3D = _fn_layer("MaxPool3D", "max_pool3d",
+                      ("kernel_size", "stride", "padding"), (2, None, 0))
+AvgPool3D = _fn_layer("AvgPool3D", "avg_pool3d",
+                      ("kernel_size", "stride", "padding"), (2, None, 0))
+Unfold = _fn_layer("Unfold", "unfold",
+                   ("kernel_sizes", "strides", "paddings", "dilations"),
+                   (3, 1, 0, 1))
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes,
+                      self.strides, self.paddings, self.dilations)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of (N, C, H, W)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW"):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class _MaxUnPool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return getattr(F, self._fn)(x, indices, self.kernel_size,
+                                    self.stride, self.padding,
+                                    self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    _fn = "max_unpool1d"
+
+
+class MaxUnPool2D(_MaxUnPool):
+    _fn = "max_unpool2d"
+
+
+class MaxUnPool3D(_MaxUnPool):
+    _fn = "max_unpool3d"
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode="nearest")
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True)
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.groups = groups
+        self.dilation = dilation
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, kernel_size],
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True, attr=bias_attr))
+
+    def forward(self, x):
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.groups = groups
+        self.dilation = dilation
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *kernel_size],
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True, attr=bias_attr))
+
+    def forward(self, x):
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation)
+
+
+class _InstanceNormND(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        self.num_features = num_features
+        self.epsilon = epsilon
+        self.weight = (None if weight_attr is False else
+                       self.create_parameter(
+                           [num_features],
+                           default_initializer=lambda s, d: __import__(
+                               "jax.numpy", fromlist=["ones"]).ones(s, d)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon)
+
+
+class InstanceNorm1D(_InstanceNormND):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormND):
+    pass
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-norm layer: normalizes a given weight tensor
+    (paddle.nn.SpectralNorm; the power-iteration vectors are buffers)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12):
+        super().__init__()
+        import numpy as np
+
+        import jax.numpy as jnp
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        rng = np.random.default_rng(0)
+        self.register_buffer("weight_u", Tensor(jnp.asarray(
+            rng.normal(size=(h,)).astype(np.float32))))
+        self.register_buffer("weight_v", Tensor(jnp.asarray(
+            rng.normal(size=(w,)).astype(np.float32))))
+
+    def forward(self, weight):
+        return F.spectral_norm(weight, self.weight_u, self.weight_v,
+                               dim=self.dim, power_iters=self.power_iters,
+                               eps=self.epsilon)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_features], is_bias=True, attr=bias_attr))
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# ---------------------------------------------------------------------------
+# loss layers
+# ---------------------------------------------------------------------------
+
+def _loss_layer(name, fn_name, arg_names=(), defaults=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        vals = dict(zip(arg_names, defaults))
+        vals.update(dict(zip(arg_names, args)))
+        vals.update({k: v for k, v in kwargs.items() if k in arg_names})
+        for k, v in vals.items():
+            setattr(self, k, v)
+        self._argnames = arg_names
+
+    def forward(self, *inputs):
+        kw = {k: getattr(self, k) for k in self._argnames}
+        return getattr(F, fn_name)(*inputs, **kw)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward,
+                                 "__doc__": f"paddle.nn.{name} analog over "
+                                            f"F.{fn_name}."})
+
+
+CTCLoss = _loss_layer("CTCLoss", "ctc_loss", ("blank", "reduction"),
+                      (0, "mean"))
+RNNTLoss = _loss_layer("RNNTLoss", "rnnt_loss",
+                       ("blank", "fastemit_lambda", "reduction"),
+                       (0, 0.0, "mean"))
+PoissonNLLLoss = _loss_layer("PoissonNLLLoss", "poisson_nll_loss",
+                             ("log_input", "full", "epsilon", "reduction"),
+                             (True, False, 1e-8, "mean"))
+GaussianNLLLoss = _loss_layer("GaussianNLLLoss", "gaussian_nll_loss",
+                              ("full", "epsilon", "reduction"),
+                              (False, 1e-6, "mean"))
+MultiLabelSoftMarginLoss = _loss_layer(
+    "MultiLabelSoftMarginLoss", "multi_label_soft_margin_loss",
+    ("weight", "reduction"), (None, "mean"))
+HingeEmbeddingLoss = _loss_layer("HingeEmbeddingLoss",
+                                 "hinge_embedding_loss",
+                                 ("margin", "reduction"), (1.0, "mean"))
+CosineEmbeddingLoss = _loss_layer("CosineEmbeddingLoss",
+                                  "cosine_embedding_loss",
+                                  ("margin", "reduction"), (0.0, "mean"))
+MultiMarginLoss = _loss_layer("MultiMarginLoss", "multi_margin_loss",
+                              ("p", "margin", "weight", "reduction"),
+                              (1, 1.0, None, "mean"))
+TripletMarginLoss = _loss_layer("TripletMarginLoss", "triplet_margin_loss",
+                                ("margin", "p", "epsilon", "swap",
+                                 "reduction"),
+                                (1.0, 2.0, 1e-6, False, "mean"))
+TripletMarginWithDistanceLoss = _loss_layer(
+    "TripletMarginWithDistanceLoss", "triplet_margin_with_distance_loss",
+    ("distance_function", "margin", "swap", "reduction"),
+    (None, 1.0, False, "mean"))
+SoftMarginLoss = _loss_layer("SoftMarginLoss", "soft_margin_loss",
+                             ("reduction",), ("mean",))
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], is_bias=True, attr=bias_attr))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+# ---------------------------------------------------------------------------
+# RNN extras + seq2seq decoding
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    """Base for user RNN cells (paddle.nn.RNNCellBase): subclasses
+    implement forward(inputs, states) -> (outputs, new_states)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        import jax.numpy as jnp
+        B = batch_ref.shape[batch_dim_idx]
+        shape = shape or (getattr(self, "hidden_size"),)
+        return Tensor(jnp.full((B,) + tuple(shape), init_value,
+                               jnp.dtype(dtype)))
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from paddle_tpu.nn.rnn import RNN
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states = initial_states or (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, states[0])
+        out_bw, st_bw = self.rnn_bw(inputs, states[1])
+        cat_axis = -1
+        return paddle.concat([out_fw, out_bw], axis=cat_axis), (st_fw, st_bw)
+
+
+class BeamSearchDecoder:
+    """Cell-level beam decoder surface (paddle.nn.BeamSearchDecoder).
+
+    Wraps an RNN cell + output layer; ``dynamic_decode`` drives it. This
+    TPU-native version scores with log-softmax and tracks (B, beam)
+    hypotheses exactly like nn.generation.beam_search, reusing gather_tree
+    for the backtrace."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   **kwargs):
+    """Greedy-over-beams cell decoding loop (paddle.nn.dynamic_decode).
+
+    Returns (ids (B, beam, T), final scores (B, beam))."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cell = decoder.cell
+    K = decoder.beam_size
+    state = inits
+    if isinstance(state, Tensor):
+        B = state.shape[0]
+    else:
+        B = state[0].shape[0] if state else 1
+    tok = np.full((B * K,), decoder.start_token, np.int64)
+    # tile states beam-wise
+    def tile(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            return type(s)(tile(v) for v in s)
+        return paddle.repeat_interleave(s, K, axis=0)
+
+    state = tile(state)
+    scores = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, -jnp.inf)
+    scores = jnp.broadcast_to(scores, (B, K))
+    steps_t, steps_p = [], []
+    for _ in range(max_step_num):
+        emb = (decoder.embedding_fn(paddle.to_tensor(tok))
+               if decoder.embedding_fn else
+               paddle.to_tensor(np.eye(int(getattr(cell, "input_size", 8)),
+                                       dtype=np.float32)[tok % 8]))
+        out, state = cell(emb, state)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        logp = jax.nn.log_softmax(
+            logits.value.astype(jnp.float32), -1).reshape(B, K, -1)
+        V = logp.shape[-1]
+        cand = (scores[..., None] + logp).reshape(B, K * V)
+        scores, top = jax.lax.top_k(cand, K)
+        parent = top // V
+        tok_jnp = top % V
+        steps_t.append(tok_jnp)
+        steps_p.append(parent)
+        tok = np.asarray(tok_jnp).reshape(-1).astype(np.int64)
+
+        def reorder(s):
+            if s is None:
+                return None
+            if isinstance(s, (tuple, list)):
+                return type(s)(reorder(v) for v in s)
+            v = s.value.reshape((B, K) + s.value.shape[1:])
+            v = jnp.take_along_axis(
+                v, np.asarray(parent).reshape(
+                    (B, K) + (1,) * (v.ndim - 2)), axis=1)
+            return Tensor(v.reshape((B * K,) + v.shape[2:]))
+
+        state = reorder(state)
+    full = paddle.gather_tree(paddle.to_tensor(jnp.stack(steps_t)),
+                              paddle.to_tensor(jnp.stack(steps_p)))
+    ids = jnp.moveaxis(full.value, 0, -1)          # (B, K, T)
+    return Tensor(ids), Tensor(scores)
