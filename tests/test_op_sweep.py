@@ -494,6 +494,41 @@ SPECS = {
                           f(15), f(15)], grad=[0, 2, 3], sel=0),
     "rnn_scan_lstm": spec([f(2, 3, 4), f(2, 5), f(2, 5), f(20, 4), f(20, 5),
                            f(20), f(20)], grad=[0, 3, 4], sel=0),
+    # ---- round-2 pool/loss family (functional_extra) ----
+    "thresholded_relu": spec([f(2, 3)], kw=dict(threshold=0.55), grad=[0]),
+    "fold": spec([f(1, 4, 4)], kw=dict(output_sizes=4, kernel_sizes=2,
+                                       strides=2), grad=[0]),
+    "max_unpool1d": spec([f(1, 2, 3), ii(1, 2, 3, lo=0, hi=6)],
+                         kw=dict(kernel_size=2), grad=[0]),
+    "max_unpool2d": spec([f(1, 2, 2, 2), ii(1, 2, 2, 2, lo=0, hi=16)],
+                         kw=dict(kernel_size=2), grad=[0]),
+    "max_unpool3d": spec([f(1, 1, 2, 2, 2), ii(1, 1, 2, 2, 2, lo=0, hi=64)],
+                         kw=dict(kernel_size=2), grad=[0]),
+    "adaptive_avg_pool3d": spec([f(1, 2, 4, 4, 4)], kw=dict(output_size=2),
+                                grad=[0]),
+    "adaptive_max_pool1d": spec([f(1, 2, 6)], kw=dict(output_size=3),
+                                grad=[0]),
+    "adaptive_max_pool3d": spec([f(1, 2, 4, 4, 4)], kw=dict(output_size=2),
+                                grad=[0]),
+    "fractional_max_pool2d": spec([f(1, 2, 6, 6)],
+                                  kw=dict(output_size=3, random_u=0.4),
+                                  grad=[0]),
+    "fractional_max_pool3d": spec([f(1, 2, 4, 4, 4)],
+                                  kw=dict(output_size=2, random_u=0.4),
+                                  grad=[0]),
+    "bilinear": spec([f(2, 3), f(2, 4), f(2, 3, 4)], grad=[0, 1, 2]),
+    "spectral_norm_op": spec([f(3, 4), f(3), f(4)], grad=[0], rtol=3e-2,
+                             atol=3e-3),
+    "poisson_nll_loss": spec([f(2, 3), f(2, 3)], grad=[0, 1]),
+    "gaussian_nll_loss": spec([f(2, 3), f(2, 3), f(2, 3, lo=0.5)],
+                              grad=[0, 1, 2]),
+    "multi_margin_loss": spec([f(2, 4), ii(2, lo=0, hi=4)], grad=[0]),
+    "triplet_margin_with_distance_loss": spec([f(2, 3), f(2, 3), f(2, 3)],
+                                              grad=[0, 1, 2]),
+    "hsigmoid_loss": spec([f(2, 4), ii(2, lo=0, hi=6), S(6), f(5, 4)],
+                          grad=[0, 1]),
+    "rnnt_loss": spec([f(1, 3, 3, 4), ii(1, 2, lo=1, hi=4),
+                       ii(1, lo=3, hi=4), ii(1, lo=2, hi=3)], grad=[0]),
 }
 
 # randomness ops: forward-shape check only, with an explicit PRNG key
