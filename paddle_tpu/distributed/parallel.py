@@ -40,6 +40,14 @@ def init_parallel_env(mesh_shape=None, dim_names=None) -> "ParallelEnv":
     nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if coord and nproc > 1 and not _INITIALIZED:
+        # CPU-mesh testing: per-process virtual device count must be set
+        # via jax config BEFORE the backend initializes (XLA_FLAGS'
+        # force_host_platform_device_count is ignored once jax.distributed
+        # owns backend creation).
+        ncpu = os.environ.get("PADDLE_NUM_CPU_DEVICES")
+        if ncpu:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", int(ncpu))
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
     if mesh_shape is not None:

@@ -42,14 +42,27 @@ class Generator:
 
     def __init__(self, seed_: int = 0):
         self._seed = seed_
-        self._key = jax.random.key(seed_)
+        # lazy: materializing a key initializes the jax backend, and the
+        # module-level default Generator must not pin the backend at import
+        # time (multi-host jax.distributed.initialize comes after import)
+        self._key_ = None
         self._draws = 0
         self._lock = threading.Lock()
+
+    @property
+    def _key(self):
+        if self._key_ is None:
+            self._key_ = jax.random.key(self._seed)
+        return self._key_
+
+    @_key.setter
+    def _key(self, k):
+        self._key_ = k
 
     def manual_seed(self, seed_: int) -> "Generator":
         with self._lock:
             self._seed = seed_
-            self._key = jax.random.key(seed_)
+            self._key_ = None
             self._draws = 0
         return self
 

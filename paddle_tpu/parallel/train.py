@@ -88,6 +88,9 @@ class ShardedTrainer:
         self.amp_dtype = amp_dtype
         self.data_spec = data_spec if data_spec is not None else sharded_data_spec(mesh)
         self._step = None
+        self._multi_step = None
+        self._lr_cache = None
+        self._seed_dev = None
 
         state = dict(model.state_dict())
         for name, b in model.named_buffers():
@@ -143,12 +146,18 @@ class ShardedTrainer:
         return named_sharding(self.mesh, new, ndim=p.ndim) if new else None
 
     # -- compiled step ------------------------------------------------------
-    def _build(self, n_batch: int):
+    def _single_step_fn(self, n_batch: int):
+        """The pure (params, buffers, opt_state, lr, seed, *batch) ->
+        (params', opt_state', loss, seed') step body, shared by the
+        one-step and K-step executables."""
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         state_names, trainable = self.state_names, self.trainable
         wd = getattr(opt, "_weight_decay", 0.0) or 0.0
 
         def step(params, buffers, opt_state, lr, seed, *batch):
+            # seed is a DEVICE-resident counter (donated, bumped in-graph):
+            # no per-step host->device scalar transfer, which costs a
+            # blocking RPC round-trip on tunneled/remote runtimes
             def compute_loss(train_params):
                 full = dict(buffers)
                 full.update(train_params)
@@ -164,7 +173,9 @@ class ShardedTrainer:
                     # randomness every executed step instead of baking the
                     # trace-time key in as a constant (mpu/random.py
                     # RNGStatesTracker analog)
-                    rnd.push_trace_key(jax.random.key(seed))
+                    from paddle_tpu.flags import flags as _flags
+                    rnd.push_trace_key(
+                        jax.random.key(seed, impl=_flags.train_rng_impl))
                     try:
                         for n, t in state.items():
                             if n in full:
@@ -192,8 +203,13 @@ class ShardedTrainer:
                 new_p, new_st = opt.update(g, st, p, lr, wd)
                 new_params[name] = new_p
                 new_opt[name] = new_st
-            return new_params, new_opt, loss
+            return new_params, new_opt, loss, seed + 1
 
+        return step
+
+    def _build(self, n_batch: int):
+        step = self._single_step_fn(n_batch)
+        trainable, state_names = self.trainable, self.state_names
         in_shardings = (
             {n: self.shardings[n] for n in trainable},
             {n: self.shardings[n] for n in state_names if n not in trainable},
@@ -206,28 +222,132 @@ class ShardedTrainer:
             {n: self.shardings[n] for n in trainable},
             self.opt_shardings,
             NamedSharding(self.mesh.jax_mesh, P()),
+            NamedSharding(self.mesh.jax_mesh, P()),
         )
         if self.pass_rules:
             from paddle_tpu.passes.rewrite import rewrite as _rewrite
             step = _rewrite(step, self.pass_rules)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
-                       donate_argnums=(0, 2))
+                       donate_argnums=(0, 2, 4))
+
+    def _build_multi(self, n_batch: int):
+        """K steps per dispatch: a lax.scan over the single-step body with
+        per-step batch slices. One executable run amortizes the host
+        dispatch / runtime-RPC cost over K steps (on remote/tunneled
+        runtimes each execute costs a round-trip; sustained training
+        should not pay it per step)."""
+        import jax.lax as lax
+
+        single = self._single_step_fn(n_batch)
+
+        def multi(params, buffers, opt_state, lr, seed, *batches):
+            def body(carry, xs):
+                p, o, s = carry
+                new_p, new_o, loss, s2 = single(p, buffers, o, lr, s, *xs)
+                return (new_p, new_o, s2), loss
+
+            (p, o, s), losses = lax.scan(
+                body, (params, opt_state, seed), tuple(batches))
+            return p, o, losses, s
+
+        rep = NamedSharding(self.mesh.jax_mesh, P())
+        data = NamedSharding(self.mesh.jax_mesh,
+                             P(None, *self.data_spec))
+        in_shardings = (
+            {n: self.shardings[n] for n in self.trainable},
+            {n: self.shardings[n] for n in self.state_names
+             if n not in self.trainable},
+            self.opt_shardings, rep, rep,
+        ) + (data,) * n_batch
+        out_shardings = (
+            {n: self.shardings[n] for n in self.trainable},
+            self.opt_shardings, rep, rep,
+        )
+        if self.pass_rules:
+            from paddle_tpu.passes.rewrite import rewrite as _rewrite
+            multi = _rewrite(multi, self.pass_rules)
+        return jax.jit(multi, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 2, 4))
+
+    def train_steps(self, *stacked_batch) -> Tensor:
+        """Run K steps in ONE compiled dispatch. Each input is stacked
+        (K, ...): slice k feeds step k. Returns the (K,) per-step losses.
+        Model params / optimizer state advance K steps in place."""
+        vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in stacked_batch]
+        data = NamedSharding(self.mesh.jax_mesh, P(None, *self.data_spec))
+
+        def put(v):
+            # same per-host contract as _put_batch: multi-process callers
+            # pass their LOCAL (K, local_batch, ...) slice
+            if isinstance(v, jax.Array) and v.sharding == data:
+                return v
+            if jax.process_count() > 1:
+                import numpy as np
+                return jax.make_array_from_process_local_data(
+                    data, np.asarray(v))
+            return jax.device_put(v, data)
+
+        vals = [put(v) for v in vals]
+        K = vals[0].shape[0]
+        if self._multi_step is None:
+            self._multi_step = self._build_multi(len(vals))
+        params = {n: self._tensors[n]._value for n in self.trainable}
+        buffers = {n: self._tensors[n]._value for n in self.state_names
+                   if n not in self.trainable}
+        lr, seed = self._scalars()
+        new_params, new_opt, losses, self._seed_dev = self._multi_step(
+            params, buffers, self.opt_state, lr, seed, *vals)
+        for n in self.trainable:
+            self._tensors[n]._set_value(new_params[n])
+        self.opt_state = new_opt
+        self.optimizer._step_count += K
+        return Tensor(losses)
+
+    def _put_batch(self, v):
+        """Host batch -> global sharded array. Multi-process: `v` is this
+        process's LOCAL batch shard (per-host data feeding, the reference's
+        per-rank DataLoader semantics); the global array is assembled from
+        every process's local slice. Single-process: `v` is the global batch.
+        Arrays already carrying the target sharding pass through untouched
+        (no per-step device_put RPC)."""
+        sh = NamedSharding(self.mesh.jax_mesh, self.data_spec)
+        if isinstance(v, jax.Array) and v.sharding == sh:
+            return v
+        if jax.process_count() > 1:
+            import numpy as np
+            return jax.make_array_from_process_local_data(sh, np.asarray(v))
+        return jax.device_put(v, sh)
+
+    def _scalars(self):
+        """Device-resident lr + RNG-seed counter. lr is re-transferred only
+        when its host value changes; the seed lives on device for good
+        (bumped inside the compiled step, donated back in)."""
+        lr_host = float(self.optimizer.get_lr())
+        if self._lr_cache is None or self._lr_cache[0] != lr_host:
+            rep = NamedSharding(self.mesh.jax_mesh, P())
+            self._lr_cache = (lr_host,
+                              jax.device_put(jnp.float32(lr_host), rep))
+        if self._seed_dev is None:
+            rep = NamedSharding(self.mesh.jax_mesh, P())
+            self._seed_dev = jax.device_put(
+                jnp.uint32(self.optimizer._step_count), rep)
+        return self._lr_cache[1], self._seed_dev
 
     def train_step(self, *batch) -> Tensor:
         """Run one step; updates model params + optimizer state in place."""
         vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
-        vals = [jax.device_put(v, NamedSharding(self.mesh.jax_mesh, self.data_spec))
-                for v in vals]
+        vals = [self._put_batch(v) for v in vals]
         if self._step is None:
             self._step = self._build(len(vals))
         params = {n: self._tensors[n]._value for n in self.trainable}
         buffers = {n: self._tensors[n]._value for n in self.state_names
                    if n not in self.trainable}
-        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
-        seed = jnp.asarray(self.optimizer._step_count, dtype=jnp.uint32)
-        new_params, new_opt, loss = self._step(params, buffers, self.opt_state,
-                                               lr, seed, *vals)
+        lr, seed = self._scalars()
+        new_params, new_opt, loss, self._seed_dev = self._step(
+            params, buffers, self.opt_state, lr, seed, *vals)
         for n in self.trainable:
             self._tensors[n]._set_value(new_params[n])
         self.opt_state = new_opt

@@ -1,0 +1,121 @@
+"""Rank worker for test_multiprocess.py: N processes jointly execute one
+SPMD training program over a global CPU mesh.
+
+Launched with PADDLE_MASTER / PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_NUM_CPU_DEVICES env (the same contract paddle_tpu.distributed.launch
+sets); the capability proven is the reference's multi-rank parity harness
+(reference test/legacy_test/test_dist_base.py:952).
+
+Writes {outdir}/losses_r{rank}.json with the per-step losses (pre-save,
+post-restore) so the parent can check cross-rank agreement and parity with
+a single-process 8-device run of the identical program.
+"""
+
+import json
+import os
+import sys
+
+
+def build(paddle, mesh):
+    """Deterministic tiny TP model: column-parallel fc1, row-parallel fc2
+    (Megatron split over the 'mp' axis), dp-sharded batch."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.parallel import Replicate, Shard
+
+    paddle.seed(0)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 16)
+            self.head = nn.Linear(16, 4)
+
+        def forward(self, x):
+            h = F.gelu(self.fc1(x))
+            h = self.fc2(h)
+            return self.head(h)
+
+    model = MLP()
+    plan = {
+        "fc1.weight": [Replicate(), Shard(1)],
+        "fc1.bias": [Replicate(), Shard(0)],
+        # 2-D sharded: rows over dp (the cross-process axis) x cols over mp
+        # — its checkpoint shards land in BOTH processes' files
+        "fc2.weight": [Shard(0), Shard(1)],
+    }
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        logits = m(x)
+        return F.cross_entropy(logits, y)
+
+    return model, opt, loss_fn, plan
+
+
+def batches(step, dp_rank=None, dp_degree=1):
+    """Deterministic global batch for `step`; a dp-rank slice if asked
+    (per-host data feeding: each process feeds only its rows)."""
+    import numpy as np
+
+    rng = np.random.default_rng(100 + step)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (8,)).astype(np.int64)
+    if dp_rank is not None:
+        n = 8 // dp_degree
+        x = x[dp_rank * n:(dp_rank + 1) * n]
+        y = y[dp_rank * n:(dp_rank + 1) * n]
+    return x, y
+
+
+def run(outdir, per_host: bool):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import init_parallel_env
+    init_parallel_env()
+    import jax
+
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = jax.process_index()
+    assert jax.process_count() == nprocs, (jax.process_count(), nprocs)
+    assert jax.device_count() == 8, jax.device_count()
+
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    mesh = init_mesh((2, 4), ("dp", "mp"))
+    model, opt, loss_fn, plan = build(paddle, mesh)
+    trainer = ShardedTrainer(model, opt, loss_fn, mesh, plan)
+
+    dp_rank = rank if per_host else None  # dp row r lives on process r
+    losses = []
+    with mesh:
+        for step in range(4):
+            x, y = batches(step, dp_rank, dp_degree=2 if per_host else 1)
+            losses.append(float(trainer.train_step(x, y).numpy()))
+
+        ckpt = os.path.join(outdir, "ckpt")
+        trainer.save(ckpt)
+
+        # fresh trainer (fresh init), restore, one more step: resumes the
+        # exact trajectory
+        paddle.seed(1)
+        model2, opt2, loss_fn2, plan2 = build(paddle, mesh)
+        trainer2 = ShardedTrainer(model2, opt2, loss_fn2, mesh, plan2)
+        trainer2.load(ckpt)
+        x, y = batches(4, dp_rank, dp_degree=2 if per_host else 1)
+        post = float(trainer2.train_step(x, y).numpy())
+
+    out = {"losses": losses, "post_restore": post}
+    with open(os.path.join(outdir, f"losses_r{rank}.json"), "w") as f:
+        json.dump(out, f)
+    return out
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    run(sys.argv[1], per_host=True)
+    print("mp_worker ok", flush=True)

@@ -1,0 +1,135 @@
+"""Multi-process (multi-controller) execution: 2 jax.distributed ranks, one
+global 8-device CPU mesh, jointly running the SAME compiled SPMD training
+program — rendezvous, cross-process collectives (Gloo), per-host data
+feeding, sharded checkpoint save/restore, and loss parity with a
+single-process 8-device run.
+
+Reference bar: test/legacy_test/test_dist_base.py:952 (multi-rank parity
+harness) + distributed/parallel.py:943 (init path).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_ranks(tmp_path, nprocs=2, ncpu_per_proc=4, timeout=420):
+    port = _free_port()
+    procs = []
+    for r in range(nprocs):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_TRAINER_ID": str(r),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_NUM_CPU_DEVICES": str(ncpu_per_proc),
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "mp_worker.py"),
+             str(tmp_path)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out[-4000:]}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_training_parity(tmp_path):
+    """2 ranks x 4 devices == 1 process x 8 devices, to the last detail the
+    program defines: same losses, same post-restore loss."""
+    _spawn_ranks(tmp_path)
+
+    results = []
+    for r in range(2):
+        with open(tmp_path / f"losses_r{r}.json") as f:
+            results.append(json.load(f))
+    # both ranks observe the same (replicated) losses
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-5)
+    assert np.isclose(results[0]["post_restore"], results[1]["post_restore"],
+                      rtol=1e-5)
+
+    # single-process reference: identical program on this process's
+    # 8-device mesh, global-batch feeding
+    import mp_worker
+    ref = mp_worker.run(str(tmp_path / "ref"), per_host=False)
+
+    np.testing.assert_allclose(results[0]["losses"], ref["losses"],
+                               rtol=5e-4, atol=1e-5)
+    assert np.isclose(results[0]["post_restore"], ref["post_restore"],
+                      rtol=5e-4, atol=1e-5)
+
+    # sharded checkpoint: each slice stored exactly once across ranks
+    # (disk ~= 1x model size, not N_ranks x)
+    with open(tmp_path / "ckpt" / "metadata.json") as f:
+        meta = json.load(f)["tensors"]
+    for name, entry in meta.items():
+        total = sum(int(np.prod(st["shape"])) if st["shape"] else 1
+                    for st in entry["storage"])
+        want = int(np.prod(entry["shape"])) if entry["shape"] else 1
+        assert total == want, (name, total, want)
+    # and the dp x mp 2-D-sharded fc2.weight really is split across BOTH
+    # rank files (each process wrote only its addressable slices)
+    files = {st["file"] for st in meta["model.fc2.weight"]["storage"]}
+    assert files == {"data_r0.npz", "data_r1.npz"}, files
+
+
+@pytest.mark.slow
+def test_two_process_cross_topology_restore(tmp_path):
+    """Save from 2-process dp2xmp4; restore into THIS single process with a
+    different topology (mp8) — the read plan reassembles slices."""
+    _spawn_ranks(tmp_path)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+    import mp_worker
+
+    mesh = init_mesh((1, 8), ("dp", "mp"))
+    model, opt, loss_fn, plan = mp_worker.build(paddle, mesh)
+    trainer = ShardedTrainer(model, opt, loss_fn, mesh, plan)
+    trainer.load(str(tmp_path / "ckpt"))
+
+    # the restored fc1.weight must equal the global value the 2-proc run
+    # saved: reassemble it directly from the checkpoint for comparison
+    target = {"model.fc1.weight": Tensor(np.zeros((16, 32), np.float32))}
+    ckpt.load_state_dict(target, str(tmp_path / "ckpt"))
+    got = np.asarray(model.fc1.weight.value)
+    np.testing.assert_allclose(got, np.asarray(
+        target["model.fc1.weight"].value), rtol=0, atol=0)
+
+    # and training continues finite from the restored state
+    x, y = mp_worker.batches(4)
+    with mesh:
+        loss = trainer.train_step(x, y)
+    assert np.isfinite(float(loss.numpy()))
