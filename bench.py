@@ -45,17 +45,28 @@ def _peak_flops(jax) -> float:
     return 1e12
 
 
-def _measure(step_fn, fence, steps: int, trials: int = 3) -> float:
-    """Median-free protocol: best mean-over-steps across trials (the tunnel
-    adds run-level noise; best-of-trials is the stable statistic)."""
-    fence(step_fn())  # compile + warm
+def _measure_steps(trainer, arrays, steps: int, trials: int = 3) -> float:
+    """Per-step time with K steps per dispatch (ShardedTrainer.train_steps):
+    one executable runs `steps` scan iterations, so the per-execute
+    runtime-RPC round-trip (~10-14 ms through the tunnel) is amortized the
+    way sustained training amortizes it. Batch is tiled K times and
+    pre-placed on device (protocol: input H2D excluded)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(trainer.mesh.jax_mesh, P(None, *trainer.data_spec))
+    stacked = [jax.device_put(jnp.stack([jnp.asarray(a)] * steps), sh)
+               for a in arrays]
+    losses = trainer.train_steps(*stacked)  # compile + warm
+    float(np.asarray(losses.value)[-1])
     best = float("inf")
     for _ in range(trials):
         t0 = time.perf_counter()
-        out = None
-        for _ in range(steps):
-            out = step_fn()
-        fence(out)
+        losses = trainer.train_steps(*stacked)
+        float(np.asarray(losses.value)[-1])
         best = min(best, (time.perf_counter() - t0) / steps)
     return best
 
@@ -74,19 +85,6 @@ def _emit(metric: str, value: float, unit: str) -> dict:
             "vs_baseline": vs}
     print(json.dumps(line))
     return line
-
-
-def _device_batch(trainer, *arrays):
-    """Pre-place the batch on device with the trainer's data sharding so the
-    timed loop measures compute, not host->device tunnel transfers (the
-    driver's TPU is behind a network tunnel; a 38MB ResNet batch per step
-    would otherwise dominate). train_step's own device_put is then a no-op."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding
-
-    sh = NamedSharding(trainer.mesh.jax_mesh, trainer.data_spec)
-    return [jax.device_put(jnp.asarray(a), sh) for a in arrays]
 
 
 def _trainer_for(model, loss_fn, lr=1e-4, opt_name="adamw", amp=True):
@@ -150,9 +148,7 @@ def bench_llama(profile=False):
     # host fetch does. TPU executes FIFO, so fetching the last loss fences
     # the whole timed window.
     with mesh:
-        ids, labels = _device_batch(trainer, ids, labels)
-        step_time = _measure(lambda: trainer.train_step(ids, labels),
-                             lambda t: float(np.asarray(t.value)), steps)
+        step_time = _measure_steps(trainer, (ids, labels), steps)
 
     tokens_per_sec = B * S / step_time
     flops = model.flops_per_token(S) * B * S
@@ -270,9 +266,7 @@ def bench_resnet50():
     x = rng.normal(size=(B, 3, side, side)).astype(np.float32)
     y = rng.integers(0, 1000, (B,))
     with mesh:
-        x, y = _device_batch(trainer, x, y)
-        step_time = _measure(lambda: trainer.train_step(x, y),
-                             lambda t: float(np.asarray(t.value)), steps)
+        step_time = _measure_steps(trainer, (x, y), steps)
     ips = B / step_time
     # ~4.1 GF inference FLOPs per 224x224 image; x3 for fwd+bwd
     mfu = (12.3e9 * B / step_time) / _peak_flops(jax) * 100
@@ -289,17 +283,22 @@ def bench_bert():
 
     cfg = BertConfig(dropout=0.0)  # BERT-base
     model = BertForMaskedLM(cfg)
+    # pure-bf16 params (the flagship llama/ernie protocol) rather than
+    # f32-master AMP: the per-op f32->bf16 weight casts cost ~15% step time
+    import jax as _jax
+    if _jax.devices()[0].platform == "tpu":
+        import jax.numpy as jnp
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
     trainer, mesh, on_tpu = _trainer_for(
-        model, lambda m, i, l: m.loss(i, l), lr=1e-4)
+        model, lambda m, i, l: m.loss(i, l), lr=1e-4, amp=False)
     B, S = (16, 512) if on_tpu else (2, 64)
     steps = 10 if on_tpu else 2
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (B, S))
     labels = rng.integers(0, cfg.vocab_size, (B, S))
     with mesh:
-        ids, labels = _device_batch(trainer, ids, labels)
-        step_time = _measure(lambda: trainer.train_step(ids, labels),
-                             lambda t: float(np.asarray(t.value)), steps)
+        step_time = _measure_steps(trainer, (ids, labels), steps)
     tps = B * S / step_time
     n = sum(p.size for p in model.parameters())
     mfu = (6 * n * B * S / step_time) / _peak_flops(jax) * 100
@@ -337,9 +336,7 @@ def bench_unet():
     ctx = rng.normal(size=(B, ctx_len, ctx_dim)).astype(np.float32)
     tgt = rng.normal(size=x.shape).astype(np.float32)
     with mesh:
-        x, t, ctx, tgt = _device_batch(trainer, x, t, ctx, tgt)
-        step_time = _measure(lambda: trainer.train_step(x, t, ctx, tgt),
-                             lambda lt: float(np.asarray(lt.value)), steps)
+        step_time = _measure_steps(trainer, (x, t, ctx, tgt), steps)
     n = sum(p.size for p in model.parameters())
     print(f"unet: step={step_time*1e3:.1f}ms params={n/1e6:.0f}M B={B}",
           file=sys.stderr)
@@ -389,9 +386,7 @@ def bench_ernie():
     ids = rng.integers(0, cfg.vocab_size, (B, S))
     labels = rng.integers(0, cfg.vocab_size, (B, S))
     with mesh:
-        ids, labels = _device_batch(trainer, ids, labels)
-        step_time = _measure(lambda: trainer.train_step(ids, labels),
-                             lambda t: float(np.asarray(t.value)), steps)
+        step_time = _measure_steps(trainer, (ids, labels), steps)
     tps = B * S / step_time
     n = sum(p.size for p in model.parameters())
     mfu = (6 * n * B * S / step_time) / _peak_flops(jax) * 100

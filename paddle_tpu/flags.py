@@ -130,18 +130,28 @@ define_flag("use_fused_rope", False,
             "route rotary embedding through the fused Pallas kernel; off by "
             "default (XLA fuses rope into neighbors at train shapes: 67.2 -> "
             "73.9 ms/step on the 134M Llama when forced on; see BASELINE.md)")
-define_flag("flash_attention_min_seq", 1024,
+define_flag("flash_attention_min_seq", 512,
             "min KV seq length to route through the Pallas flash kernel "
-            "(below this XLA's fused sdpa wins; at/above it the adaptive "
-            "single-block/512-block schedule wins — measured on v5e: "
-            "S=512 sdpa 3.6ms vs flash 4.5ms, S=1024 sdpa 9.8ms vs "
-            "flash 6.8ms fwd+bwd per layer, and sdpa OOMs at S=2048)")
+            "(below this XLA's fused sdpa wins — measured end-to-end on "
+            "v5e round 3: BERT-base B=16 S=512 train step 83.2 ms with "
+            "sdpa vs 75.4 ms with flash; S=1024 flash fwd 0.37 ms vs sdpa "
+            "1.20 ms per layer, and sdpa OOMs at S=2048)")
 define_flag("use_fused_lm_ce", True,
             "route large-vocab LM losses through the chunked-vocab fused "
             "head+CE (ops/fused_ce.py) instead of materializing (T, V) "
             "logits")
 define_flag("use_ring_attention", True,
             "use ring (context-parallel) attention when the mesh has a sep>1 axis")
+define_flag("fused_ce_logits_budget_mb", 1536,
+            "transient f32 logits budget (MB) for the chunked fused "
+            "lm-head CE; the vocab chunk is the largest multiple of 1024 "
+            "whose (tokens, chunk) f32 block fits")
+define_flag("train_rng_impl", "rbg",
+            "PRNG implementation for the per-step traced key in compiled "
+            "training steps (dropout & co.). 'rbg' uses the TPU hardware "
+            "RNG path — threefry mask generation alone cost ~36 ms/step on "
+            "the 183M-param dropout-0.1 GPT config (v5e); 'threefry2x32' "
+            "restores the jax default (cross-backend reproducible streams)")
 define_flag("default_dtype", "float32", "default floating point dtype")
 define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
 define_flag("profiler_dir", "", "directory for profiler trace output")

@@ -66,14 +66,28 @@ class BertForMaskedLM(nn.Layer):
         self.transform_ln = nn.LayerNorm(config.hidden_size,
                                          epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def hidden_states(self, input_ids, token_type_ids=None,
+                      attention_mask=None):
         h = self.embeddings(input_ids, token_type_ids)
         h = self.encoder(h, src_mask=attention_mask)
-        h = self.transform_ln(F.gelu(self.transform(h)))
+        return self.transform_ln(F.gelu(self.transform(h)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.hidden_states(input_ids, token_type_ids, attention_mask)
         return paddle.matmul(h, self.embeddings.word_embeddings.weight.t())
 
     def loss(self, input_ids, labels, ignore_index: int = -100, **kw):
+        from paddle_tpu.flags import flags
+        V = self.config.vocab_size
+        if flags.use_fused_lm_ce and V >= 4096:
+            # chunked-vocab fused head+CE (ops/fused_ce.py): the (T, V) MLM
+            # logits are the step's largest activation; never materialize
+            # them. Matches cross_entropy(ignore_index) semantics.
+            from paddle_tpu.ops.fused_ce import fused_lm_loss
+            h = self.hidden_states(input_ids, **kw)
+            return fused_lm_loss(
+                h, self.embeddings.word_embeddings.weight.t(), labels,
+                ignore_index=ignore_index)
         logits = self(input_ids, **kw)
-        V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]),
                                ignore_index=ignore_index)

@@ -73,12 +73,7 @@ class GPTForCausalLM(nn.Layer):
         self.drop = nn.Dropout(config.dropout)
 
     def forward(self, input_ids):
-        S = input_ids.shape[1]
-        pos = paddle.arange(S, dtype="int64").unsqueeze(0)
-        x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for blk in self.h:
-            x = blk(x)
-        x = self.ln_f(x)
+        x = self.hidden_states(input_ids)
         return paddle.matmul(x, self.wte.weight.t())  # tied head
 
     def generate(self, input_ids, max_new_tokens: int = 32,
@@ -97,7 +92,22 @@ class GPTForCausalLM(nn.Layer):
         return generate_tokens(self, input_ids,
                                max_new_tokens=max_new_tokens, **kwargs)
 
+    def hidden_states(self, input_ids):
+        S = input_ids.shape[1]
+        pos = paddle.arange(S, dtype="int64").unsqueeze(0)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.h:
+            x = blk(x)
+        return self.ln_f(x)
+
     def loss(self, input_ids, labels):
+        from paddle_tpu.flags import flags
+        V = self.config.vocab_size
+        if flags.use_fused_lm_ce and V >= 4096:
+            # chunked-vocab fused head+CE (shared routing, ops/fused_ce.py);
+            # the tied head is the transposed embedding
+            from paddle_tpu.ops.fused_ce import fused_lm_loss
+            return fused_lm_loss(self.hidden_states(input_ids),
+                                 self.wte.weight.t(), labels)
         logits = self(input_ids)
-        V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))

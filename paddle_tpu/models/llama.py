@@ -231,14 +231,11 @@ class LlamaForCausalLM(nn.Layer):
         V = self.config.vocab_size
         if flags.use_fused_lm_ce and V >= 4096:
             # chunked-vocab fused head+CE: never materializes the (T, V)
-            # logits (the largest activation of the step — see
-            # ops/fused_ce.py; phi fusion/cross_entropy_with_softmax analog)
-            hidden = self.model(input_ids)
-            B, S, H = hidden.shape
-            from paddle_tpu.ops.registry import op_api
-            return op_api("fused_linear_ce")(
-                hidden.reshape([B * S, H]), self._head_weight(),
-                labels.reshape([-1]), chunk=8192)
+            # logits (the largest activation of the step — shared routing
+            # in ops/fused_ce.py; phi cross_entropy_with_softmax analog)
+            from paddle_tpu.ops.fused_ce import fused_lm_loss
+            return fused_lm_loss(self.model(input_ids),
+                                 self._head_weight(), labels)
         logits = self(input_ids)
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
 

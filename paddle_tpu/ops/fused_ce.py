@@ -42,12 +42,13 @@ def _pad_head(head, V: int, chunk: int):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_linear_cross_entropy(hidden, head, labels, chunk: int = 4096):
-    """mean over tokens of CE(softmax(hidden @ head), labels).
+    """mean over VALID tokens of CE(softmax(hidden @ head), labels).
 
     hidden: (T, H); head: (H, V); labels: (T,) int. Returns a scalar f32.
-    Labels outside [0, V) (e.g. -100 padding) contribute zero loss and
-    zero gradient, with the mean still taken over ALL T tokens — exactly
-    the unfused path's semantics (one_hot of an invalid label is all-zero).
+    Labels outside [0, V) (e.g. -100 ignore padding) contribute zero loss
+    and zero gradient and are excluded from the mean denominator — the
+    F.cross_entropy(ignore_index=...) semantics. Callers with a
+    non-negative ignore_index must map it to -1 before the call.
     """
     loss, _ = _fwd_impl(hidden, head, labels, chunk)
     return loss
@@ -90,7 +91,8 @@ def _fwd_impl(hidden, head, labels, chunk):
         body, (m0, s0, g0), (hchunks, jnp.arange(n)))
     lse = m + jnp.log(s)
     valid = (labels >= 0) & (labels < V)
-    loss = jnp.mean(jnp.where(valid, lse - gold, 0.0))
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    loss = jnp.sum(jnp.where(valid, lse - gold, 0.0)) / denom
     return loss, lse
 
 
@@ -107,7 +109,8 @@ def _bwd(chunk, res, g):
     headp, n, _ = _pad_head(head, V, chunk)
     hchunks = jnp.moveaxis(headp.reshape(H, n, chunk), 1, 0)
     valid = ((labels >= 0) & (labels < V)).astype(jnp.float32)
-    scale = (g / T) * valid  # mean over ALL tokens; ignored rows get 0
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    scale = (g / denom) * valid  # mean over VALID tokens; ignored rows get 0
 
     def body(dh, xs):
         w, idx = xs
@@ -140,8 +143,52 @@ fused_linear_cross_entropy.defvjp(_fwd, _bwd)
 from paddle_tpu.ops.registry import register_op
 
 
+def auto_chunk(T: int, V: int) -> int:
+    """Vocab chunk size bounding the transient f32 logits block.
+
+    One chunk of (T, chunk) f32 logits lives at a time; if the FULL (T, V)
+    block fits the budget, a single chunk (scan of length 1) wins — the
+    scan serialization + per-chunk dW dynamic-update-slices cost more than
+    the extra HBM traffic (v5e, T=8192 V=30522: fwd+bwd 6.9 ms at
+    chunk=8192 vs 4.2 ms single-chunk). Floor: one 128-lane block — at
+    extreme T even that may exceed the budget; the block is the smallest
+    MXU-shaped unit, so the budget is best-effort there."""
+    from paddle_tpu.flags import flags
+    budget = flags.fused_ce_logits_budget_mb * 1e6
+    if T * V * 4 <= budget:
+        return V
+    per = int(budget // (T * 4))
+    return min(V, max(128, (per // 128) * 128))
+
+
+def fused_lm_loss(hidden, head, labels, ignore_index: int = None):
+    """Shared model-side routing for the fused lm-head CE (the single
+    entry the Llama/GPT/BERT loss paths use — one place to tune
+    thresholds/chunking): flattens (..., H) hidden against an (H, V)
+    head, maps a non-negative ignore_index out of range (negative
+    sentinels are already invalid to the kernel), auto-picks the vocab
+    chunk, and dispatches through the op registry so the eager tape
+    records it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.registry import op_api
+
+    T = 1
+    for d in hidden.shape[:-1]:
+        T *= int(d)
+    H = int(hidden.shape[-1])
+    h2 = hidden.reshape([T, H])
+    lab = labels.reshape([-1])
+    if ignore_index is not None and ignore_index >= 0:
+        lab = paddle.where(lab == ignore_index,
+                           paddle.full_like(lab, -1), lab)
+    return op_api("fused_linear_ce")(h2, head, lab,
+                                     chunk=auto_chunk(T, int(head.shape[1])))
+
+
 @register_op("fused_linear_ce",
              ref="paddle/phi/kernels/fusion/ + cross_entropy_with_softmax "
                  "(capability analog)")
-def fused_linear_ce_op(hidden, head, labels, chunk: int = 4096):
+def fused_linear_ce_op(hidden, head, labels, chunk: int = None):
+    if chunk is None:
+        chunk = auto_chunk(hidden.shape[0], head.shape[1])
     return fused_linear_cross_entropy(hidden, head, labels, chunk)
