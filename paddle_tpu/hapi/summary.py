@@ -13,6 +13,20 @@ from paddle_tpu.framework.tensor import Tensor
 __all__ = ["summary", "flops"]
 
 
+def _mode_snapshot(net):
+    """Per-sublayer (module, training) pairs — restoring these instead of
+    a blanket net.train() preserves submodules the user froze with
+    sub.eval() (same pattern as nn.generation._sublayers_with_self)."""
+    from paddle_tpu.nn.generation import _sublayers_with_self
+    return [(m, m.training) for m in _sublayers_with_self(net)
+            if hasattr(m, "training")]
+
+
+def _mode_restore(snap):
+    for m, was in snap:
+        m.training = was
+
+
 def _param_count(sub):
     own = [p for p in sub._parameters.values() if p is not None]
     n = int(sum(int(np.prod(p.shape)) for p in own))
@@ -44,12 +58,13 @@ def summary(net, input_size=None, dtypes=None, input=None):
                     [dtypes or "float32"] * len(sizes)
                 x = [paddle.zeros(list(s), dtype=d)
                      for s, d in zip(sizes, dts)]
-            was_training = net.training
+            snap = _mode_snapshot(net)
             net.eval()
-            with paddle.no_grad():
-                net(*x) if isinstance(x, list) else net(x)
-            if was_training:
-                net.train()
+            try:
+                with paddle.no_grad():
+                    net(*x) if isinstance(x, list) else net(x)
+            finally:
+                _mode_restore(snap)
         finally:
             for h in hooks:
                 h.remove()
@@ -107,7 +122,7 @@ def flops(net, input_size=None, custom_ops=None, print_detail=False,
     names = list(state.keys())
     vals = [state[n]._value for n in names]
 
-    was_training = net.training
+    snap = _mode_snapshot(net)
     net.eval()
     try:
         def fn(param_vals, *xs):
@@ -136,8 +151,7 @@ def flops(net, input_size=None, custom_ops=None, print_detail=False,
                 cost = cost[0]
         total = int(cost.get("flops", 0))
     finally:
-        if was_training:
-            net.train()
+        _mode_restore(snap)
     if print_detail:
         n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
         print(f"Total Flops: {total}     Total Params: {n_params}")

@@ -395,12 +395,33 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode=F
     return lax.reduce_window(x, init, reducer, window, strides, pad_cfg)
 
 
+def _pool_with_index(x, kernel_size, stride, padding, nd, ceil_mode,
+                     data_format):
+    """return_mask branch shared by max_pool1/2/3d: channel-last input is
+    transposed to channel-first for the index kernel (and back), ceil_mode
+    is rejected rather than silently ignored."""
+    if ceil_mode:
+        raise NotImplementedError(
+            "max_pool(return_mask=True) does not support ceil_mode=True")
+    from paddle_tpu.nn.functional_extra import max_pool_with_index
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    if channel_last:
+        fwd = (0, nd + 1) + tuple(range(1, nd + 1))      # to channel-first
+        bwd = (0,) + tuple(range(2, nd + 2)) + (1,)      # back
+        x = jnp.transpose(x, fwd)
+    out, idx = max_pool_with_index(x, kernel_size, stride, padding, nd=nd)
+    if channel_last:
+        out = jnp.transpose(out, bwd)
+        idx = jnp.transpose(idx, bwd)
+    return out, idx
+
+
 @register_op("max_pool1d")
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL"):
     if return_mask:
-        from paddle_tpu.nn.functional_extra import max_pool_with_index
-        return max_pool_with_index(x, kernel_size, stride, padding, nd=1)
+        return _pool_with_index(x, kernel_size, stride, padding, 1,
+                                ceil_mode, data_format)
     return _pool(x, kernel_size, stride, padding, 1, lax.max, -jnp.inf,
                  data_format, ceil_mode)
 
@@ -409,8 +430,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW"):
     if return_mask:
-        from paddle_tpu.nn.functional_extra import max_pool_with_index
-        return max_pool_with_index(x, kernel_size, stride, padding, nd=2)
+        return _pool_with_index(x, kernel_size, stride, padding, 2,
+                                ceil_mode, data_format)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     return _pool(x, kernel_size, stride, padding, 2, lax.max, init,
                  data_format, ceil_mode)
@@ -420,8 +441,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW"):
     if return_mask:
-        from paddle_tpu.nn.functional_extra import max_pool_with_index
-        return max_pool_with_index(x, kernel_size, stride, padding, nd=3)
+        return _pool_with_index(x, kernel_size, stride, padding, 3,
+                                ceil_mode, data_format)
     return _pool(x, kernel_size, stride, padding, 3, lax.max, -jnp.inf,
                  data_format, ceil_mode)
 
@@ -885,7 +906,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             lbl = jnp.squeeze(lbl, axis=axis)
         applied_weight = jnp.take(weight, lbl)
         loss = loss * applied_weight
-    if not soft_label and ignore_index >= 0:
+    if not soft_label:
+        # ignore_index masking applies for ANY sentinel value, including the
+        # default -100 (paddle semantics: ignored tokens contribute no loss
+        # and do not count in the mean denominator). one_hot already zeroes
+        # out-of-range labels; the denominator is the real divergence risk.
         lbl = label
         if lbl.ndim == logp.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis=axis)

@@ -467,6 +467,7 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
     scores = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, -jnp.inf)
     scores = jnp.broadcast_to(scores, (B, K))
     steps_t, steps_p = [], []
+    done = jnp.zeros((B, K), bool)
     for _ in range(max_step_num):
         emb = (decoder.embedding_fn(paddle.to_tensor(tok))
                if decoder.embedding_fn else
@@ -477,10 +478,20 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
         logp = jax.nn.log_softmax(
             logits.value.astype(jnp.float32), -1).reshape(B, K, -1)
         V = logp.shape[-1]
+        # finished hypotheses are frozen: their only continuation is
+        # end_token at 0 logp, so their score stops accumulating (same
+        # masking as nn.generation.beam_search / the reference
+        # BeamSearchDecoder semantics)
+        if decoder.end_token is not None:
+            frozen = jnp.full((V,), -jnp.inf).at[decoder.end_token].set(0.0)
+            logp = jnp.where(done[..., None], frozen[None, None, :], logp)
         cand = (scores[..., None] + logp).reshape(B, K * V)
         scores, top = jax.lax.top_k(cand, K)
         parent = top // V
         tok_jnp = top % V
+        if decoder.end_token is not None:
+            done = jnp.take_along_axis(done, parent, axis=1) \
+                | (tok_jnp == decoder.end_token)
         steps_t.append(tok_jnp)
         steps_p.append(parent)
         tok = np.asarray(tok_jnp).reshape(-1).astype(np.int64)

@@ -391,7 +391,9 @@ def to_model_bytes(layer, example_inputs, opset_version: int = 13) -> bytes:
     from paddle_tpu.nn.utils import functional_call
     from paddle_tpu.passes import decomposition_rules, rewrite_jaxpr
 
-    was_training = getattr(layer, "training", False)
+    from paddle_tpu.nn.generation import _sublayers_with_self
+    mode_snapshot = [(m, m.training) for m in _sublayers_with_self(layer)
+                     if hasattr(m, "training")]
     if hasattr(layer, "eval"):
         layer.eval()
     try:
@@ -416,11 +418,10 @@ def to_model_bytes(layer, example_inputs, opset_version: int = 13) -> bytes:
         closed = rewrite_jaxpr(closed, decomposition_rules(), recurse=False)
         closed = _inline_calls(closed)
     finally:
-        for m, was in ([(layer, was_training)]
-                       if hasattr(layer, "training") else []):
+        # per-sublayer restore (no blanket .train(): it would clobber
+        # submodules the user froze with sub.eval())
+        for m, was in mode_snapshot:
             m.training = was
-        if was_training and hasattr(layer, "train"):
-            layer.train()
 
     g = _Graph()
     jaxpr = closed.jaxpr

@@ -72,11 +72,13 @@ def _nms_mask(boxes, scores, iou_threshold):
     return keep
 
 
-def nms(boxes, scores=None, iou_threshold: float = 0.3,
-        score_threshold: Optional[float] = None, category_idxs=None,
-        categories=None, top_k: Optional[int] = None):
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None, *,
+        score_threshold: Optional[float] = None):
     """Greedy NMS returning kept indices by descending score
-    (python/paddle/vision/ops.py:nms parity, incl. categorical batching)."""
+    (python/paddle/vision/ops.py:nms parity, incl. categorical batching).
+    Positional order matches the reference — nms(boxes, 0.5) binds the
+    iou threshold; score_threshold is a keyword-only extension."""
     bx = boxes if isinstance(boxes, Tensor) else Tensor(jnp.asarray(boxes))
     n = bx.shape[0]
     sc = scores if scores is not None else Tensor(jnp.ones((n,)))
@@ -141,9 +143,16 @@ def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
           * (bin_w / ratio_w)[:, None])                     # (R, ow*ratio_w)
 
     def bilinear(img, ys, xs):
-        """img (C, H, W); ys (P,), xs (Q,) -> (C, P, Q)."""
-        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
-        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        """img (C, H, W); ys (P,), xs (Q,) -> (C, P, Q). Samples with an
+        unclamped coordinate outside [-1, H] / [-1, W] contribute ZERO
+        (reference BilinearInterpolate), not border-replicated values;
+        coordinates in (-1, 0) snap onto the border like the reference."""
+        in_y = (ys >= -1.0) & (ys <= H)
+        in_x = (xs >= -1.0) & (xs <= W)
+        ys = jnp.clip(ys, 0.0, H - 1)          # (-1,0) -> 0, (H-1,H) -> H-1
+        xs = jnp.clip(xs, 0.0, W - 1)
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
         y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
         x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
         y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
@@ -153,10 +162,11 @@ def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
         v01 = img[:, y0i][:, :, x1i]
         v10 = img[:, y1i][:, :, x0i]
         v11 = img[:, y1i][:, :, x1i]
-        return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
-                + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
-                + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
-                + v11 * wy[None, :, None] * wx[None, None, :])
+        out = (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+               + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+               + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+               + v11 * wy[None, :, None] * wx[None, None, :])
+        return out * in_y[None, :, None] * in_x[None, None, :]
 
     def per_roi(r):
         img = x[img_of_roi[r]]

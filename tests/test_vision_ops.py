@@ -41,13 +41,13 @@ def test_box_iou_and_nms_match_reference():
     iou = V.box_iou(paddle.to_tensor(boxes), paddle.to_tensor(boxes)).numpy()
     assert np.allclose(np.diag(iou), 1.0, atol=1e-5)
 
-    kept = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
-                 iou_threshold=0.3).numpy()
+    kept = V.nms(paddle.to_tensor(boxes), 0.3,
+                 scores=paddle.to_tensor(scores)).numpy()
     ref = _ref_nms(boxes, scores, 0.3)
     np.testing.assert_array_equal(kept, ref)
 
-    top = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
-                iou_threshold=0.3, top_k=2).numpy()
+    top = V.nms(paddle.to_tensor(boxes), 0.3,
+                scores=paddle.to_tensor(scores), top_k=2).numpy()
     np.testing.assert_array_equal(top, ref[:2])
 
 
@@ -56,8 +56,9 @@ def test_nms_categorical_keeps_cross_category_overlaps():
     boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
     scores = np.array([0.9, 0.8], np.float32)
     cats = np.array([0, 1])
-    kept = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
-                 iou_threshold=0.5, category_idxs=paddle.to_tensor(cats),
+    kept = V.nms(paddle.to_tensor(boxes), 0.5,
+                 scores=paddle.to_tensor(scores),
+                 category_idxs=paddle.to_tensor(cats),
                  categories=[0, 1]).numpy()
     assert set(kept.tolist()) == {0, 1}
 
