@@ -435,7 +435,71 @@ def bench_decode():
     return _emit("llama_110m_greedy_decode_tokens_per_sec", tps, "tokens/sec")
 
 
+def bench_moe():
+    """MoE LM train step (dropless ragged dispatch, stacked-expert grouped
+    GEMM — incubate/nn/moe.py): tokens/sec on one chip. The reference's
+    MoE tier lives in incubate/distributed/models/moe."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.nn import MoEMLP
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    d, f, E, V = (1024, 4096, 8, 32000) if on_tpu else (32, 64, 4, 256)
+    n_layers = 4 if on_tpu else 2
+
+    class MoEBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.norm = nn.LayerNorm(d)
+            self.moe = MoEMLP(d, f, n_experts=E, top_k=2, dispatch="ragged")
+
+        def forward(self, x):
+            return x + self.moe(self.norm(x))
+
+    class MoELM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(V, d)
+            self.blocks = nn.LayerList([MoEBlock() for _ in range(n_layers)])
+
+        def loss(self, ids, labels):
+            h = self.embed(ids)
+            for b in self.blocks:
+                h = b(h)
+            from paddle_tpu.ops.fused_ce import fused_lm_loss
+            return fused_lm_loss(h, self.embed.weight.t(), labels)
+
+    model = MoELM()
+    if on_tpu:
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
+    mesh = init_mesh((1, 1, 1), ("dp", "sep", "mp"))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    trainer = ShardedTrainer(model, opt, lambda m, i, l: m.loss(i, l),
+                             mesh, {})
+    B, S = (8, 1024) if on_tpu else (2, 32)
+    steps = 10 if on_tpu else 2
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (B, S))
+    labels = rng.integers(0, V, (B, S))
+    with mesh:
+        step_time = _measure_steps(trainer, (ids, labels), steps)
+    tps = B * S / step_time
+    n = sum(p.size for p in model.parameters())
+    print(f"moe: step={step_time*1e3:.1f}ms params={n/1e6:.0f}M "
+          f"(E={E} top2 dropless)", file=sys.stderr)
+    return _emit("moe_lm_train_tokens_per_sec", tps, "tokens/sec")
+
+
 CONFIGS = {
+    "moe": bench_moe,
     "llama": bench_llama,
     "resnet50": bench_resnet50,
     "bert": bench_bert,

@@ -13,18 +13,9 @@ from paddle_tpu.framework.tensor import Tensor
 __all__ = ["summary", "flops"]
 
 
-def _mode_snapshot(net):
-    """Per-sublayer (module, training) pairs — restoring these instead of
-    a blanket net.train() preserves submodules the user froze with
-    sub.eval() (same pattern as nn.generation._sublayers_with_self)."""
-    from paddle_tpu.nn.generation import _sublayers_with_self
-    return [(m, m.training) for m in _sublayers_with_self(net)
-            if hasattr(m, "training")]
-
-
-def _mode_restore(snap):
-    for m, was in snap:
-        m.training = was
+from paddle_tpu.nn.generation import (
+    mode_restore as _mode_restore, mode_snapshot as _mode_snapshot,
+)
 
 
 def _param_count(sub):

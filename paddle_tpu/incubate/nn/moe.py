@@ -19,8 +19,12 @@ static loop over the capacity buffer).
 tokens sorted by expert drive ``lax.ragged_dot`` with per-expert row
 counts — no capacity padding, no dropped tokens. Measured (v5e, d=1024
 f=4096 E=8 top2, 8k tokens, f32, jit fwd): ragged 15.7ms vs capacity
-23.9ms (1.5x). The capacity path remains the expert-parallel ('ep'
-mesh axis) form; ragged is the single-device/dp fast path.
+23.9ms (1.5x). When the active mesh has an ``'ep'`` axis of size > 1 the
+ragged path auto-selects the dropless EXPERT-PARALLEL shard_map kernel
+(``_make_ragged_ep_ffn``: per-shard ragged_dot over the local experts +
+psum combine) — dropless ACROSS ep, the reference's global_scatter
+capability. The capacity path remains available as the GSPMD-einsum
+fallback form.
 """
 
 from __future__ import annotations
@@ -40,8 +44,8 @@ def _make_ragged_ffn(activation: str, top_k: int, n_experts: int):
     sorted by expert, per-expert row counts drive the ragged contraction —
     no capacity buffer, no dropped tokens (the megablox/grouped-GEMM form;
     reference capability analog: the NCCL variable-count all-to-all path in
-    incubate/distributed/models/moe/moe_layer.py). Single-device/dp path;
-    the capacity dispatch remains the ep-sharded one."""
+    incubate/distributed/models/moe/moe_layer.py). This is the no-mesh
+    form; with an ep>1 mesh _make_ragged_ep_ffn takes over."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -79,6 +83,91 @@ def _ragged_ffn_op(activation: str, top_k: int, n_experts: int):
     if key not in _RAGGED_CACHE:
         opdef = OpDef(f"moe_ragged_ffn<{activation},{top_k},{n_experts}>",
                       _make_ragged_ffn(activation, top_k, n_experts))
+        _RAGGED_CACHE[key] = lambda *args: apply_op(opdef, args, {})
+    return _RAGGED_CACHE[key]
+
+
+def _make_ragged_ep_ffn(activation: str, top_k: int, n_experts: int,
+                        mesh, ep_axis: str, token_axes: tuple):
+    """DROPLESS expert-parallel grouped GEMM (shard_map over the ep axis).
+
+    The reference reaches dropless-EP with variable-count NCCL all-to-all
+    (moe_layer.py:99 MoEScatter + global_scatter). XLA wants static
+    shapes, so the TPU-native form inverts the exchange: tokens stay
+    dp-sharded and REPLICATED over ep (their natural GSPMD state when the
+    batch shards over dp), experts stay Shard(0) over ep, and each ep
+    shard runs lax.ragged_dot over ONLY the rows routed to its local
+    experts — the globally-sorted assignment array is dynamically rolled
+    so the local expert region starts at row 0, and group_sizes cover
+    just the local experts (trailing rows are outside every group, so the
+    kernel skips them). A single psum over ep combines the per-shard
+    partial outputs. No capacity buffer, no drops, no padding waste;
+    the collectives (implicit replication + psum) ride ICI.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    act_api = getattr(F, activation)
+    act = act_api.op.impl if hasattr(act_api, "op") else act_api
+    ep = mesh.shape[mesh.dim_names.index(ep_axis)]
+    if n_experts % ep:
+        raise ValueError(
+            f"dropless EP MoE needs n_experts ({n_experts}) divisible by "
+            f"the '{ep_axis}' mesh size ({ep})")
+    e_local = n_experts // ep
+    axes_entry = (token_axes if len(token_axes) > 1 else
+                  (token_axes[0] if token_axes else None))
+    tok_spec = P(axes_entry, None)
+
+    def local_fn(tokens, gatev, topi, w1, b1, w2, b2):
+        T, H = tokens.shape
+        g = lax.axis_index(ep_axis)
+        e_flat = jnp.transpose(topi).reshape(-1)           # (KT,) global ids
+        g_flat = jnp.transpose(gatev).reshape(-1)
+        order = jnp.argsort(e_flat)
+        inv = jnp.argsort(order)
+        rep = jnp.tile(tokens, (top_k, 1))[order]          # sorted by expert
+        gs = jnp.bincount(e_flat, length=n_experts).astype(jnp.int32)
+        start = (jnp.cumsum(gs) - gs)[g * e_local]         # rows before ours
+        gs_local = lax.dynamic_slice(gs, (g * e_local,), (e_local,))
+        rolled = jnp.roll(rep, -start, axis=0)
+        e_rolled = jnp.roll(e_flat[order], -start) - g * e_local
+        e_rolled = jnp.clip(e_rolled, 0, e_local - 1)
+        h = lax.ragged_dot(rolled, w1, gs_local) \
+            + b1.reshape(e_local, -1)[e_rolled]
+        h = act(h)
+        y = lax.ragged_dot(h, w2, gs_local) \
+            + b2.reshape(e_local, -1)[e_rolled]
+        n_local = jnp.sum(gs_local)
+        valid = jnp.arange(top_k * T) < n_local
+        y = jnp.where(valid[:, None], y, 0.0)              # select: kills NaNs
+        y = jnp.roll(y, start, axis=0)[inv] * g_flat[:, None]
+        out = y.reshape(top_k, T, H).sum(axis=0)
+        return lax.psum(out, ep_axis)
+
+    mapped = shard_map(
+        local_fn, mesh=mesh.jax_mesh,
+        in_specs=(tok_spec, tok_spec, tok_spec,
+                  P(ep_axis, None, None), P(ep_axis, None, None),
+                  P(ep_axis, None, None), P(ep_axis, None, None)),
+        out_specs=tok_spec, check_vma=False)
+
+    def impl(tokens, gatev, topi, w1, b1, w2, b2):
+        return mapped(tokens, gatev, topi, w1, b1, w2, b2)
+
+    return impl
+
+
+def _ragged_ep_ffn_op(activation: str, top_k: int, n_experts: int,
+                      mesh, ep_axis: str, token_axes: tuple):
+    key = (activation, top_k, n_experts, mesh.jax_mesh, ep_axis, token_axes)
+    if key not in _RAGGED_CACHE:
+        opdef = OpDef(
+            f"moe_ragged_ep_ffn<{activation},{top_k},{n_experts},{ep_axis}>",
+            _make_ragged_ep_ffn(activation, top_k, n_experts, mesh,
+                                ep_axis, token_axes))
         _RAGGED_CACHE[key] = lambda *args: apply_op(opdef, args, {})
     return _RAGGED_CACHE[key]
 
@@ -158,10 +247,11 @@ class MoEMLP(nn.Layer):
                  top_k: int = 2, capacity_factor: float = 1.25,
                  activation: str = "gelu", normalize_topk: bool = True,
                  gate: Optional[nn.Layer] = None,
-                 dispatch: str = "capacity"):
+                 dispatch: str = "capacity", ep_axis: str = "ep"):
         super().__init__()
         if dispatch not in ("capacity", "ragged"):
             raise ValueError("dispatch must be 'capacity' or 'ragged'")
+        self.ep_axis = ep_axis
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.n_experts = n_experts
@@ -181,11 +271,22 @@ class MoEMLP(nn.Layer):
         self.b2 = self.create_parameter([n_experts, 1, d_model], is_bias=True)
         self.aux_loss = None
 
-    def ep_plan(self, mesh, axis: str = "ep") -> dict:
+    def _ep_mesh(self):
+        """The active mesh when expert parallelism applies (ep axis
+        present with size > 1), else None (single-device ragged path)."""
+        from paddle_tpu.parallel.mesh import get_mesh
+        mesh = get_mesh()
+        if (mesh is not None and self.ep_axis in mesh.dim_names
+                and mesh.shape[mesh.dim_names.index(self.ep_axis)] > 1):
+            return mesh
+        return None
+
+    def ep_plan(self, mesh, axis: str = None) -> dict:
         """Param-name -> placements dict for ShardedTrainer: stacked expert
-        weights Shard(0) over `axis`, everything else replicated."""
+        weights Shard(0) over `axis` (default: this layer's ep_axis),
+        everything else replicated."""
         from paddle_tpu.parallel import Replicate, Shard
-        idx = mesh.dim_names.index(axis)
+        idx = mesh.dim_names.index(axis or self.ep_axis)
         plan = {}
         for name, _ in self.named_parameters():
             pls = [Replicate()] * mesh.ndim
@@ -209,7 +310,20 @@ class MoEMLP(nn.Layer):
 
         if self.dispatch == "ragged":
             gatev, topi = _topk_gates(probs, self.top_k, self.normalize_topk)
-            ffn = _ragged_ffn_op(self.activation, self.top_k, self.n_experts)
+            mesh = self._ep_mesh()
+            if mesh is not None:
+                # dropless expert parallelism: per-shard ragged_dot over the
+                # ep-sharded stacked weights + psum combine (see
+                # _make_ragged_ep_ffn). Token dim stays sharded over dp.
+                token_axes = tuple(a for a in ("dp",)
+                                   if a in mesh.dim_names
+                                   and mesh.shape[mesh.dim_names.index(a)] > 1)
+                ffn = _ragged_ep_ffn_op(self.activation, self.top_k,
+                                        self.n_experts, mesh, self.ep_axis,
+                                        token_axes)
+            else:
+                ffn = _ragged_ffn_op(self.activation, self.top_k,
+                                     self.n_experts)
             out = ffn(tokens, gatev, topi, self.w1, self.b1, self.w2,
                       self.b2)
             return paddle.reshape(out, [B, S, H])

@@ -42,8 +42,7 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
     # per-sublayer snapshot: a blanket model.train() on exit would clobber
     # submodules the user deliberately froze with sub.eval(). Models are
     # duck-typed (any callable with forward(ids)->logits): no Layer, no-op.
-    mode_snapshot = [(m, m.training) for m in _sublayers_with_self(model)
-                     if hasattr(m, "training")]
+    snap = mode_snapshot(model)
     if hasattr(model, "eval"):
         model.eval()  # deterministic decode: no live dropout
     try:
@@ -65,8 +64,7 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
                 if eos_token_id is not None and done.all():
                     break
     finally:
-        for m, was in mode_snapshot:
-            m.training = was
+        mode_restore(snap)
     return ids
 
 
@@ -75,6 +73,19 @@ def _sublayers_with_self(model):
     if hasattr(model, "sublayers"):
         out.extend(model.sublayers(include_self=False))
     return out
+
+
+def mode_snapshot(model):
+    """Per-sublayer (module, training) pairs. Restoring these (instead of
+    a blanket .train()) preserves submodules the user froze with
+    sub.eval(). Shared by generation, hapi summary/flops, onnx export."""
+    return [(m, m.training) for m in _sublayers_with_self(model)
+            if hasattr(m, "training")]
+
+
+def mode_restore(snap):
+    for m, was in snap:
+        m.training = was
 
 
 def beam_search(model, input_ids, beam_size: int = 4,
@@ -107,8 +118,7 @@ def beam_search(model, input_ids, beam_size: int = 4,
             f"prompt {S} + {max_new_tokens} new tokens exceeds "
             f"max_position_embeddings {max_pos}")
 
-    mode_snapshot = [(m, m.training) for m in _sublayers_with_self(model)
-                     if hasattr(m, "training")]
+    snap = mode_snapshot(model)
     if hasattr(model, "eval"):
         model.eval()
     try:
@@ -172,6 +182,5 @@ def beam_search(model, input_ids, beam_size: int = 4,
                               axis=0)                           # (B, T)
             return np.concatenate([ids, chosen], axis=1)
     finally:
-        for m, was in mode_snapshot:
-            m.training = was
+        mode_restore(snap)
 

@@ -391,9 +391,8 @@ def to_model_bytes(layer, example_inputs, opset_version: int = 13) -> bytes:
     from paddle_tpu.nn.utils import functional_call
     from paddle_tpu.passes import decomposition_rules, rewrite_jaxpr
 
-    from paddle_tpu.nn.generation import _sublayers_with_self
-    mode_snapshot = [(m, m.training) for m in _sublayers_with_self(layer)
-                     if hasattr(m, "training")]
+    from paddle_tpu.nn.generation import mode_restore, mode_snapshot
+    snap = mode_snapshot(layer)
     if hasattr(layer, "eval"):
         layer.eval()
     try:
@@ -420,8 +419,7 @@ def to_model_bytes(layer, example_inputs, opset_version: int = 13) -> bytes:
     finally:
         # per-sublayer restore (no blanket .train(): it would clobber
         # submodules the user froze with sub.eval())
-        for m, was in mode_snapshot:
-            m.training = was
+        mode_restore(snap)
 
     g = _Graph()
     jaxpr = closed.jaxpr
