@@ -395,9 +395,27 @@ def bench_ernie():
     return _emit("ernie_semiauto_tokens_per_sec", tps, "tokens/sec")
 
 
-def bench_decode():
-    """Greedy KV-cache decode tokens/sec on the flagship 134M Llama
-    (block_multi_head_attention capability analog)."""
+def _decode_marginal(dec, prompt, n_hi=96, n_lo=32, reps=5):
+    """Pure decode seconds/token: difference of two generate lengths —
+    prefill and per-call dispatch cancel out."""
+    import numpy as np
+
+    dec.generate(prompt, max_new_tokens=n_hi)
+    dec.generate(prompt, max_new_tokens=n_lo)
+    t_hi, t_lo = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        dec.generate(prompt, max_new_tokens=n_hi)
+        t_hi.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        dec.generate(prompt, max_new_tokens=n_lo)
+        t_lo.append(time.perf_counter() - t0)
+    return (np.median(t_hi) - np.median(t_lo)) / (n_hi - n_lo)
+
+
+def _bench_decode_config(cfg_kwargs, metric, label):
+    """Greedy KV-cache decode: bf16 vs int8-weight-only marginal tok/s
+    (weight_only_linear + block_multi_head_attention capability analog)."""
     import numpy as np
 
     import jax
@@ -406,33 +424,49 @@ def bench_decode():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=768, intermediate_size=2048,
-                      num_hidden_layers=12, num_attention_heads=12,
-                      num_key_value_heads=12, max_position_embeddings=1024,
-                      dtype="bfloat16" if on_tpu else "float32"
-                      ) if on_tpu else LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
-        max_position_embeddings=128)
+    cfg = LlamaConfig(**cfg_kwargs, dtype="bfloat16") if on_tpu else \
+        LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=4, max_position_embeddings=128)
     model = LlamaForCausalLM(cfg)
     if on_tpu:
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
     B, prompt_len = (8, 128) if on_tpu else (1, 8)
-    new_tokens = 128 if on_tpu else 8
-    dec = LlamaDecoder(model, max_len=prompt_len + new_tokens + 1)
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, (B, prompt_len))
-    dec.generate(prompt, max_new_tokens=new_tokens)  # compile prefill + scan
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = dec.generate(prompt, max_new_tokens=new_tokens)
-        best = min(best, time.perf_counter() - t0)
-    tps = B * new_tokens / best
-    print(f"decode: {best*1e3:.0f}ms for {new_tokens} tokens x B={B}",
+    hi, lo = (96, 32) if on_tpu else (8, 4)
+    dec = LlamaDecoder(model, max_len=prompt_len + hi + 1)
+    s_bf = _decode_marginal(dec, prompt, hi, lo)
+    dec_i8 = LlamaDecoder(model, max_len=prompt_len + hi + 1,
+                          weight_dtype="int8")
+    s_i8 = _decode_marginal(dec_i8, prompt, hi, lo)
+    n = sum(p.size for p in model.parameters())
+    wbw = n / 2 / s_i8 / 1e9  # int8 weight bytes per second
+    print(f"{label}: bf16 {s_bf*1e3:.2f}ms/tok ({B/s_bf:.0f} tok/s), "
+          f"int8 {s_i8*1e3:.2f}ms/tok ({B/s_i8:.0f} tok/s), "
+          f"int8/bf16 {s_bf/s_i8:.2f}x, int8 weight-stream ~{wbw:.0f} GB/s",
           file=sys.stderr)
-    return _emit("llama_110m_greedy_decode_tokens_per_sec", tps, "tokens/sec")
+    return _emit(metric, B / s_bf, "tokens/sec")
+
+
+def bench_decode():
+    return _bench_decode_config(
+        dict(vocab_size=32000, hidden_size=768, intermediate_size=2048,
+             num_hidden_layers=12, num_attention_heads=12,
+             num_key_value_heads=12, max_position_embeddings=1024),
+        "llama_110m_greedy_decode_tokens_per_sec", "decode-134M")
+
+
+def bench_decode_1b():
+    """The weight-bandwidth-bound regime: ~941M params, where int8
+    weight-only shows its step-time win (the 134M model is
+    kernel-overhead-bound at B=8 and int8 is ~parity there)."""
+    return _bench_decode_config(
+        dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+             num_hidden_layers=16, num_attention_heads=16,
+             num_key_value_heads=16, max_position_embeddings=1024),
+        "llama_1b_greedy_decode_tokens_per_sec", "decode-1B")
 
 
 def bench_moe():
@@ -506,6 +540,7 @@ CONFIGS = {
     "unet": bench_unet,
     "ernie": bench_ernie,
     "decode": bench_decode,
+    "decode1b": bench_decode_1b,
 }
 
 

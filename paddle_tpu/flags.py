@@ -142,6 +142,10 @@ define_flag("use_fused_lm_ce", True,
             "logits")
 define_flag("use_ring_attention", True,
             "use ring (context-parallel) attention when the mesh has a sep>1 axis")
+define_flag("decode_cache_layout", "stacked",
+            "KV-cache layout for the compiled decoder: 'per_layer' "
+            "(one (B, L, KV, D) buffer per layer) or 'stacked' "
+            "((layers, B, L, KV, D) single buffer)")
 define_flag("fused_ce_logits_budget_mb", 1536,
             "transient f32 logits budget (MB) for the chunked fused "
             "lm-head CE; the vocab chunk is the largest multiple of 1024 "

@@ -3,8 +3,11 @@
 Capability analog of the reference's decode stack —
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
 (block-table KV cache attention) and the fused generation ops — in the
-TPU-native form: a PURE functional forward with a statically-shaped
-``(L, B, max_len, KV, D)`` cache, so prefill and every decode step are each
+TPU-native form: a PURE functional forward with a statically-shaped KV
+cache — stacked ``(L, B, max_len, KV, D)`` by default, or one
+``(B, max_len, KV, D)`` buffer per layer via
+``flags.decode_cache_layout='per_layer'`` (measured equal-or-slower on
+v5e; kept as a tuning knob) — so prefill and every decode step are each
 ONE cached-compile XLA program (no recompiles across steps; static shapes
 are what the MXU wants). Block tables are unnecessary: XLA owns memory, and
 a padded dense cache + position mask is the layout it tiles best.
@@ -27,14 +30,18 @@ from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, _rope_tables
 __all__ = ["LlamaDecoder"]
 
 
-def _rope_at(x, pos, cfg):
-    """Rotate (B, S, H, D) by positions ``pos + [0..S)`` (traced offset);
-    shares the training-path frequency tables (_rope_tables) so decode can
-    never diverge from training if rope scaling changes."""
-    cos, sin = _rope_tables(x.shape[1], cfg.head_dim, cfg.rope_theta,
-                            x.dtype, offset=pos)
-    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+def _rope_at(x, pos, cfg, p):
+    """Rotate (B, S, H, D) by positions ``pos + [0..S)``: a dynamic slice
+    of the tables precomputed at init from the training-path frequency
+    function (_rope_tables), so decode can never diverge from training if
+    rope scaling changes — and no per-step exp/pow work."""
+    S = x.shape[1]
     d2 = cfg.head_dim // 2
+    cos = jax.lax.dynamic_slice(p["rope.cos"], (pos, 0),
+                                (S, d2)).astype(x.dtype)
+    sin = jax.lax.dynamic_slice(p["rope.sin"], (pos, 0),
+                                (S, d2)).astype(x.dtype)
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
     x1, x2 = x[..., :d2], x[..., d2:]
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
 
@@ -43,10 +50,21 @@ def _mm(x, p, name):
     """x @ weight, transparently using the int8 weight-only path when the
     decoder quantized this matrix (weight stays int8 in HBM — half the
     weight bandwidth, which bounds small-batch decode; reference analog:
-    weight_only_linear, paddle/phi/kernels/fusion/gpu/)."""
+    weight_only_linear, paddle/phi/kernels/fusion/gpu/). On TPU the
+    dequant happens INSIDE the Pallas matmul tile (ops/pallas/int8_matmul)
+    — XLA's astype-then-dot materializes the bf16 weight and loses the
+    bandwidth win (measured slower than bf16)."""
     q = p.get(name + ":int8")
     if q is not None:
-        return (x @ q.astype(x.dtype)) * p[name + ":scale"].astype(x.dtype)
+        scale = p[name + ":scale"]
+        lead = x.shape[:-1]
+        x2 = x.reshape((-1, x.shape[-1]))
+        from paddle_tpu.ops.pallas import int8_matmul as i8
+        if jax.default_backend() == "tpu" and i8.supported(x2, q):
+            out = i8.int8_matmul(x2, q, scale)
+        else:
+            out = (x2 @ q.astype(x.dtype)) * scale.astype(x.dtype)
+        return out.reshape(lead + (q.shape[1],))
     return x @ p[name]
 
 
@@ -64,17 +82,28 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
             var + cfg.rms_norm_eps)).astype(x.dtype) * w
 
     x = rms(h, p[pre + "input_layernorm.weight"])
-    q = _mm(x, p, pre + "self_attn.q_proj.weight").reshape(B, S, H, D)
-    k = _mm(x, p, pre + "self_attn.k_proj.weight").reshape(B, S, KV, D)
-    v = _mm(x, p, pre + "self_attn.v_proj.weight").reshape(B, S, KV, D)
-    q = _rope_at(q, pos, cfg)
-    k = _rope_at(k, pos, cfg)
+    qkv = _mm(x, p, pre + "self_attn.qkv.weight")
+    q = qkv[..., :H * D].reshape(B, S, H, D)
+    k = qkv[..., H * D:H * D + KV * D].reshape(B, S, KV, D)
+    v = qkv[..., H * D + KV * D:].reshape(B, S, KV, D)
+    q = _rope_at(q, pos, cfg, p)
+    k = _rope_at(k, pos, cfg, p)
 
-    kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, pos, 0, 0))
-    vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, pos, 0, 0))
+    if isinstance(kc, tuple):
+        # per-layer cache buffers: a DUS on THIS layer's (B, max_len, KV, D)
+        # array only
+        kc_l = jax.lax.dynamic_update_slice(kc[li], k, (0, pos, 0, 0))
+        vc_l = jax.lax.dynamic_update_slice(vc[li], v, (0, pos, 0, 0))
+        kc = tuple(kc_l if i == li else c for i, c in enumerate(kc))
+        vc = tuple(vc_l if i == li else c for i, c in enumerate(vc))
+    else:
+        # stacked (L, B, max_len, KV, D) cache
+        kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, pos, 0, 0))
+        kc_l, vc_l = kc[li], vc[li]
 
     rep = H // KV
-    kk, vv = kc[li], vc[li]                       # (B, max_len, KV, D)
+    kk, vv = kc_l, vc_l                           # (B, max_len, KV, D)
     if rep > 1:
         kk = jnp.repeat(kk, rep, axis=2)
         vv = jnp.repeat(vv, rep, axis=2)
@@ -89,8 +118,9 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
     h = h + _mm(out, p, pre + "self_attn.o_proj.weight")
 
     x = rms(h, p[pre + "post_attention_layernorm.weight"])
-    a = jax.nn.silu(_mm(x, p, pre + "mlp.gate_proj.weight")) * _mm(
-        x, p, pre + "mlp.up_proj.weight")
+    gu = _mm(x, p, pre + "mlp.gate_up.weight")
+    F_ = gu.shape[-1] // 2
+    a = jax.nn.silu(gu[..., :F_]) * gu[..., F_:]
     return h + _mm(a, p, pre + "mlp.down_proj.weight"), kc, vc
 
 
@@ -102,9 +132,12 @@ def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len):
     var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
     h = (h.astype(jnp.float32) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
          ).astype(h.dtype) * p["model.norm.weight"]
-    head = (p["model.embed_tokens.weight"].T if cfg.tie_word_embeddings
-            else p["lm_head.weight"])
-    logits = (h[:, -1] @ head).astype(jnp.float32)   # (B, V)
+    if "head:int8" in p:
+        logits = _mm(h[:, -1], p, "head").astype(jnp.float32)
+    else:
+        head = (p["model.embed_tokens.weight"].T if cfg.tie_word_embeddings
+                else p["lm_head.weight"])
+        logits = (h[:, -1] @ head).astype(jnp.float32)   # (B, V)
     return logits, kc, vc
 
 
@@ -120,21 +153,35 @@ class LlamaDecoder:
                  weight_dtype: Optional[str] = None):
         """weight_dtype="int8": per-output-channel weight-only quantization
         of the decoder/MLP matmul weights (embedding and final norm stay in
-        the activation dtype) — halves the checkpoint/HBM footprint of the
-        quantized matrices. Measured honestly (v5e, 134M, B=8): decode
-        throughput is ~parity with bf16 (0.96x) because XLA materializes
-        the dequantized operand rather than fusing the int8->bf16 convert
-        into the matmul read; the win today is memory, not speed."""
+        the activation dtype). On TPU the dequant runs inside the Pallas
+        matmul tile (ops/pallas/int8_matmul), so the quantized matrices
+        stream int8 from HBM — halving the weight bandwidth that bounds
+        small-batch decode (reference weight_only_linear capability).
+
+        Decode steps are kernel-count-sensitive (the scan body runs ~1ms
+        of tiny ops on a 134M model): q/k/v and gate/up are concatenated
+        at init into single fused matmuls (q_proj|k_proj|v_proj ->
+        'self_attn.qkv', gate|up -> 'mlp.gate_up'), and the rope tables
+        are precomputed once for max_len instead of per step."""
         if weight_dtype not in (None, "int8"):
             raise ValueError(f"weight_dtype must be None or 'int8', "
                              f"got {weight_dtype!r}")
         self.cfg = model.config
         self.max_len = max_len
         self.weight_dtype = weight_dtype
+        raw = {name: t.value for name, t in model.state_dict().items()}
+        # fuse qkv and gate/up per layer (one matmul each; fewer kernels)
+        for li in range(model.config.num_hidden_layers):
+            pre = f"model.layers.{li}."
+            raw[pre + "self_attn.qkv.weight"] = jnp.concatenate(
+                [raw.pop(pre + "self_attn.q_proj.weight"),
+                 raw.pop(pre + "self_attn.k_proj.weight"),
+                 raw.pop(pre + "self_attn.v_proj.weight")], axis=1)
+            raw[pre + "mlp.gate_up.weight"] = jnp.concatenate(
+                [raw.pop(pre + "mlp.gate_proj.weight"),
+                 raw.pop(pre + "mlp.up_proj.weight")], axis=1)
         p = {}
-        for name, t in model.state_dict().items():
-            v = t.value
-            # nn.Linear keeps (in, out); the functional path uses x @ w
+        for name, v in raw.items():
             if (weight_dtype == "int8" and v.ndim == 2
                     and ("self_attn." in name or "mlp." in name)):
                 from paddle_tpu.quantization import weight_quantize
@@ -144,6 +191,22 @@ class LlamaDecoder:
                 p[name + ":scale"] = scale.value
                 continue
             p[name] = v
+        # the lm head (tied: transposed embedding) is the single biggest
+        # matrix in the step — quantize a dedicated copy of it too
+        if weight_dtype == "int8":
+            from paddle_tpu.quantization import weight_quantize
+            from paddle_tpu.framework.tensor import Tensor
+            head = (p["model.embed_tokens.weight"].T
+                    if model.config.tie_word_embeddings
+                    else p.pop("lm_head.weight"))
+            q, scale = weight_quantize(Tensor(head))
+            p["head:int8"] = q.value
+            p["head:scale"] = scale.value
+        # precomputed rope tables for the whole cache window
+        cos, sin = _rope_tables(max_len, model.config.head_dim,
+                                model.config.rope_theta,
+                                jnp.dtype(model.config.dtype), offset=0)
+        p["rope.cos"], p["rope.sin"] = cos, sin
         self.params = p
         cfg = self.cfg
         self.trace_count = 0  # python side effect: bumps only on (re)trace
@@ -182,9 +245,19 @@ class LlamaDecoder:
     def _empty_cache(self, B):
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
-        shape = (cfg.num_hidden_layers, B, self.max_len,
-                 cfg.num_key_value_heads, cfg.head_dim)
-        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+        from paddle_tpu.flags import flags
+        if flags.decode_cache_layout not in ("stacked", "per_layer"):
+            raise ValueError(
+                f"decode_cache_layout must be 'stacked' or 'per_layer', "
+                f"got {flags.decode_cache_layout!r}")
+        if flags.decode_cache_layout == "stacked":
+            shape = (cfg.num_hidden_layers, B, self.max_len,
+                     cfg.num_key_value_heads, cfg.head_dim)
+            return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+        shape = (B, self.max_len, cfg.num_key_value_heads, cfg.head_dim)
+        zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
+                              for _ in range(cfg.num_hidden_layers))
+        return zeros(), zeros()
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,

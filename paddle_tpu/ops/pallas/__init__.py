@@ -10,3 +10,4 @@ reference's CPU-kernel parity strategy.
 
 from paddle_tpu.ops.pallas import flash_attention  # noqa: F401
 from paddle_tpu.ops.pallas import rms_norm  # noqa: F401
+from paddle_tpu.ops.pallas import int8_matmul  # noqa: F401

@@ -266,3 +266,28 @@ def test_beam_search_eos_freezes_beams():
     if (seq == 0).any():
         first = int(np.argmax(seq == 0))
         assert np.all(seq[first:] == 0)
+
+
+def test_per_layer_cache_layout_parity():
+    """flags.decode_cache_layout='per_layer' must decode identically to
+    the default stacked layout (and bogus values must raise)."""
+    import pytest as _pytest
+
+    from paddle_tpu.flags import flags
+    from paddle_tpu.inference.generate import LlamaDecoder
+
+    model = _model()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, model.config.vocab_size, (2, 8))
+    dec = LlamaDecoder(model, max_len=24)
+    ref = dec.generate(prompt, max_new_tokens=6)
+    flags.decode_cache_layout = "per_layer"
+    try:
+        dec2 = LlamaDecoder(model, max_len=24)
+        out = dec2.generate(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(ref, out)
+        flags.decode_cache_layout = "bogus"
+        with _pytest.raises(ValueError):
+            LlamaDecoder(model, max_len=24).generate(prompt, max_new_tokens=2)
+    finally:
+        flags.decode_cache_layout = "stacked"

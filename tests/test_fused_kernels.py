@@ -180,3 +180,27 @@ def test_llama_rope_op_fused_vs_unfused_training_parity():
         paddle.set_flags({"use_fused_rms_norm": True, "use_fused_rope": False})
     assert abs(l_fused - l_ref) < 1e-5, (l_fused, l_ref)
     np.testing.assert_allclose(g_fused, g_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_int8_matmul_kernel_matches_dequant():
+    """ops/pallas/int8_matmul (weight_only_linear capability): interpret
+    mode on CPU; per-channel dequant parity incl. a non-divisible N."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.int8_matmul import int8_matmul, supported
+
+    rng = np.random.default_rng(0)
+    for K, N, bn in ((256, 512, 1024), (512, 640, 1024), (512, 640, 512),
+                     (5504, 256, 1024)):
+        # (512, 640, 512) exercises the padded trailing tile (grid=2,
+        # last block 128 wide of a 512 BlockSpec); K=5504 is the 1B
+        # down_proj contraction (128-aligned, not 256)
+        x = jnp.asarray(rng.standard_normal((8, K)), jnp.float32)
+        w = jnp.asarray(rng.integers(-127, 127, (K, N)), jnp.int8)
+        s = jnp.asarray(rng.uniform(0.01, 0.02, (N,)), jnp.float32)
+        got = np.asarray(int8_matmul(x, w, s, block_n=bn))
+        ref = np.asarray((x @ w.astype(jnp.float32)) * s)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # routing guards: big row counts / unaligned shapes are not eligible
+    assert not supported(jnp.zeros((128, 256)), jnp.zeros((256, 512), jnp.int8))
+    assert not supported(jnp.zeros((8, 200)), jnp.zeros((200, 512), jnp.int8))
