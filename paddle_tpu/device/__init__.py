@@ -75,6 +75,11 @@ class Stream:
 
 
 class Event:
+    """Timing events (device/cuda Event analog). ``record`` drains the XLA
+    dispatch queue and stamps HOST wall-clock time, so ``elapsed_time`` is
+    a real device-inclusive measurement between two recorded points (not a
+    per-stream device timestamp — XLA owns streams)."""
+
     def __init__(self, enable_timing=False, blocking=False, interprocess=False):
         self._t = None
 
@@ -91,7 +96,8 @@ class Event:
 
     def elapsed_time(self, end: "Event") -> float:
         if self._t is None or end._t is None:
-            return 0.0
+            raise RuntimeError(
+                "Event.elapsed_time: both events must be record()ed first")
         return (end._t - self._t) * 1000.0
 
 

@@ -15,7 +15,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["AutoTuner", "Candidate", "default_candidates", "prune_by_memory"]
+__all__ = ["AutoTuner", "Candidate", "default_candidates", "estimate_memory",
+           "prune_by_memory"]
 
 
 @dataclass
@@ -26,6 +27,7 @@ class Candidate:
     sep: int = 1
     micro_batches: int = 1
     use_recompute: bool = False
+    sharding_stage: int = 0            # ZeRO stage over the dp axis
     metrics: Dict[str, float] = field(default_factory=dict)
 
     @property
@@ -34,19 +36,20 @@ class Candidate:
 
     def key(self):
         return (self.dp, self.mp, self.pp, self.sep, self.micro_batches,
-                self.use_recompute)
+                self.use_recompute, self.sharding_stage)
 
     def __repr__(self):
         t = self.metrics.get("tokens_per_sec")
         perf = f", tokens/s={t:.0f}" if t else ""
         return (f"Candidate(dp={self.dp}, mp={self.mp}, pp={self.pp}, "
                 f"sep={self.sep}, mb={self.micro_batches}, "
-                f"rc={self.use_recompute}{perf})")
+                f"rc={self.use_recompute}, zero={self.sharding_stage}{perf})")
 
 
 def default_candidates(n_devices: int, num_layers: int, batch_size: int,
                        heads: int) -> List[Candidate]:
-    """Divisibility-pruned grid (search.py all_candidates + prune.py rules)."""
+    """Divisibility-pruned grid (search.py all_candidates + prune.py rules)
+    over {dp, mp, pp, sep} x micro-batches x recompute x ZeRO stage."""
     out = []
     degrees = [1, 2, 4, 8, 16, 32]
     for dp, mp, pp, sep in itertools.product(degrees, repeat=4):
@@ -61,20 +64,59 @@ def default_candidates(n_devices: int, num_layers: int, batch_size: int,
         for mb in (1, 2, 4):
             if batch_size % (dp * mb):
                 continue
+            if pp > 1 and mb < 2:
+                continue  # prune.py analog: pipeline wants >1 micro-batch
             for rc in (False, True):
-                out.append(Candidate(dp, mp, pp, sep, mb, rc))
+                stages = (0,) if dp == 1 else (0, 1, 2, 3)
+                for stage in stages:
+                    out.append(Candidate(dp, mp, pp, sep, mb, rc, stage))
     return out
+
+
+def estimate_memory(c: Candidate, param_bytes: int,
+                    act_bytes_per_micro: int = 0,
+                    optimizer_multiplier: float = 3.0,
+                    recompute_factor: float = 0.3) -> Dict[str, float]:
+    """Per-chip memory breakdown (memory_cost_model.py analog).
+
+    - params shard over mp*pp (tensor/pipeline split) and, at ZeRO-3,
+      additionally over dp;
+    - grads mirror params; ZeRO-2+ shards them over dp;
+    - optimizer states (Adam m+v+master ~= optimizer_multiplier x f32
+      params) shard over dp at every ZeRO stage >= 1;
+    - activations are per-micro-batch, scaled by the 1F1B in-flight bound
+      (min(2*pp, micro_batches) micro-batches alive per rank) and the
+      recompute factor when enabled.
+    """
+    model_shard = max(c.mp * c.pp, 1)
+    dp = max(c.dp, 1)
+    p = param_bytes / model_shard
+    params = p / dp if c.sharding_stage >= 3 else p
+    grads = p / dp if c.sharding_stage >= 2 else p
+    opt = param_bytes * optimizer_multiplier / model_shard
+    if c.sharding_stage >= 1:
+        opt /= dp
+    in_flight = min(2 * c.pp, max(c.micro_batches, 1))
+    act = act_bytes_per_micro * in_flight / max(c.sep, 1)
+    if c.use_recompute:
+        act *= recompute_factor
+    total = params + grads + opt + act
+    return {"params": params, "grads": grads, "optimizer": opt,
+            "activations": act, "total": total}
 
 
 def prune_by_memory(cands: List[Candidate], param_bytes: int,
                     hbm_bytes: int = 16 << 30,
-                    optimizer_multiplier: float = 3.0) -> List[Candidate]:
-    """memory_cost_model.py analog: params+grads+opt must fit per chip."""
+                    optimizer_multiplier: float = 3.0,
+                    act_bytes_per_micro: int = 0) -> List[Candidate]:
+    """Drop candidates whose estimated per-chip footprint exceeds 90% of
+    HBM; records the estimate on the candidate for the recorder."""
     keep = []
     for c in cands:
-        shard = c.mp * c.pp  # param-sharding degrees
-        per_chip = param_bytes * (1 + optimizer_multiplier) / max(shard, 1)
-        if per_chip < hbm_bytes * 0.9:
+        est = estimate_memory(c, param_bytes, act_bytes_per_micro,
+                              optimizer_multiplier)
+        c.metrics["est_bytes"] = est["total"]
+        if est["total"] < hbm_bytes * 0.9:
             keep.append(c)
     return keep
 

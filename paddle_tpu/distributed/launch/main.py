@@ -29,6 +29,12 @@ def _parse(argv):
     p.add_argument("--devices", type=str, default="",
                    help="accepted for reference-CLI parity; the TPU runtime "
                         "owns local chips, so this is informational")
+    p.add_argument("--elastic_store", type=str, default="",
+                   help="host:port of the elastic TCPStore; enables the "
+                        "elastic agent (heartbeat + membership watch + "
+                        "env rewrite on scale events)")
+    p.add_argument("--elastic_ttl", type=float, default=3.0,
+                   help="node liveness TTL seconds for elastic membership")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -46,33 +52,106 @@ def _worker_env(args, restarts: int) -> dict:
     return env
 
 
+def _make_elastic(args):
+    """The per-node elastic AGENT (fleet/elastic/manager.py:124 analog):
+    the launcher heartbeats for its node while the worker runs, watches
+    membership, and on a scale event restarts the worker with rewritten
+    PADDLE_* env (endpoints_env)."""
+    if not args.elastic_store:
+        return None
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.native.tcp_store import TCPStore
+    host, _, port = args.elastic_store.rpartition(":")
+    store = TCPStore(host or "127.0.0.1", int(port), is_master=False)
+    mgr = ElasticManager(store, f"node{args.node_rank}",
+                         np_range=args.nnodes, heartbeat_s=0.3,
+                         ttl_s=args.elastic_ttl)
+    return mgr.start()
+
+
+def _wait_quorum(elastic, args) -> List[str]:
+    """HOLD until at least np_min nodes are alive, then give late joiners
+    one TTL-ish window to settle (ElasticStatus.HOLD semantics)."""
+    lo, _, hi = args.nnodes.partition(":")
+    np_min, np_max = int(lo), int(hi or lo)
+    deadline = time.time() + max(30.0, 3 * args.elastic_ttl)
+    members = elastic._alive_nodes()
+    while len(members) < np_min and time.time() < deadline:
+        time.sleep(0.2)
+        members = elastic._alive_nodes()
+    settle_end = time.time() + 2 * 0.3  # two heartbeat periods
+    while len(members) < np_max and time.time() < settle_end:
+        time.sleep(0.2)
+        members = elastic._alive_nodes()
+    return members
+
+
 def launch(argv: Optional[List[str]] = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     os.makedirs(args.log_dir, exist_ok=True)
-    restarts = 0
+    elastic = _make_elastic(args)
+    restarts = 0   # incarnation counter (log/env numbering)
+    failures = 0   # genuine failures only; scale restarts don't consume it
     while True:
         log_path = os.path.join(
             args.log_dir, f"worker.{args.node_rank}.{restarts}.log")
         cmd = [sys.executable, args.script] + list(args.script_args)
+        env = _worker_env(args, restarts)
+        launched_members: List[str] = []
+        if elastic is not None:
+            # authoritative membership snapshot for THIS incarnation: the
+            # poll below compares against it, so a scale event can never
+            # be consumed behind our back by the manager's own loop tick
+            launched_members = _wait_quorum(elastic, args)
+            elastic._members = launched_members
+            env.update(elastic.endpoints_env())
+        scaled = False
         with open(log_path, "ab") as logf:
-            proc = subprocess.Popen(cmd, env=_worker_env(args, restarts),
+            proc = subprocess.Popen(cmd, env=env,
                                     stdout=logf, stderr=subprocess.STDOUT)
             try:
-                ret = proc.wait()
+                if elastic is None:
+                    ret = proc.wait()
+                else:
+                    while True:
+                        ret = proc.poll()
+                        if ret is not None:
+                            break
+                        if elastic._alive_nodes() != launched_members:
+                            # membership changed: stop the worker; the
+                            # restart below picks up the rewritten env
+                            scaled = True
+                            sys.stderr.write(
+                                "elastic: membership changed -> "
+                                "restarting worker\n")
+                            proc.terminate()
+                            try:
+                                ret = proc.wait(timeout=10)
+                            except subprocess.TimeoutExpired:
+                                proc.kill()
+                                ret = proc.wait()
+                            break
+                        time.sleep(0.2)
             except KeyboardInterrupt:
                 proc.send_signal(signal.SIGTERM)
                 return 130
-        if ret == 0:
+        if ret == 0 and not scaled:
+            if elastic is not None:
+                elastic.stop()
             return 0
         restarts += 1
-        if restarts > args.max_restarts:
-            sys.stderr.write(
-                f"worker failed {restarts} times (last={ret}); giving up. "
-                f"logs: {log_path}\n")
-            return ret
-        sys.stderr.write(f"worker exited {ret}; restart {restarts}/"
-                         f"{args.max_restarts}\n")
-        time.sleep(1)
+        if not scaled:
+            failures += 1
+            if failures > args.max_restarts:
+                sys.stderr.write(
+                    f"worker failed {failures} times (last={ret}); giving "
+                    f"up. logs: {log_path}\n")
+                if elastic is not None:
+                    elastic.stop()
+                return ret
+        sys.stderr.write(f"worker exited {ret}; restart {restarts} "
+                         f"(failures {failures}/{args.max_restarts})\n")
+        time.sleep(0.5 if scaled else 1)
 
 
 def main() -> None:

@@ -28,7 +28,22 @@ __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
     "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
     "Multinomial", "Geometric", "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
 ]
+
+from paddle_tpu.distribution.transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+)
+from paddle_tpu.distribution.transformed_distribution import (  # noqa: E402,F401
+    TransformedDistribution,
+)
 
 _HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
 

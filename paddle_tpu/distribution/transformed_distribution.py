@@ -1,0 +1,56 @@
+"""TransformedDistribution
+(python/paddle/distribution/transformed_distribution.py analog): a base
+distribution pushed through a chain of bijectors; log_prob applies the
+change-of-variables formula through the inverse chain."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution:
+    def __init__(self, base, transforms: Sequence):
+        from paddle_tpu.distribution.transform import ChainTransform, Transform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+
+    @property
+    def batch_shape(self):
+        return self.base.batch_shape
+
+    @property
+    def event_shape(self):
+        shape = tuple(self.base.batch_shape) + tuple(self.base.event_shape)
+        out = self._chain.forward_shape(shape)
+        n = len(out) - len(self.base.batch_shape)
+        return tuple(out[len(out) - n:]) if n > 0 else ()
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value) -> Tensor:
+        """log p_Y(y) = log p_X(f^{-1}(y)) - log|det J_f(f^{-1}(y))|."""
+        x = self._chain.inverse(value)
+        ld = self._chain.forward_log_det_jacobian(x)
+        base_lp = self.base.log_prob(x)
+        # align ranks: the chain's ldj may have consumed event dims
+        bl = base_lp._value if isinstance(base_lp, Tensor) else base_lp
+        lv = ld._value if isinstance(ld, Tensor) else ld
+        while bl.ndim > lv.ndim:
+            bl = bl.sum(axis=-1)
+        return Tensor(bl - lv)
+
+    def prob(self, value) -> Tensor:
+        return paddle.exp(self.log_prob(value))

@@ -62,6 +62,9 @@ class StaticFunction:
         except Exception:
             pass
         self._cache: Dict[Any, Tuple[OpDef, dict]] = {}
+        self._warned_break = False  # one-time graph-break warning
+        self._broken: set = set()   # cache keys that graph-broke: go
+        #                             straight to eager, don't re-trace
 
     def _make_impl(self, static_kwargs: tuple, training: bool, n_state: int,
                    state_names: Tuple[str, ...], cell: dict):
@@ -136,6 +139,14 @@ class StaticFunction:
             state_tensors = []
 
         cache_key = (static_kwargs, training, state_names)
+        if cache_key in self._broken:
+            # a prior call graph-broke on this specialization: skip the
+            # (expensive, guaranteed-to-fail) re-trace entirely
+            from paddle_tpu.framework.monitor import stat_add
+            stat_add("to_static_graph_breaks")
+            if self._layer is not None:
+                return self._layer(*args, **kwargs)
+            return self._fn(*args, **kwargs)
         entry = self._cache.get(cache_key)
         if entry is None:
             cell: dict = {}
@@ -156,7 +167,35 @@ class StaticFunction:
         tensor_args = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
                        for a in args]
 
-        outs = apply_op(opdef, tuple(state_tensors + tensor_args), {"key": key})
+        try:
+            outs = apply_op(opdef, tuple(state_tensors + tensor_args),
+                            {"key": key})
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError):
+            # GRAPH BREAK: data-dependent Python control flow on tensor
+            # VALUES cannot trace. The reference's SOT
+            # (jit/sot/opcode_translator) splits the bytecode into
+            # subgraphs around the break; the contract here is
+            # fall-back-to-eager per call (correct results, no compile)
+            # with a one-time warning + a STAT counter
+            # (to_static_graph_breaks) so the break is observable.
+            from paddle_tpu.framework.monitor import stat_add
+            stat_add("to_static_graph_breaks")
+            self._broken.add(cache_key)
+            if not self._warned_break:
+                self._warned_break = True
+                import warnings
+                warnings.warn(
+                    f"to_static<{getattr(self._fn, '__name__', 'fn')}>: "
+                    "data-dependent Python control flow broke the trace; "
+                    "falling back to EAGER for these calls (use "
+                    "paddle.where / lax.cond-style ops to stay compiled)",
+                    stacklevel=2)
+            if self._layer is not None:
+                return self._layer(*args, **kwargs)
+            return self._fn(*args, **kwargs)
         if not isinstance(outs, tuple):
             outs = (outs,)
         n_out = cell["n_out"]

@@ -57,6 +57,7 @@ def register_op(name: str, *, ref: str = "", n_outputs: int = 1, differentiable:
         if name in OPS:
             raise KeyError(f"op {name!r} registered twice")
         OPS[name] = opdef
+        _auto_schema(opdef)
 
         @functools.wraps(impl)
         def api(*args, **kwargs):
@@ -66,6 +67,34 @@ def register_op(name: str, *, ref: str = "", n_outputs: int = 1, differentiable:
         return api
 
     return deco
+
+
+def _auto_schema(opdef: OpDef) -> None:
+    """Every registered op is DECLARATIVE (the ops.yaml invariant): the
+    decorator itself is the declaration, so derive the OpSchema — args
+    from the signature, doc from the docstring, the SPMD binding from the
+    rules table — unless a richer hand-written schema exists
+    (ops/schema_defs.py registers those through build_ops first)."""
+    import inspect
+
+    from paddle_tpu.ops import schema as _schema
+
+    if opdef.name in _schema._SCHEMAS:
+        return
+    try:
+        raw = str(inspect.signature(opdef.impl))
+        # slice, don't strip: strip("()") also eats the closing paren of a
+        # tuple default, e.g. "(x, k=1, axes=(0, 1))" -> "x, k=1, axes=(0, 1"
+        sig = raw[1:-1] if raw.startswith("(") and raw.endswith(")") else raw
+    except (TypeError, ValueError):
+        sig = "..."
+    from paddle_tpu.ops import spmd_rules as _spmd
+    bound = opdef.name if opdef.name in _spmd.SPMD_RULES else None
+    _schema._SCHEMAS[opdef.name] = _schema.OpSchema(
+        name=opdef.name, impl=opdef.impl, args=sig,
+        doc=(opdef.doc or "").strip(), ref=opdef.ref, spmd=bound,
+        differentiable=opdef.differentiable, n_outputs=opdef.n_outputs,
+        sample=None)
 
 
 def get_op(name: str) -> OpDef:

@@ -73,7 +73,8 @@ class ShardedTrainer:
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  mesh: ProcessMesh, plan: Optional[Dict[str, Sequence]] = None,
                  data_spec: Optional[P] = None, donate: bool = True,
-                 amp_dtype: Optional[str] = None, pass_rules=None):
+                 amp_dtype: Optional[str] = None, pass_rules=None,
+                 offload: str = ""):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -91,6 +92,21 @@ class ShardedTrainer:
         self._multi_step = None
         self._lr_cache = None
         self._seed_dev = None
+        # optimizer-state offload to host memory (group_sharded offload= /
+        # pinned-memory capability, group_sharded_utils.py analog): the
+        # TPU-native form is a pinned_host memory-kind sharding — XLA
+        # streams the states HBM<->host around the update. TPU-only (the
+        # CPU SPMD partitioner cannot compute from host memory).
+        if offload not in ("", "opt"):
+            raise ValueError(f"offload must be '' or 'opt', got {offload!r}")
+        self._offload_opt = False
+        if offload == "opt":
+            if jax.default_backend() != "tpu":
+                import warnings
+                warnings.warn("ShardedTrainer(offload='opt') needs a TPU "
+                              "backend; ignoring", stacklevel=2)
+            else:
+                self._offload_opt = True
 
         state = dict(model.state_dict())
         for name, b in model.named_buffers():
@@ -132,6 +148,8 @@ class ShardedTrainer:
                         sh = self._zero_sharding(p, name, zero_axis) or sh
                 else:
                     sh = NamedSharding(mesh.jax_mesh, P())
+                if self._offload_opt:
+                    sh = sh.with_memory_kind("pinned_host")
                 pst[k] = jax.device_put(v, sh)
                 psh[k] = sh
             self.opt_state[name] = pst
@@ -153,11 +171,24 @@ class ShardedTrainer:
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         state_names, trainable = self.state_names, self.trainable
         wd = getattr(opt, "_weight_decay", 0.0) or 0.0
+        offload = self._offload_opt
+        if offload:
+            dev_shardings = {
+                n: {k: sh.with_memory_kind("device")
+                    for k, sh in per.items()}
+                for n, per in self.opt_shardings.items()}
 
         def step(params, buffers, opt_state, lr, seed, *batch):
             # seed is a DEVICE-resident counter (donated, bumped in-graph):
             # no per-step host->device scalar transfer, which costs a
             # blocking RPC round-trip on tunneled/remote runtimes
+            if offload:
+                # stream the host-resident optimizer states into HBM for
+                # the update; out_shardings put the new states back on host
+                opt_state = {
+                    n: {k: jax.device_put(v, dev_shardings[n][k])
+                        for k, v in per.items()}
+                    for n, per in opt_state.items()}
             def compute_loss(train_params):
                 full = dict(buffers)
                 full.update(train_params)

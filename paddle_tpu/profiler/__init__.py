@@ -12,5 +12,6 @@ from paddle_tpu.profiler.profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent,
     export_chrome_tracing, make_scheduler,
 )
+from paddle_tpu.profiler.merge import merge_chrome_traces  # noqa: F401
 from paddle_tpu.profiler.statistic import SortedKeys, summary  # noqa: F401
 from paddle_tpu.profiler.timer import Benchmark, benchmark  # noqa: F401

@@ -1,0 +1,46 @@
+"""Cross-rank trace merge (tools/CrossStackProfiler capability analog):
+combine per-rank chrome traces into one timeline, one process lane per
+rank, so multi-process runs can be inspected side by side."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional, Sequence
+
+__all__ = ["merge_chrome_traces"]
+
+
+def merge_chrome_traces(paths: Sequence[str], out_path: str,
+                        rank_names: Optional[Sequence[str]] = None) -> dict:
+    """Merge chrome-trace JSON files (one per rank) into ``out_path``.
+
+    Each input's events move to a distinct pid lane (rank index), with a
+    process_name metadata row naming the rank; globs are expanded and
+    sorted so ``merge_chrome_traces(["trace_r*.json"], ...)`` works.
+    Returns the merged dict.
+    """
+    files: List[str] = []
+    for p in paths:
+        hits = sorted(glob.glob(p))
+        files.extend(hits if hits else [p])
+    if not files:
+        raise ValueError("merge_chrome_traces: no input traces")
+    merged = []
+    for rank, path in enumerate(files):
+        with open(path) as f:
+            data = json.load(f)
+        events = data.get("traceEvents", data if isinstance(data, list) else [])
+        name = (rank_names[rank] if rank_names and rank < len(rank_names)
+                else f"rank {rank} ({os.path.basename(path)})")
+        merged.append({"ph": "M", "pid": rank, "name": "process_name",
+                       "args": {"name": name}})
+        for e in events:
+            e = dict(e)
+            e["pid"] = rank
+            merged.append(e)
+    out = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    return out

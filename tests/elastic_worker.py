@@ -1,0 +1,91 @@
+"""Worker for test_elastic_e2e: trains with per-step sharded checkpoints;
+spans launcher incarnations (PADDLE_RESTART_COUNT) and world sizes
+(PADDLE_TRAINERS_NUM: 2-rank jax.distributed job, or single-rank after a
+scale-down). Appends (step, loss) lines to {outdir}/losses_r{rank}.log."""
+
+import json
+import os
+import sys
+import time
+
+
+def main(outdir, ckpt_dir, total_steps):
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import init_parallel_env
+    if n > 1:
+        init_parallel_env()
+    else:
+        # single rank: plain local CPU devices
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices",
+                          int(os.environ.get("PADDLE_NUM_CPU_DEVICES", "2")))
+    import jax
+
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    ndev = jax.device_count()
+    mesh = init_mesh((ndev,), ("dp",))
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=net.parameters())
+    tr = ShardedTrainer(net, opt, lambda m, x, y: F.cross_entropy(m(x), y),
+                        mesh, {})
+
+    # versioned checkpoints + atomic 'latest' pointer: a kill mid-save can
+    # never corrupt the resume point
+    latest = os.path.join(ckpt_dir, "latest.txt")
+    start = 0
+    if os.path.exists(latest):
+        with open(latest) as f:
+            cdir = f.read().strip()
+        sd = tr.state_dict()
+        sd["meta.step"] = Tensor(np.zeros((), np.int64))
+        ckpt.load_state_dict(sd, cdir)
+        for name in tr.trainable:
+            for k in tr.opt_state[name]:
+                tr.opt_state[name][k] = jax.device_put(
+                    sd[f"opt.{name}.{k}"].value, tr.opt_shardings[name][k])
+        start = int(np.asarray(sd["meta.step"].value)) + 1
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(8, 8)).astype(np.float32)   # same global batch in
+    Y = rng.integers(0, 4, (8,))                     # every world size
+    per = 8 // n
+    Xl, Yl = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+
+    log = open(os.path.join(outdir, f"losses_r{rank}.log"), "a")
+    with mesh:
+        for step in range(start, total_steps):
+            loss = float(tr.train_step(Xl, Yl).numpy())
+            log.write(json.dumps({"inc": incarnation, "step": step,
+                                  "loss": loss}) + "\n")
+            log.flush()
+            sd = tr.state_dict()
+            sd["meta.step"] = Tensor(np.asarray(step, np.int64))
+            cdir = os.path.join(ckpt_dir, f"step{step}")
+            ckpt.save_state_dict(sd, cdir)
+            if rank == 0:   # save_state_dict syncs: all rank files exist
+                tmp = latest + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(cdir)
+                os.replace(tmp, latest)
+            time.sleep(0.25)
+    log.close()
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main(sys.argv[1], sys.argv[2], int(sys.argv[3]))

@@ -1,0 +1,121 @@
+"""Distribution transforms + TransformedDistribution (VERDICT round-3
+item 10; reference python/paddle/distribution/transform.py +
+transformed_distribution.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def _numeric_ldj(t, x, eps=1e-4):
+    """Central-difference log|f'(x)| for elementwise transforms."""
+    f = lambda a: t.forward(paddle.to_tensor(a.astype(np.float32))).numpy()
+    d = (f(x + eps) - f(x - eps)) / (2 * eps)
+    return np.log(np.abs(d))
+
+
+ELEMENTWISE = [
+    (D.ExpTransform(), np.linspace(-1.2, 1.2, 7)),
+    (D.TanhTransform(), np.linspace(-1.5, 1.5, 7)),
+    (D.SigmoidTransform(), np.linspace(-2.0, 2.0, 7)),
+    (D.AffineTransform(loc=0.5, scale=-2.5), np.linspace(-1.0, 1.0, 7)),
+    (D.PowerTransform(3.0), np.linspace(0.2, 2.0, 7)),
+]
+
+
+@pytest.mark.parametrize("t,x", ELEMENTWISE,
+                         ids=lambda v: type(v).__name__ if isinstance(
+                             v, D.Transform) else None)
+def test_elementwise_roundtrip_and_ldj(t, x):
+    x = x.astype(np.float32)
+    xt = paddle.to_tensor(x)
+    y = t.forward(xt)
+    back = t.inverse(y).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+    ldj = t.forward_log_det_jacobian(xt).numpy()
+    np.testing.assert_allclose(ldj, _numeric_ldj(t, x), rtol=1e-3, atol=5e-4)
+    # inverse ldj is the negated forward ldj at the preimage
+    ildj = t.inverse_log_det_jacobian(y).numpy()
+    np.testing.assert_allclose(ildj, -ldj, rtol=1e-4, atol=1e-5)
+
+
+def test_chain_and_independent():
+    chain = D.ChainTransform([D.AffineTransform(1.0, 2.0), D.ExpTransform()])
+    x = paddle.to_tensor(np.linspace(-1, 1, 6).reshape(2, 3).astype(np.float32))
+    y = chain.forward(x)
+    np.testing.assert_allclose(y.numpy(), np.exp(1.0 + 2.0 * x.numpy()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(chain.inverse(y).numpy(), x.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    ldj = chain.forward_log_det_jacobian(x).numpy()
+    # log|d/dx exp(1+2x)| = log 2 + 1 + 2x
+    np.testing.assert_allclose(ldj, np.log(2.0) + 1.0 + 2.0 * x.numpy(),
+                               rtol=1e-5)
+
+    ind = D.IndependentTransform(D.ExpTransform(), 1)
+    ldj_i = ind.forward_log_det_jacobian(x).numpy()
+    np.testing.assert_allclose(ldj_i, x.numpy().sum(-1), rtol=1e-6)
+
+
+def test_stickbreaking_simplex_and_roundtrip():
+    t = D.StickBreakingTransform()
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 3)).astype(np.float32))
+    y = t.forward(x).numpy()
+    assert y.shape == (4, 4)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+    assert (y > 0).all()
+    np.testing.assert_allclose(t.inverse(paddle.to_tensor(y)).numpy(),
+                               x.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_reshape_and_stack():
+    rt = D.ReshapeTransform((4,), (2, 2))
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = rt.forward(x)
+    assert tuple(y.shape) == (2, 2, 2)
+    np.testing.assert_allclose(rt.inverse(y).numpy(), x.numpy())
+    assert rt.forward_shape((5, 4)) == (5, 2, 2)
+
+    st = D.StackTransform([D.ExpTransform(), D.TanhTransform()], axis=0)
+    x2 = paddle.to_tensor(np.ones((2, 3), np.float32) * 0.3)
+    y2 = st.forward(x2).numpy()
+    np.testing.assert_allclose(y2[0], np.exp(0.3 * np.ones(3)), rtol=1e-5)
+    np.testing.assert_allclose(y2[1], np.tanh(0.3 * np.ones(3)), rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal_parity():
+    """Normal + ExpTransform == LogNormal (both ours and torch's)."""
+    import torch
+
+    base = D.Normal(loc=np.float32(0.3), scale=np.float32(0.8))
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    v = np.array([0.3, 0.9, 2.1], np.float32)
+    got = td.log_prob(paddle.to_tensor(v)).numpy()
+    want = torch.distributions.LogNormal(0.3, 0.8).log_prob(
+        torch.tensor(v)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # our own LogNormal family agrees too
+    ln = D.LogNormal(loc=np.float32(0.3), scale=np.float32(0.8))
+    np.testing.assert_allclose(got, ln.log_prob(paddle.to_tensor(v)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    # samples land in the support and are reparameterized
+    s = td.rsample((1000,))
+    assert (s.numpy() > 0).all()
+
+
+def test_transformed_distribution_tanh_normal():
+    """Tanh-squashed Gaussian (SAC policy form) vs torch."""
+    import torch
+
+    base = D.Normal(loc=np.float32(0.0), scale=np.float32(1.0))
+    td = D.TransformedDistribution(base, [D.TanhTransform()])
+    v = np.array([-0.9, -0.2, 0.5, 0.95], np.float32)
+    got = td.log_prob(paddle.to_tensor(v)).numpy()
+    tt = torch.distributions.TransformedDistribution(
+        torch.distributions.Normal(0.0, 1.0),
+        [torch.distributions.transforms.TanhTransform()])
+    want = tt.log_prob(torch.tensor(v)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,120 @@
+"""Elastic end-to-end (VERDICT round-3 item 9): the REAL launcher runs 2
+worker nodes; node 1 is killed; node 0's elastic agent TTL-detects the
+loss, terminates its worker, rewrites PADDLE_* env (2 ranks -> 1), and
+relaunches; training resumes from the distributed checkpoint with loss
+continuity.
+
+Reference bar: python/paddle/distributed/fleet/elastic/manager.py:124
+(watch membership -> rewrite endpoints -> restart)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TOTAL_STEPS = 14
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_elastic_launcher_restart_and_resume(tmp_path):
+    from paddle_tpu.native.tcp_store import TCPStore
+
+    store_port = _free_port()
+    job_port = _free_port()
+    store = TCPStore("127.0.0.1", store_port, is_master=True, world_size=1)
+
+    outdir = tmp_path / "out"
+    ckpt = tmp_path / "ckpt"
+    outdir.mkdir()
+    ckpt.mkdir()
+
+    def spawn_launcher(node_rank):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PADDLE_MASTER": f"127.0.0.1:{job_port}",
+            "PADDLE_NUM_CPU_DEVICES": "2",
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "1:2", "--node_rank", str(node_rank),
+             "--master", f"127.0.0.1:{job_port}",
+             "--elastic_store", f"127.0.0.1:{store_port}",
+             "--elastic_ttl", "2.0",
+             "--log_dir", str(tmp_path / f"log{node_rank}"),
+             "--max_restarts", "5",
+             os.path.join(HERE, "elastic_worker.py"),
+             str(outdir), str(ckpt), str(TOTAL_STEPS)],
+            env=env, cwd=REPO, start_new_session=True)
+
+    l0 = spawn_launcher(0)
+    l1 = spawn_launcher(1)
+    try:
+        # wait for joint training to make real progress (checkpoint of
+        # step >= 3) so the continuity assertion has a trajectory
+        deadline = time.time() + 240
+        latest = ckpt / "latest.txt"
+
+        def _ckpt_step():
+            try:
+                return int(latest.read_text().strip().rsplit("step", 1)[1])
+            except (FileNotFoundError, ValueError, IndexError):
+                return -1
+
+        while time.time() < deadline and _ckpt_step() < 3:
+            time.sleep(0.5)
+        assert _ckpt_step() >= 3, "2-rank training never reached step 3"
+
+        # preempt node 1: kill its whole process group (launcher + worker)
+        os.killpg(l1.pid, signal.SIGKILL)
+
+        # node 0's agent must detect, rewrite env to 1 rank, relaunch, and
+        # the worker must finish all steps from the checkpoint
+        rc = l0.wait(timeout=300)
+        assert rc == 0, f"surviving launcher exited {rc}"
+    finally:
+        for p in (l0, l1):
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        store.close() if hasattr(store, "close") else None
+
+    rows = [json.loads(line)
+            for line in (outdir / "losses_r0.log").read_text().splitlines()]
+    incs = {r["inc"] for r in rows}
+    assert len(incs) >= 2, f"no restart happened: {incs}"
+    # steps are contiguous across incarnations: resumed from the checkpoint
+    last_inc = max(incs)
+    first_resumed = min(r["step"] for r in rows if r["inc"] == last_inc)
+    pre = [r for r in rows if r["inc"] < last_inc]
+    last_pre = max(r["step"] for r in pre)
+    assert 0 < first_resumed <= last_pre + 1, (first_resumed, last_pre)
+    assert max(r["step"] for r in rows) == TOTAL_STEPS - 1
+    # loss continuity: the resumed loss continues the trajectory (well
+    # below the from-scratch initial loss, close to the pre-kill level)
+    first_loss = rows[0]["loss"]
+    resumed_losses = [r["loss"] for r in rows if r["inc"] == last_inc]
+    pre_losses = [r["loss"] for r in pre]
+    assert resumed_losses[0] < first_loss * 0.9, (
+        first_loss, resumed_losses[0])
+    assert abs(resumed_losses[0] - pre_losses[-1]) < 0.5 * first_loss
+    # and it keeps improving
+    assert resumed_losses[-1] <= resumed_losses[0] + 1e-3

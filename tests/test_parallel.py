@@ -218,3 +218,35 @@ def test_uneven_shard_training_and_grads():
         np.testing.assert_array_equal(t2.numpy(), np.ones((7, 3)))
     finally:
         set_mesh(None)
+
+
+def test_offload_opt_requires_tpu_and_warns_on_cpu():
+    """offload='opt' (group_sharded offload capability): host-memory
+    optimizer states are a TPU memory-kind feature; on CPU the trainer
+    warns and trains normally."""
+    import warnings
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.parallel.train import ShardedTrainer
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    mesh = init_mesh((8,), ("dp",))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tr = ShardedTrainer(net, opt, lambda m, x, y: F.cross_entropy(m(x), y),
+                            mesh, {}, offload="opt")
+    assert any("TPU backend" in str(ww.message) for ww in w)
+    rng = np.random.default_rng(0)
+    with mesh:
+        loss = tr.train_step(rng.normal(size=(8, 4)).astype(np.float32),
+                             rng.integers(0, 4, (8,)))
+    assert np.isfinite(float(loss.numpy()))
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ShardedTrainer(net, opt, lambda m, x, y: 0, mesh, {}, offload="xyz")

@@ -153,3 +153,27 @@ def test_rule_predictions_match_gspmd():
     got = tuple(out.sharding.spec) + (None,) * (
         1 - len(tuple(out.sharding.spec)))
     assert got[0] == axis_name.get(pred[0].dims_mapping[0])
+
+
+def test_every_registered_op_has_a_schema():
+    """ops.yaml invariant (VERDICT round-3 item 2): every op in the
+    registry is declarative — len(_SCHEMAS) == len(OPS), describe()
+    renders docs for each, and ops with an SPMD rule carry the binding."""
+    import paddle_tpu as paddle
+    for _ns in ("incubate", "fft", "signal", "quantization", "sparse",
+                "linalg", "geometric", "text", "audio", "distribution"):
+        getattr(paddle, _ns)
+    from paddle_tpu.ops import spmd_rules as R
+    from paddle_tpu.ops.registry import OPS
+    from paddle_tpu.ops.schema import _SCHEMAS, describe, get_schema
+
+    missing = sorted(set(OPS) - set(_SCHEMAS))
+    assert not missing, f"ops without schema: {missing}"
+    assert len(_SCHEMAS) >= len(OPS)
+    for name in OPS:
+        s = get_schema(name)
+        text = describe(name)
+        assert name in text and s.args is not None
+        if name in R.SPMD_RULES:
+            # the schema must reflect the SPMD-rule binding
+            assert s.spmd is not None, f"{name}: rule exists, schema unbound"

@@ -601,3 +601,103 @@ def test_weight_only_quant_roundtrip_and_linear():
 
     with pytest.raises(NotImplementedError):
         Q.weight_quantize(w, algo="int4")
+
+
+def test_to_static_graph_break_falls_back_to_eager():
+    """Data-dependent Python control flow (the reference SOT's
+    guard+fallback territory, jit/sot/opcode_translator): to_static must
+    not crash — it falls back to eager per call with a one-time warning
+    and counts the break in STAT_* (to_static_graph_breaks)."""
+    import warnings
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.monitor import stat_get, stat_reset
+
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if float(h.sum()) > 0:       # breaks the trace
+                return h * 2
+            return h - 1
+
+    stat_reset("to_static_graph_breaks")
+    m = Branchy()
+    st = paddle.jit.to_static(m)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = st(x)
+        out2 = st(x)
+    ref = m(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy())
+    np.testing.assert_allclose(out2.numpy(), ref.numpy())
+    assert stat_get("to_static_graph_breaks") == 2
+    assert sum("falling back to EAGER" in str(ww.message) for ww in w) == 1
+    # a traceable function still compiles through the normal path
+    st2 = paddle.jit.to_static(lambda t: t * 2 + 1)
+    np.testing.assert_allclose(st2(x).numpy(), x.numpy() * 2 + 1)
+
+
+def test_merge_chrome_traces(tmp_path):
+    """Cross-rank timeline merge (tools/CrossStackProfiler capability):
+    per-rank traces land in distinct pid lanes with named processes."""
+    import json
+
+    from paddle_tpu.profiler import merge_chrome_traces
+
+    for r in range(2):
+        with open(tmp_path / f"trace_r{r}.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"ph": "X", "pid": 99, "tid": 1, "name": f"op{r}",
+                 "ts": r * 10, "dur": 5}]}, f)
+    out = merge_chrome_traces([str(tmp_path / "trace_r*.json")],
+                              str(tmp_path / "merged.json"))
+    events = out["traceEvents"]
+    metas = [e for e in events if e.get("ph") == "M"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert len(metas) == 2 and "rank 0" in metas[0]["args"]["name"]
+    assert json.load(open(tmp_path / "merged.json"))["traceEvents"]
+
+
+def test_auto_tuner_memory_model_and_stages():
+    """estimate_memory (memory_cost_model.py analog): ZeRO stages shard
+    the right terms, recompute/sep shrink activations, and the pruner
+    drops over-budget configs while keeping the sharded ones."""
+    from paddle_tpu.distributed.auto_tuner import (
+        Candidate, default_candidates, estimate_memory, prune_by_memory)
+
+    P = 8 << 30  # 8 GB of params (bf16 4B-equivalent units are irrelevant)
+    base = estimate_memory(Candidate(dp=4), P)
+    z1 = estimate_memory(Candidate(dp=4, sharding_stage=1), P)
+    z2 = estimate_memory(Candidate(dp=4, sharding_stage=2), P)
+    z3 = estimate_memory(Candidate(dp=4, sharding_stage=3), P)
+    assert z1["optimizer"] == base["optimizer"] / 4
+    assert z2["grads"] == base["grads"] / 4 and z2["params"] == base["params"]
+    assert z3["params"] == base["params"] / 4
+    assert base["total"] > z1["total"] > z2["total"] > z3["total"]
+    # activations: recompute factor + 1F1B in-flight bound + sep sharding
+    act = 1 << 30
+    a0 = estimate_memory(Candidate(pp=2, micro_batches=8), P, act)
+    assert a0["activations"] == act * 4            # min(2*pp, mb) = 4
+    a1 = estimate_memory(Candidate(pp=2, micro_batches=8,
+                                   use_recompute=True), P, act)
+    assert a1["activations"] < a0["activations"]
+    a2 = estimate_memory(Candidate(pp=2, micro_batches=8, sep=2), P, act)
+    assert a2["activations"] == a0["activations"] / 2
+
+    # a model too big for plain dp must survive only via sharded configs
+    cands = [Candidate(dp=8), Candidate(dp=8, sharding_stage=3)]
+    kept = prune_by_memory(cands, param_bytes=12 << 30, hbm_bytes=16 << 30)
+    assert [c.sharding_stage for c in kept] == [3]
+    assert all("est_bytes" in c.metrics for c in cands)
+
+    # the grid now spans ZeRO stages and prunes pp with mb=1
+    grid = default_candidates(n_devices=8, num_layers=4, batch_size=8,
+                              heads=4)
+    assert any(c.sharding_stage == 3 for c in grid)
+    assert not any(c.pp > 1 and c.micro_batches < 2 for c in grid)
