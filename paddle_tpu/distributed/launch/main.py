@@ -79,6 +79,11 @@ def _wait_quorum(elastic, args) -> List[str]:
     while len(members) < np_min and time.time() < deadline:
         time.sleep(0.2)
         members = elastic._alive_nodes()
+    if len(members) < np_min:
+        raise RuntimeError(
+            f"elastic quorum not reached: {len(members)}/{np_min} nodes "
+            f"alive after {max(30.0, 3 * args.elastic_ttl):.0f}s "
+            f"(members={members})")
     settle_end = time.time() + 2 * 0.3  # two heartbeat periods
     while len(members) < np_max and time.time() < settle_end:
         time.sleep(0.2)

@@ -137,14 +137,15 @@ class ChainTransform(Transform):
         return y
 
     def _forward_log_det_jacobian(self, x):
+        # every term reduces to the chain's BATCH rank (input rank minus
+        # the chain's domain event dim) — intermediate values may change
+        # rank (Reshape), so reducing by per-transform deltas misaligns
+        batch_rank = x.ndim - self._domain_event_dim
         total = None
         for t in self.transforms:
             ld = t._forward_log_det_jacobian(x)
-            # reduce finer-grained ldj over the extra event dims so terms
-            # from transforms with different event ranks line up
-            extra = self._domain_event_dim - t._domain_event_dim
-            if extra > 0 and ld.ndim >= extra:
-                ld = ld.sum(axis=tuple(range(ld.ndim - extra, ld.ndim)))
+            if ld.ndim > batch_rank:
+                ld = ld.sum(axis=tuple(range(batch_rank, ld.ndim)))
             total = ld if total is None else total + ld
             x = t._forward(x)
         return total

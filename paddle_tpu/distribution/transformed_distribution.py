@@ -34,7 +34,10 @@ class TransformedDistribution:
         return tuple(out[len(out) - n:]) if n > 0 else ()
 
     def sample(self, shape=()):
-        return self.rsample(shape).detach()
+        # base.sample, not rsample: non-reparameterized bases (Gamma,
+        # Beta, Categorical, ...) only implement sample
+        x = self.base.sample(shape)
+        return self._chain.forward(x).detach()
 
     def rsample(self, shape=()):
         x = self.base.rsample(shape)

@@ -142,6 +142,10 @@ define_flag("use_fused_lm_ce", True,
             "logits")
 define_flag("use_ring_attention", True,
             "use ring (context-parallel) attention when the mesh has a sep>1 axis")
+define_flag("use_decode_attention", True,
+            "route single-token GQA cache attention through the Pallas "
+            "decode kernel (ops/pallas/decode_attention.py); MHA (no "
+            "head sharing) stays on XLA, which is faster there")
 define_flag("decode_cache_layout", "stacked",
             "KV-cache layout for the compiled decoder: 'per_layer' "
             "(one (B, L, KV, D) buffer per layer) or 'stacked' "

@@ -4,17 +4,20 @@ Capability analog of the reference's decode stack —
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
 (block-table KV cache attention) and the fused generation ops — in the
 TPU-native form: a PURE functional forward with a statically-shaped KV
-cache — stacked ``(L, B, max_len, KV, D)`` by default, or one
-``(B, max_len, KV, D)`` buffer per layer via
+cache — token-major ``(B, max_len, KV, D)`` for MHA, head-major
+``(B, KV, max_len, D)`` for GQA (the decode-kernel layout); stacked over
+layers by default, or one buffer per layer via
 ``flags.decode_cache_layout='per_layer'`` (measured equal-or-slower on
 v5e; kept as a tuning knob) — so prefill and every decode step are each
 ONE cached-compile XLA program (no recompiles across steps; static shapes
 are what the MXU wants). Block tables are unnecessary: XLA owns memory, and
 a padded dense cache + position mask is the layout it tiles best.
 
-Decode attention is a masked dense read of the cache — at sq=1 this is a
-bandwidth-bound matvec XLA fuses well; the Pallas flash kernel covers
-chunked prefill (bottom-right-aligned causal, sq != sk).
+Decode attention: MHA runs XLA's masked dense read (a bandwidth-bound
+matvec it fuses well); GQA routes through the Pallas decode-attention
+kernel (ops/pallas/decode_attention.py — no repeated-KV
+materialization). The Pallas flash kernel covers chunked prefill
+(bottom-right-aligned causal, sq != sk).
 """
 
 from __future__ import annotations
@@ -89,32 +92,58 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
     q = _rope_at(q, pos, cfg, p)
     k = _rope_at(k, pos, cfg, p)
 
+    rep = H // KV
+    head_major = rep > 1   # GQA: (B, KV, L, D) tiles feed the Pallas
+    #                        kernel; MHA keeps token-major (B, L, KV, D),
+    #                        which XLA's fused matvec prefers (measured)
+    kt = jnp.swapaxes(k, 1, 2) if head_major else k
+    vt = jnp.swapaxes(v, 1, 2) if head_major else v
+    at = (0, 0, pos, 0) if head_major else (0, pos, 0, 0)
     if isinstance(kc, tuple):
-        # per-layer cache buffers: a DUS on THIS layer's (B, max_len, KV, D)
-        # array only
-        kc_l = jax.lax.dynamic_update_slice(kc[li], k, (0, pos, 0, 0))
-        vc_l = jax.lax.dynamic_update_slice(vc[li], v, (0, pos, 0, 0))
+        # per-layer cache buffers: a DUS on THIS layer's array only
+        kc_l = jax.lax.dynamic_update_slice(kc[li], kt, at)
+        vc_l = jax.lax.dynamic_update_slice(vc[li], vt, at)
         kc = tuple(kc_l if i == li else c for i, c in enumerate(kc))
         vc = tuple(vc_l if i == li else c for i, c in enumerate(vc))
     else:
-        # stacked (L, B, max_len, KV, D) cache
-        kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, pos, 0, 0))
+        kc = jax.lax.dynamic_update_slice(kc, kt[None], (li,) + at)
+        vc = jax.lax.dynamic_update_slice(vc, vt[None], (li,) + at)
         kc_l, vc_l = kc[li], vc[li]
 
-    rep = H // KV
-    kk, vv = kc_l, vc_l                           # (B, max_len, KV, D)
-    if rep > 1:
-        kk = jnp.repeat(kk, rep, axis=2)
-        vv = jnp.repeat(vv, rep, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(
-        jnp.float32(D)).astype(q.dtype)
-    kpos = jnp.arange(max_len)[None, None, None, :]
-    qpos = pos + jnp.arange(S)[None, None, :, None]
-    mask = kpos <= qpos                           # bottom-right causal
-    scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
-    attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", attn, vv).reshape(B, S, H * D)
+    from paddle_tpu.flags import flags as _flags
+    from paddle_tpu.ops.pallas import decode_attention as _da
+    use_kernel = (head_major and S == 1 and _flags.use_decode_attention
+                  and jax.default_backend() == "tpu"
+                  and _da.supported(q[:, 0], kc_l))
+    if use_kernel:
+        # one-kernel GQA cache attention (block_multi_head_attention
+        # capability): no repeated-KV materialization, online softmax,
+        # compute skipped past the valid prefix. Measured (v5e, B=8
+        # D=64): 8-way GQA L=4096 0.24 ms vs 0.88 ms XLA; 4-way L=8192
+        # 0.60 ms vs 2.06 ms; ~1B GQA4 end-to-end 2.98 vs 7.08 ms/tok.
+        out = _da.decode_attention(q[:, 0], kc_l, vc_l,
+                                   pos + 1).reshape(B, S, H * D)
+    elif head_major:
+        kk = jnp.repeat(kc_l, rep, axis=1)
+        vv = jnp.repeat(vc_l, rep, axis=1)
+        scores = jnp.einsum("bqhd,bhkd->bhqk", q, kk) / jnp.sqrt(
+            jnp.float32(D)).astype(q.dtype)
+        kpos = jnp.arange(max_len)[None, None, None, :]
+        qpos = pos + jnp.arange(S)[None, None, :, None]
+        mask = kpos <= qpos                       # bottom-right causal
+        scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bqhd", attn, vv).reshape(B, S, H * D)
+    else:
+        kk, vv = kc_l, vc_l                       # (B, max_len, KV, D)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(
+            jnp.float32(D)).astype(q.dtype)
+        kpos = jnp.arange(max_len)[None, None, None, :]
+        qpos = pos + jnp.arange(S)[None, None, :, None]
+        mask = kpos <= qpos                       # bottom-right causal
+        scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", attn, vv).reshape(B, S, H * D)
     h = h + _mm(out, p, pre + "self_attn.o_proj.weight")
 
     x = rms(h, p[pre + "post_attention_layernorm.weight"])
@@ -250,11 +279,15 @@ class LlamaDecoder:
             raise ValueError(
                 f"decode_cache_layout must be 'stacked' or 'per_layer', "
                 f"got {flags.decode_cache_layout!r}")
+        head_major = cfg.num_attention_heads != cfg.num_key_value_heads
+        if head_major:
+            per = (B, cfg.num_key_value_heads, self.max_len, cfg.head_dim)
+        else:
+            per = (B, self.max_len, cfg.num_key_value_heads, cfg.head_dim)
         if flags.decode_cache_layout == "stacked":
-            shape = (cfg.num_hidden_layers, B, self.max_len,
-                     cfg.num_key_value_heads, cfg.head_dim)
+            shape = (cfg.num_hidden_layers,) + per
             return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
-        shape = (B, self.max_len, cfg.num_key_value_heads, cfg.head_dim)
+        shape = per
         zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
                               for _ in range(cfg.num_hidden_layers))
         return zeros(), zeros()

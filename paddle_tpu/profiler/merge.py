@@ -31,7 +31,9 @@ def merge_chrome_traces(paths: Sequence[str], out_path: str,
     for rank, path in enumerate(files):
         with open(path) as f:
             data = json.load(f)
-        events = data.get("traceEvents", data if isinstance(data, list) else [])
+        # chrome traces come as {"traceEvents": [...]} or a bare array
+        events = data if isinstance(data, list) else \
+            data.get("traceEvents", [])
         name = (rank_names[rank] if rank_names and rank < len(rank_names)
                 else f"rank {rank} ({os.path.basename(path)})")
         merged.append({"ph": "M", "pid": rank, "name": "process_name",

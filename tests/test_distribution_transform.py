@@ -119,3 +119,19 @@ def test_transformed_distribution_tanh_normal():
         [torch.distributions.transforms.TanhTransform()])
     want = tt.log_prob(torch.tensor(v)).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_chain_with_rank_change_and_nonreparam_sample():
+    """Review regressions: a rank-changing chain reduces every ldj term to
+    the batch rank; sample() works on non-reparameterized bases."""
+    chain = D.ChainTransform([D.ReshapeTransform((4,), (2, 2)),
+                              D.ExpTransform()])
+    x = paddle.to_tensor(np.linspace(-1, 1, 12).reshape(3, 4)
+                         .astype(np.float32))
+    ldj = chain.forward_log_det_jacobian(x).numpy()
+    assert ldj.shape == (3,)
+    np.testing.assert_allclose(ldj, x.numpy().sum(-1), rtol=1e-5, atol=1e-6)
+
+    td = D.TransformedDistribution(D.Gamma(2.0, 1.0), [D.ExpTransform()])
+    s = td.sample((64,))
+    assert s.shape[0] == 64 and (s.numpy() > 1.0 - 1e-6).all()

@@ -204,3 +204,34 @@ def test_int8_matmul_kernel_matches_dequant():
     # routing guards: big row counts / unaligned shapes are not eligible
     assert not supported(jnp.zeros((128, 256)), jnp.zeros((256, 512), jnp.int8))
     assert not supported(jnp.zeros((8, 200)), jnp.zeros((200, 512), jnp.int8))
+
+
+def test_decode_attention_kernel_interpret_parity():
+    """ops/pallas/decode_attention (block_multi_head_attention capability):
+    interpret-mode parity with the masked dense reference, incl. GQA and
+    dynamic valid-length masking."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.decode_attention import (
+        decode_attention, supported)
+
+    rng = np.random.default_rng(0)
+    B, L, D = 2, 256, 8
+    for KV, H in ((4, 4), (2, 6)):
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        kc = jnp.asarray(rng.standard_normal((B, KV, L, D)), jnp.float32)
+        vc = jnp.asarray(rng.standard_normal((B, KV, L, D)), jnp.float32)
+        assert supported(q, kc)
+        for pos in (1, 100, L):
+            got = np.asarray(decode_attention(q, kc, vc, pos, block_l=128))
+            rep = H // KV
+            kk = jnp.repeat(kc, rep, 1) if rep > 1 else kc
+            vv = jnp.repeat(vc, rep, 1) if rep > 1 else vc
+            s = jnp.einsum("bhd,bhkd->bhk", q, kk) / np.sqrt(D)
+            s = jnp.where(jnp.arange(L)[None, None, :] < pos, s, -jnp.inf)
+            want = np.asarray(jnp.einsum("bhk,bhkd->bhd",
+                                         jax.nn.softmax(s, -1), vv))
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"KV={KV} pos={pos}")
+    assert not supported(jnp.zeros((2, 5, 8)), jnp.zeros((2, 2, 256, 8)))

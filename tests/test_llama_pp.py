@@ -50,6 +50,7 @@ def test_pp_trainer_loss_decreases_and_matches_eager_init():
     assert abs(l_after - losses[-1]) < 0.5
 
 
+@pytest.mark.slow
 def test_pp_trainer_1f1b_schedule_parity():
     """1F1B schedule (VERDICT item 4): init-loss parity with eager and
     training progress on the hybrid dp x pp x mp mesh."""

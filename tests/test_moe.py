@@ -190,6 +190,7 @@ def test_ragged_dispatch_never_drops_tokens():
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ragged_ep_matches_single_device_ragged():
     """Dropless expert parallelism: the shard_map ragged path over an ep
     mesh must equal the single-device ragged path bit-for-near-bit

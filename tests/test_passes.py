@@ -230,6 +230,7 @@ def test_to_static_build_strategy_applies_fusion():
     assert any(getattr(r, "hits", 0) > 0 for r in static_layer._pass_rules)
 
 
+@pytest.mark.slow
 def test_sharded_trainer_pass_rules_numerics_parity():
     """Pass rules plug into the compiled SPMD train step (the auto-parallel
     pass-pipeline hook): losses match the un-rewritten trainer."""

@@ -97,6 +97,7 @@ def test_pipeline_train_step_end_to_end(mesh):
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow
 def test_microbatch_io_sharded_over_pp(mesh):
     """Per-stage micro-batch IO (VERDICT weak #5 fix): with M % S == 0 the
     pipeline output is pp-sharded on the micro-batch dim — each rank holds
@@ -130,6 +131,7 @@ def test_microbatch_io_sharded_over_pp(mesh):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_microbatch_io_sharded_interleaved(mesh):
     """VPP path gets the same sharded micro-batch IO as the base pipeline."""
     rng = np.random.default_rng(1)

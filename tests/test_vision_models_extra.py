@@ -27,6 +27,7 @@ def _smoke(model, side=64, n_classes=10, batch=2, train_step=True):
     return main
 
 
+@pytest.mark.slow
 def test_alexnet():
     _smoke(M.alexnet(num_classes=10), side=64)
 
@@ -37,6 +38,7 @@ def test_squeezenet_both_versions():
     _smoke(M.squeezenet1_1(num_classes=10), side=64, train_step=False)
 
 
+@pytest.mark.slow
 def test_shufflenetv2_smallest():
     _smoke(M.shufflenet_v2_x0_25(num_classes=10), side=64)
 
@@ -56,6 +58,7 @@ def test_densenet121():
     _smoke(M.densenet121(num_classes=10), side=64)
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads():
     model = M.googlenet(num_classes=10)
     rng = np.random.default_rng(0)
