@@ -27,6 +27,42 @@ from paddle_tpu.autograd.tape import no_grad, enable_grad, is_grad_enabled, set_
 
 # op surface: paddle_tpu.matmul(...), paddle_tpu.add(...), ...
 from paddle_tpu.ops import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import unfold_axis as unfold  # noqa: F401
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    from paddle_tpu.nn import functional as _F
+    return _F.label_smooth(label, prior_dist=prior_dist, epsilon=epsilon)
+
+
+def rank(x):
+    """Number of dimensions as a 0-D int64 tensor (paddle.rank)."""
+    import numpy as _np
+    return to_tensor(_np.asarray(len(x.shape), _np.int64))
+
+
+def increment(x, value=1.0):
+    """In-place x += value (paddle.increment: loop-counter semantics)."""
+    import jax.numpy as _jnp
+    x._set_value(x._value + _jnp.asarray(value, x._value.dtype))
+    return x
+
+
+def get_default_dtype() -> str:
+    from paddle_tpu.flags import flags as _flags
+    return _flags.default_dtype
+
+
+def set_default_dtype(d) -> None:
+    from paddle_tpu.flags import flags as _flags
+    name = getattr(d, "__name__", None) or getattr(d, "name", None) or str(d)
+    name = name.replace("paddle.", "")
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(
+            f"set_default_dtype only supports float16/bfloat16/float32/"
+            f"float64, got {d!r}")
+    _flags.set("default_dtype", name)
+
 from paddle_tpu import ops  # noqa: F401
 
 from paddle_tpu import autograd  # noqa: F401

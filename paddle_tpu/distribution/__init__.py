@@ -44,6 +44,7 @@ from paddle_tpu.distribution.transform import (  # noqa: E402,F401
 from paddle_tpu.distribution.transformed_distribution import (  # noqa: E402,F401
     TransformedDistribution,
 )
+from paddle_tpu.distribution import constraint  # noqa: E402,F401
 
 _HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
 

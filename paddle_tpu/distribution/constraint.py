@@ -1,0 +1,54 @@
+"""Support constraints (python/paddle/distribution/constraint.py analog):
+predicates over parameter/sample supports, used by variable transforms and
+distribution validation."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["Constraint", "Real", "Range", "Positive", "Simplex",
+           "real", "positive", "simplex"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _v(value)
+        return Tensor(v == v)
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        v = _v(value)
+        return Tensor((_v(self._lower) <= v) & (v <= _v(self._upper)))
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return Tensor(_v(value) >= 0.0)
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _v(value)
+        return Tensor(jnp.all(v >= 0, axis=-1)
+                      & (jnp.abs(v.sum(-1) - 1.0) < 1e-6))
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

@@ -23,6 +23,7 @@ __all__ = [
     "nonzero", "unique", "repeat_interleave", "unstack", "moveaxis",
     "swapaxes", "as_complex", "as_real", "diagonal", "diag", "diag_embed",
     "tril", "triu", "rot90", "one_hot", "pad", "crop", "tensordot",
+    "scatter_nd", "unfold_axis",
 ]
 
 
@@ -429,3 +430,34 @@ def crop(x, shape, offsets=None):
 @register_op("tensordot")
 def tensordot(x, y, axes=2):
     return jnp.tensordot(x, y, axes=axes)
+
+
+@register_op("scatter_nd",
+             ref="python/paddle/tensor/manipulation.py:3885")
+def scatter_nd(index, updates, shape):
+    """Scatter-add updates into zeros(shape) at nd indices (duplicates
+    sum, paddle semantics)."""
+    depth = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(depth))
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    return out.at[idx].add(updates)
+
+
+@register_op("unfold_axis",
+             ref="python/paddle/tensor/manipulation.py:6446 (paddle.unfold)")
+def unfold_axis(x, axis, size, step):
+    """Sliding windows of `size` every `step` along `axis` -> the window
+    becomes a trailing dim (torch.Tensor.unfold semantics)."""
+    axis = axis % x.ndim
+    if step <= 0:
+        raise ValueError(f"unfold: step must be positive, got {step}")
+    if size > x.shape[axis]:
+        raise ValueError(f"unfold: size {size} exceeds dim {x.shape[axis]} "
+                         f"along axis {axis}")
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    win = jnp.arange(size)
+    idx = starts[:, None] + win[None, :]                 # (n, size)
+    out = jnp.take(x, idx, axis=axis)                    # windows at `axis`
+    # paddle: windows stay at axis, window-size dim goes LAST
+    return jnp.moveaxis(out, axis + 1, -1)

@@ -135,3 +135,19 @@ def test_chain_with_rank_change_and_nonreparam_sample():
     td = D.TransformedDistribution(D.Gamma(2.0, 1.0), [D.ExpTransform()])
     s = td.sample((64,))
     assert s.shape[0] == 64 and (s.numpy() > 1.0 - 1e-6).all()
+
+
+def test_constraints():
+    """distribution.constraint (reference constraint.py parity)."""
+    from paddle_tpu.distribution import constraint as C
+
+    v = paddle.to_tensor(np.array([0.2, 0.8], np.float32))
+    assert C.real(v).numpy().all()
+    assert C.positive(v).numpy().all()
+    assert not C.positive(paddle.to_tensor(
+        np.array([-1.0], np.float32))).numpy().any()
+    assert C.Range(0.0, 1.0)(v).numpy().all()
+    assert not C.Range(0.3, 1.0)(v).numpy().all()
+    assert bool(C.simplex(v).numpy())
+    assert not bool(C.simplex(paddle.to_tensor(
+        np.array([0.5, 0.9], np.float32))).numpy())

@@ -529,6 +529,11 @@ SPECS = {
                           grad=[0, 1]),
     "rnnt_loss": spec([f(1, 3, 3, 4), ii(1, 2, lo=1, hi=4),
                        ii(1, lo=3, hi=4), ii(1, lo=2, hi=3)], grad=[0]),
+    # ---- round-3 top-level additions ----
+    "scatter_nd": spec([ii(3, 1, lo=0, hi=5), f(3)], kw=dict(shape=[5]),
+                       grad=[0]),
+    "unfold_axis": spec([f(2, 6)], kw=dict(axis=1, size=3, step=2),
+                        grad=[0]),
 }
 
 # randomness ops: forward-shape check only, with an explicit PRNG key
