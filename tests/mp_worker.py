@@ -90,7 +90,15 @@ def run(outdir, per_host: bool):
     model, opt, loss_fn, plan = build(paddle, mesh)
     trainer = ShardedTrainer(model, opt, loss_fn, mesh, plan)
 
-    dp_rank = rank if per_host else None  # dp row r lives on process r
+    # this process's dp row, read off the MESH itself (device .id values
+    # are not contiguous across processes — rank 1's ids start at 2048 on
+    # this runtime, so never derive coordinates from ids or re-implement
+    # the mesh's reshape)
+    if per_host:
+        dp_rank = int(np.argwhere(
+            mesh.jax_mesh.devices == jax.local_devices()[0])[0][0])
+    else:
+        dp_rank = None
     losses = []
     with mesh:
         for step in range(4):
