@@ -212,12 +212,19 @@ def load_state_dict(state_dict: Dict[str, "Tensor"], path: str,
         idx_map = sharding.addressable_devices_indices_map(gshape)
         bufs: Dict[tuple, np.ndarray] = {}
         arrays = []
+        # cast shard buffers to the DESTINATION dtype before device_put —
+        # loading a checkpoint into a model whose params were cast (e.g.
+        # bf16 bench flow) must not flip the param dtype back (it would
+        # force a retrace / donation-dtype mismatch in the compiled step)
+        dst_dtype = jnp.zeros((), dtype=t.dtype).dtype
         for dev, index in idx_map.items():
             offs, exts = _shard_offsets(index, gshape)
             key = tuple(offs)
             if key not in bufs:
-                bufs[key] = _assemble(entry, offs, exts, cache, path,
-                                      np_dtype)
+                buf = _assemble(entry, offs, exts, cache, path, np_dtype)
+                if buf.dtype != dst_dtype:
+                    buf = buf.astype(dst_dtype)
+                bufs[key] = buf
             arrays.append(jax.device_put(bufs[key], dev))
         glob = jax.make_array_from_single_device_arrays(
             gshape, sharding, arrays)

@@ -90,10 +90,22 @@ class ElasticManager:
     def _scale_pending(self) -> bool:
         return self._alive_nodes() != self._members
 
-    def endpoints_env(self) -> dict:
+    def adopt_members(self, members) -> dict:
+        """Atomically adopt a quorum snapshot as the authoritative
+        membership for the next incarnation and return its PADDLE_* env.
+        The one entry point launchers should use: it keeps the snapshot
+        used for scale-change detection and the env handed to the worker
+        consistent even while the heartbeat loop keeps rewriting state."""
+        self._members = list(members)
+        return self.endpoints_env(members)
+
+    def endpoints_env(self, members=None) -> dict:
         """Rewritten PADDLE_* env for the relaunch (manager.py endpoint
-        rewrite analog)."""
-        members = self._members
+        rewrite analog). Pass an explicit ``members`` snapshot when the
+        caller must stay consistent with a quorum it just observed (the
+        background loop mutates self._members every heartbeat)."""
+        if members is None:
+            members = self._members
         return {
             "PADDLE_TRAINERS_NUM": str(len(members)),
             "PADDLE_TRAINER_ID": str(members.index(self.node_id)

@@ -84,7 +84,7 @@ def _wait_quorum(elastic, args) -> List[str]:
             f"elastic quorum not reached: {len(members)}/{np_min} nodes "
             f"alive after {max(30.0, 3 * args.elastic_ttl):.0f}s "
             f"(members={members})")
-    settle_end = time.time() + 2 * 0.3  # two heartbeat periods
+    settle_end = time.time() + 2 * elastic.heartbeat_s  # two heartbeat periods
     while len(members) < np_max and time.time() < settle_end:
         time.sleep(0.2)
         members = elastic._alive_nodes()
@@ -108,8 +108,11 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # poll below compares against it, so a scale event can never
             # be consumed behind our back by the manager's own loop tick
             launched_members = _wait_quorum(elastic, args)
-            elastic._members = launched_members
-            env.update(elastic.endpoints_env())
+            # adopt the LOCAL snapshot atomically — the manager's heartbeat
+            # thread rewrites its own membership every tick, so deriving the
+            # env from manager state could hand a worker a world size
+            # inconsistent with the snapshot used for change detection
+            env.update(elastic.adopt_members(launched_members))
         scaled = False
         with open(log_path, "ab") as logf:
             proc = subprocess.Popen(cmd, env=env,

@@ -40,21 +40,28 @@ def _pad_head(head, V: int, chunk: int):
     return head, n, pad
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_linear_cross_entropy(hidden, head, labels, chunk: int = 4096):
-    """mean over VALID tokens of CE(softmax(hidden @ head), labels).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(hidden, head, labels, chunk: int = 4096,
+                               ignore_index: int = -100):
+    """mean over non-ignored tokens of CE(softmax(hidden @ head), labels).
 
     hidden: (T, H); head: (H, V); labels: (T,) int. Returns a scalar f32.
-    Labels outside [0, V) (e.g. -100 ignore padding) contribute zero loss
-    and zero gradient and are excluded from the mean denominator — the
-    F.cross_entropy(ignore_index=...) semantics. Callers with a
-    non-negative ignore_index must map it to -1 before the call.
+    Exact F.cross_entropy(ignore_index=...) semantics: ONLY labels equal
+    to ``ignore_index`` (any value, including the default -100) are
+    excluded from the mean denominator; labels outside [0, V) that are
+    not the ignore_index contribute zero loss and zero gradient but DO
+    count in the denominator (matching one_hot's zeroing of out-of-range
+    labels in the unfused path).
+
+    Contract change from the round-3 kernel: ALL out-of-range labels used
+    to be excluded from the denominator. Callers that followed the old
+    "map your sentinel to -1" advice must now pass ``ignore_index=-1``.
     """
-    loss, _ = _fwd_impl(hidden, head, labels, chunk)
+    loss, _ = _fwd_impl(hidden, head, labels, chunk, ignore_index)
     return loss
 
 
-def _fwd_impl(hidden, head, labels, chunk):
+def _fwd_impl(hidden, head, labels, chunk, ignore_index):
     T, H = hidden.shape
     V = head.shape[1]
     chunk = min(chunk, V)  # never pad past one chunk of waste
@@ -90,27 +97,31 @@ def _fwd_impl(hidden, head, labels, chunk):
     (m, s, gold), _ = jax.lax.scan(
         body, (m0, s0, g0), (hchunks, jnp.arange(n)))
     lse = m + jnp.log(s)
-    valid = (labels >= 0) & (labels < V)
-    denom = jnp.maximum(jnp.sum(valid), 1)
-    loss = jnp.sum(jnp.where(valid, lse - gold, 0.0)) / denom
+    not_ignored = labels != ignore_index
+    in_range = (labels >= 0) & (labels < V)
+    denom = jnp.maximum(jnp.sum(not_ignored), 1)
+    loss = jnp.sum(jnp.where(not_ignored & in_range, lse - gold, 0.0)) / denom
     return loss, lse
 
 
-def _fwd(hidden, head, labels, chunk):
-    loss, lse = _fwd_impl(hidden, head, labels, chunk)
+def _fwd(hidden, head, labels, chunk, ignore_index):
+    loss, lse = _fwd_impl(hidden, head, labels, chunk, ignore_index)
     return loss, (hidden, head, labels.astype(jnp.int32), lse)
 
 
-def _bwd(chunk, res, g):
+def _bwd(chunk, ignore_index, res, g):
     hidden, head, labels, lse = res
     T, H = hidden.shape
     V = head.shape[1]
     chunk = min(chunk, V)
     headp, n, _ = _pad_head(head, V, chunk)
     hchunks = jnp.moveaxis(headp.reshape(H, n, chunk), 1, 0)
-    valid = ((labels >= 0) & (labels < V)).astype(jnp.float32)
-    denom = jnp.maximum(jnp.sum(valid), 1.0)
-    scale = (g / denom) * valid  # mean over VALID tokens; ignored rows get 0
+    not_ignored = (labels != ignore_index).astype(jnp.float32)
+    active = (not_ignored *
+              ((labels >= 0) & (labels < V)).astype(jnp.float32))
+    denom = jnp.maximum(jnp.sum(not_ignored), 1.0)
+    # mean over non-ignored tokens; ignored AND out-of-range rows get 0 grad
+    scale = (g / denom) * active
 
     def body(dh, xs):
         w, idx = xs
@@ -161,15 +172,13 @@ def auto_chunk(T: int, V: int) -> int:
     return min(V, max(128, (per // 128) * 128))
 
 
-def fused_lm_loss(hidden, head, labels, ignore_index: int = None):
+def fused_lm_loss(hidden, head, labels, ignore_index: int = -100):
     """Shared model-side routing for the fused lm-head CE (the single
     entry the Llama/GPT/BERT loss paths use — one place to tune
     thresholds/chunking): flattens (..., H) hidden against an (H, V)
-    head, maps a non-negative ignore_index out of range (negative
-    sentinels are already invalid to the kernel), auto-picks the vocab
-    chunk, and dispatches through the op registry so the eager tape
-    records it."""
-    import paddle_tpu as paddle
+    head, auto-picks the vocab chunk, and dispatches through the op
+    registry so the eager tape records it. ``ignore_index`` is passed
+    straight to the kernel (any value, F.cross_entropy semantics)."""
     from paddle_tpu.ops.registry import op_api
 
     T = 1
@@ -178,17 +187,17 @@ def fused_lm_loss(hidden, head, labels, ignore_index: int = None):
     H = int(hidden.shape[-1])
     h2 = hidden.reshape([T, H])
     lab = labels.reshape([-1])
-    if ignore_index is not None and ignore_index >= 0:
-        lab = paddle.where(lab == ignore_index,
-                           paddle.full_like(lab, -1), lab)
     return op_api("fused_linear_ce")(h2, head, lab,
-                                     chunk=auto_chunk(T, int(head.shape[1])))
+                                     chunk=auto_chunk(T, int(head.shape[1])),
+                                     ignore_index=ignore_index)
 
 
 @register_op("fused_linear_ce",
              ref="paddle/phi/kernels/fusion/ + cross_entropy_with_softmax "
                  "(capability analog)")
-def fused_linear_ce_op(hidden, head, labels, chunk: int = None):
+def fused_linear_ce_op(hidden, head, labels, chunk: int = None,
+                       ignore_index: int = -100):
     if chunk is None:
         chunk = auto_chunk(hidden.shape[0], head.shape[1])
-    return fused_linear_cross_entropy(hidden, head, labels, chunk)
+    return fused_linear_cross_entropy(hidden, head, labels, chunk,
+                                      ignore_index)

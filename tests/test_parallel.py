@@ -250,3 +250,28 @@ def test_offload_opt_requires_tpu_and_warns_on_cpu():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         ShardedTrainer(net, opt, lambda m, x, y: 0, mesh, {}, offload="xyz")
+
+
+def test_sharded_ckpt_load_preserves_destination_dtype(tmp_path, mesh):
+    """Round-4 ADVICE fix: loading an f32 checkpoint into bf16-cast params
+    must keep the destination dtype (sharded AND replicated targets) — a
+    dtype flip would force a retrace/donation mismatch in the compiled step."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    w = shard_tensor(np.random.rand(8, 4).astype(np.float32), mesh,
+                     [Shard(0), Replicate()])
+    r = shard_tensor(np.random.rand(4,).astype(np.float32), mesh,
+                     [Replicate()])
+    ckpt.save_state_dict({"w": w, "r": r}, str(tmp_path / "ck"))
+
+    w2 = shard_tensor(np.zeros((8, 4), np.float32), mesh,
+                      [Shard(0), Replicate()]).astype("bfloat16")
+    r2 = shard_tensor(np.zeros((4,), np.float32), mesh,
+                      [Replicate()]).astype("bfloat16")
+    ckpt.load_state_dict({"w": w2, "r": r2}, str(tmp_path / "ck"))
+    assert w2.value.dtype == jnp.bfloat16
+    assert r2.value.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(w2.value, np.float32),
+                               np.asarray(w.value, np.float32),
+                               rtol=1e-2, atol=1e-2)

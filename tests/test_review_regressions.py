@@ -147,3 +147,45 @@ def test_sharded_trainer_applies_grad_clip():
         assert np.abs(model.weight.numpy() - w0).max() < 1e-3
     finally:
         set_mesh(None)
+
+
+def test_fused_ce_ignore_index_semantics_match_unfused():
+    """Round-4 regression (VERDICT item 9 + ADVICE fused_ce finding):
+    the fused kernel takes ignore_index as an argument — in-range
+    non-negative sentinels (e.g. pad id 0) are excluded from the mean,
+    while labels outside [0, V) that are NOT the ignore_index contribute
+    zero loss/grad but DO count in the denominator, exactly like the
+    one_hot-based unfused F.cross_entropy path."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+    rng = np.random.default_rng(3)
+    T, H, V = 12, 8, 640
+    hidden = jnp.asarray(rng.standard_normal((T, H)), jnp.float32)
+    head = jnp.asarray(rng.standard_normal((H, V)) * 0.1, jnp.float32)
+
+    for ignore in (-100, 0, 5):
+        labels = rng.integers(0, V, (T,))
+        labels[1] = ignore            # ignored row
+        labels[4] = V + 7             # out-of-range, NOT ignore: counts in denom
+        if ignore != -100:
+            labels[7] = -100          # another out-of-range non-ignore value
+        lab = jnp.asarray(labels, jnp.int32)
+
+        def unfused(h, w):
+            logits = (h @ w).astype(jnp.float32)
+            return F.cross_entropy(
+                paddle.Tensor(logits), paddle.Tensor(lab),
+                ignore_index=ignore).value
+
+        def fused(h, w):
+            return fused_linear_cross_entropy(h, w, lab, 256, ignore)
+
+        l0, (gh0, gw0) = jax.value_and_grad(unfused, (0, 1))(hidden, head)
+        l1, (gh1, gw1) = jax.value_and_grad(fused, (0, 1))(hidden, head)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh0),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw0),
+                                   rtol=1e-4, atol=1e-6)
