@@ -160,6 +160,11 @@ define_flag("train_rng_impl", "rbg",
             "RNG path — threefry mask generation alone cost ~36 ms/step on "
             "the 183M-param dropout-0.1 GPT config (v5e); 'threefry2x32' "
             "restores the jax default (cross-backend reproducible streams)")
+define_flag("to_static_max_cond_paths", 16,
+            "path budget for capturing data-dependent Python bools into "
+            "lax.cond inside to_static (jit/cond_capture.py): each "
+            "captured bool doubles the leaf-path count; beyond the budget "
+            "the call graph-breaks to eager as in round 3")
 define_flag("default_dtype", "float32", "default floating point dtype")
 define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
 define_flag("profiler_dir", "", "directory for profiler trace output")

@@ -263,6 +263,15 @@ class Tensor:
             return f"Tensor(shape={list(self.shape)}, dtype={self.dtype.name}{grad_info}, traced)"
 
     def __bool__(self):
+        v = self._value
+        if isinstance(v, jax.core.Tracer):
+            # inside a to_static capture, data-dependent bools are FORCED
+            # per explored path (lax.cond capture) instead of erroring —
+            # see jit/cond_capture.py
+            from paddle_tpu.jit.cond_capture import resolve_traced_bool
+            r = resolve_traced_bool(v)
+            if r is not None:
+                return r
         return bool(self.numpy())
 
     def __int__(self):

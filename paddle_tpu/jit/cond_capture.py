@@ -1,0 +1,180 @@
+"""Capture Python ``if tensor:`` branches into ``lax.cond`` under tracing.
+
+Round-4 answer to the reference's first-class IR control flow
+(paddle/fluid/pir/dialect/operator/ir/control_flow_op.h) + SOT branch
+handling (python/paddle/jit/sot/): when a jit trace hits ``bool()`` on a
+traced tensor, instead of graph-breaking to eager, ``to_static`` now
+RE-RUNS the function once per outcome of each data-dependent bool — a
+decision-tree exploration — and combines the per-path results with
+``lax.cond`` on the recorded predicates. The whole function stays one
+compiled XLA program with zero graph breaks.
+
+Mechanics. ``Tensor.__bool__`` consults the active :class:`CaptureContext`
+when its value is a tracer. If the context has a forced decision for this
+bool site, it returns it; otherwise it raises :class:`Fork` carrying the
+predicate. :func:`explore` drives the runs depth-first, forcing ``True``
+then ``False`` at each newly discovered site, and folds the leaves back
+together bottom-up.
+
+Semantics and limits (documented fallback rules — violating any of these
+falls back to the round-3 eager graph-break, observable via the
+``to_static_graph_breaks`` STAT):
+
+- branch purity: every path is executed during tracing, so branch side
+  effects (Python state mutation, appends) happen for ALL paths;
+- matching outputs: all paths must produce the same pytree structure,
+  shapes and dtypes (:class:`CaptureMismatch` otherwise);
+- path budget: at most ``flags.to_static_max_cond_paths`` leaf paths
+  (:class:`CaptureOverflow` beyond it) — each data-dependent bool doubles
+  the count, so deeply branchy functions belong on
+  ``paddle.static.nn.cond`` instead;
+- the function must be deterministic across re-runs (same bools hit in
+  the same order); the RNG trace key is re-pushed per run so random ops
+  replay identically;
+- both sides of every branch are computed and the result selected
+  (select semantics, like ``paddle.where``) — pick static.nn.cond for
+  lazy single-branch execution of expensive branches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["explore", "resolve_traced_bool", "CaptureOverflow",
+           "CaptureMismatch", "Fork"]
+
+
+class Fork(Exception):
+    """A new data-dependent bool site was hit; carries the predicate."""
+
+    def __init__(self, pred):
+        super().__init__("data-dependent bool (capture fork)")
+        self.pred = pred
+
+
+class CaptureOverflow(Exception):
+    """More leaf paths than the flags.to_static_max_cond_paths budget."""
+
+
+class CaptureMismatch(Exception):
+    """Paths produced different pytree structures/shapes/dtypes."""
+
+
+class CaptureContext:
+    __slots__ = ("decisions", "cursor", "trace_state")
+
+    def __init__(self, decisions: List[bool]):
+        self.decisions = decisions
+        self.cursor = 0
+        # identity of the trace explore() runs under: bool sites hit in a
+        # DEEPER trace (a lax.cond branch / loop body) cannot be captured
+        # here — their predicate tracer would be dead at our combine level
+        self.trace_state = jax.core.get_opaque_trace_state()
+
+
+_stack: List[CaptureContext] = []
+
+
+def resolve_traced_bool(value) -> bool:
+    """Called by ``Tensor.__bool__`` on a traced value. Returns the forced
+    decision for this site, raises :class:`Fork` at a new site, or returns
+    ``None`` when no capture is active / the value is not a scalar (the
+    caller then falls through to the plain concretization error)."""
+    if not _stack:
+        return None
+    aval = getattr(value, "aval", None)
+    if aval is None or getattr(aval, "size", None) != 1:
+        return None
+    ctx = _stack[-1]
+    if jax.core.get_opaque_trace_state() != ctx.trace_state:
+        # nested traced region: fall through to the ordinary
+        # concretization error -> to_static graph-breaks cleanly
+        return None
+    if ctx.cursor < len(ctx.decisions):
+        d = ctx.decisions[ctx.cursor]
+        ctx.cursor += 1
+        return d
+    raise Fork(jnp.asarray(value).reshape(()).astype(bool))
+
+
+def explore(thunk: Callable[[], Any], max_paths: int = 16):
+    """Run ``thunk`` under bool-capture; return its output with every
+    data-dependent branch folded into ``lax.cond``.
+
+    Zero overhead when no fork occurs (single run, returned as-is)."""
+
+    n_runs = 0
+    # a full binary tree with max_paths leaves takes 2*max_paths - 1 runs;
+    # bounding RUNS (not just completed leaves) also catches the
+    # non-terminating case — a data-dependent `while tensor:` forks on an
+    # all-True spine forever and never completes a single leaf
+    max_runs = 2 * max_paths
+
+    def run(decisions: List[bool]):
+        nonlocal n_runs
+        n_runs += 1
+        if n_runs > max_runs:
+            raise CaptureOverflow(
+                f"data-dependent branch capture exceeded {max_runs} "
+                f"exploration runs (budget {max_paths} paths) — an "
+                f"unbounded `while tensor:` loop cannot be captured; "
+                f"use paddle.static.nn.while_loop")
+        ctx = CaptureContext(list(decisions))
+        _stack.append(ctx)
+        try:
+            return ("leaf", thunk())
+        except Fork as f:
+            return ("fork", f.pred)
+        finally:
+            _stack.pop()
+
+    n_leaves = 0
+
+    def build(prefix: List[bool]):
+        nonlocal n_leaves
+        r = run(prefix)
+        if r[0] == "leaf":
+            n_leaves += 1
+            if n_leaves > max_paths:
+                raise CaptureOverflow(
+                    f"data-dependent branch capture exceeded "
+                    f"{max_paths} paths")
+            return r
+        pred = r[1]
+        from paddle_tpu.framework.monitor import stat_add
+        stat_add("to_static_cond_captures")
+        return ("node", pred,
+                build(prefix + [True]), build(prefix + [False]))
+
+    return _combine(build([]))
+
+
+def _combine(tree):
+    if tree[0] == "leaf":
+        return tree[1]
+    _, pred, t, f = tree
+    tv, tdef = jax.tree_util.tree_flatten(_combine(t))
+    fv, fdef = jax.tree_util.tree_flatten(_combine(f))
+    if tdef != fdef:
+        raise CaptureMismatch(
+            f"branches produced different pytree structures: {tdef} vs "
+            f"{fdef}")
+    for a, b in zip(tv, fv):
+        sa = (jnp.shape(a), jnp.result_type(a))
+        sb = (jnp.shape(b), jnp.result_type(b))
+        if sa != sb:
+            raise CaptureMismatch(
+                f"branches produced mismatched leaves: {sa} vs {sb}")
+    try:
+        outs = jax.lax.cond(pred, lambda: tuple(tv), lambda: tuple(fv))
+    except jax.errors.UnexpectedTracerError as e:
+        # the bool site was hit inside an INNER trace (a static.nn.cond
+        # branch / lax loop body): its predicate tracer is dead out here.
+        # Surface as a capture failure so to_static graph-breaks cleanly.
+        raise CaptureMismatch(
+            "data-dependent bool inside a nested traced region cannot be "
+            f"captured ({e})") from e
+    return jax.tree_util.tree_unflatten(tdef, list(outs))

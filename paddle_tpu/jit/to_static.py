@@ -12,6 +12,7 @@ from paddle_tpu.autograd import tape
 from paddle_tpu.framework import random as rnd
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.jit.cond_capture import CaptureMismatch, CaptureOverflow
 from paddle_tpu.ops.registry import OpDef, apply_op
 
 __all__ = ["to_static", "StaticFunction", "not_to_static"]
@@ -71,7 +72,7 @@ class StaticFunction:
         layer = self._layer
         fn = self._fn
 
-        def impl(*flat_args, key):
+        def body(flat_args, key):
             state_vals = flat_args[:n_state]
             arg_vals = flat_args[n_state:]
             kwargs = dict(static_kwargs)
@@ -96,12 +97,34 @@ class StaticFunction:
                                                       is_leaf=_is_tensor_leaf)
                     leaves, treedef = jax.tree_util.tree_flatten(out_vals)
                     buf_names = [n for n in state_names if n in new_buffers]
+                    if "treedef" in cell and cell["treedef"] != treedef:
+                        # branch-capture re-run produced a different output
+                        # STRUCTURE (e.g. dict vs tuple) — leaves alone
+                        # can't reveal this; bail to the eager fallback
+                        raise CaptureMismatch(
+                            "data-dependent branches returned different "
+                            f"pytree structures: {cell['treedef']} vs "
+                            f"{treedef}")
                     cell["treedef"] = treedef
                     cell["n_out"] = len(leaves)
                     cell["buf_names"] = buf_names
                     return tuple(leaves) + tuple(new_buffers[n] for n in buf_names)
             finally:
                 rnd.pop_trace_key()
+
+        def impl(*flat_args, key):
+            # data-dependent Python bools fork the trace into per-path
+            # re-runs combined with lax.cond (jit/cond_capture.py) — the
+            # RNG key push/pop lives INSIDE body so every explored path
+            # replays an identical random stream
+            from paddle_tpu.flags import flags
+            from paddle_tpu.jit.cond_capture import explore
+            # treedef equality is only meaningful WITHIN one exploration
+            # (a shape-specialized retrace may legitimately change the
+            # output structure via static Python branching)
+            cell.pop("treedef", None)
+            return explore(lambda: body(flat_args, key),
+                           max_paths=flags.to_static_max_cond_paths)
 
         return impl
 
@@ -173,13 +196,16 @@ class StaticFunction:
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerIntegerConversionError,
-                jax.errors.TracerArrayConversionError):
-            # GRAPH BREAK: data-dependent Python control flow on tensor
-            # VALUES cannot trace. The reference's SOT
-            # (jit/sot/opcode_translator) splits the bytecode into
-            # subgraphs around the break; the contract here is
-            # fall-back-to-eager per call (correct results, no compile)
-            # with a one-time warning + a STAT counter
+                jax.errors.TracerArrayConversionError,
+                CaptureOverflow, CaptureMismatch):
+            # GRAPH BREAK: data-dependent bools are first captured into
+            # lax.cond (jit/cond_capture.py, round 4) — this fallback now
+            # only fires for int/array concretization, branches whose
+            # outputs mismatch across paths, or a blown path budget.
+            # The reference's SOT (jit/sot/opcode_translator) splits the
+            # bytecode into subgraphs around the break; the contract here
+            # is fall-back-to-eager per call (correct results, no
+            # compile) with a one-time warning + a STAT counter
             # (to_static_graph_breaks) so the break is observable.
             from paddle_tpu.framework.monitor import stat_add
             stat_add("to_static_graph_breaks")
@@ -189,9 +215,11 @@ class StaticFunction:
                 import warnings
                 warnings.warn(
                     f"to_static<{getattr(self._fn, '__name__', 'fn')}>: "
-                    "data-dependent Python control flow broke the trace; "
-                    "falling back to EAGER for these calls (use "
-                    "paddle.where / lax.cond-style ops to stay compiled)",
+                    "data-dependent control flow could not be captured "
+                    "into lax.cond (int/array concretization, mismatched "
+                    "branch outputs, or path budget exceeded); falling "
+                    "back to EAGER for these calls (use paddle.where or "
+                    "paddle.static.nn.cond/while_loop to stay compiled)",
                     stacklevel=2)
             if self._layer is not None:
                 return self._layer(*args, **kwargs)

@@ -21,7 +21,9 @@ from paddle_tpu.framework.tensor import Tensor
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "data", "Executor",
            "save_inference_model", "load_inference_model", "gradients",
-           "name_scope", "BuildStrategy"]
+           "name_scope", "BuildStrategy", "nn"]
+
+from paddle_tpu.static import nn  # noqa: E402,F401 (control flow ops)
 
 
 class BuildStrategy:

@@ -1,0 +1,17 @@
+"""paddle_tpu.static.nn — static-graph networking ops (control flow).
+
+Analog of python/paddle/static/nn/control_flow.py. TPU-native design:
+the reference builds IR region ops (build_if_op / build_while_op,
+paddle/fluid/pir/dialect/operator/ir/control_flow_op.h); here the SAME
+user API lowers straight onto XLA's structured control flow —
+``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` — when the inputs are
+traced, and to plain Python control flow when eager (where predicates
+are concrete, so running just the taken branch is both exact and
+autograd-friendly; mirrors dygraph-mode behavior of the reference API).
+"""
+
+from paddle_tpu.static.nn.control_flow import (  # noqa: F401
+    Assert, Print, case, cond, switch_case, while_loop,
+)
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert", "Print"]

@@ -1,0 +1,245 @@
+"""Structured control flow ops (cond / while_loop / case / switch_case).
+
+Reference: python/paddle/static/nn/control_flow.py (user API) over the IR
+region ops in paddle/fluid/pir/dialect/operator/ir/control_flow_op.h.
+TPU-native lowering:
+
+- traced predicate (inside jit/to_static) -> ``lax.cond`` /
+  ``lax.while_loop`` / ``lax.switch``: one compiled XLA program, no
+  graph break, no host round-trip;
+- concrete predicate (eager) -> ordinary Python control flow running only
+  the taken branch on the autograd tape (the reference's dygraph-mode
+  semantics: its static control-flow APIs execute ``true_fn()`` directly
+  when ``in_dygraph_mode()``).
+
+Contract carried over from XLA's structured ops: under tracing, both/all
+branch functions are traced, so they must be pure and return matching
+pytrees (same structure, shapes and dtypes); ``while_loop`` bodies must
+keep loop-var shapes/dtypes invariant. Reverse-mode autodiff through a
+traced ``while_loop`` is not defined (XLA limitation shared with the
+reference's while op); use ``lax.scan``-style fixed-trip loops (or the
+eager path) when gradients through the loop are needed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert", "Print"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _unwrap_tree(out):
+    return jax.tree_util.tree_map(_unwrap, out, is_leaf=_is_tensor)
+
+
+def _wrap_tree(out):
+    def w(v):
+        if isinstance(v, (jax.Array, jax.core.Tracer)):
+            return Tensor(v)
+        return v
+    return jax.tree_util.tree_map(w, out)
+
+
+def _pred_value(pred):
+    """Unwrap a predicate to a scalar jnp bool; report whether it is
+    concrete (eager) or traced."""
+    pv = _unwrap(pred)
+    pv = jnp.asarray(pv)
+    if pv.size != 1:
+        raise ValueError(
+            f"control-flow predicate must be a scalar, got shape {pv.shape}")
+    pv = pv.reshape(()).astype(bool)
+    traced = isinstance(pv, jax.core.Tracer)
+    return pv, traced
+
+
+def _branch_thunk(fn: Optional[Callable]):
+    """A zero-arg branch as lax expects: run the user fn (or nothing),
+    hand back a pure pytree of jnp values."""
+    def thunk(_):
+        out = fn() if fn is not None else None
+        return _unwrap_tree(out)
+    return thunk
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name: Optional[str] = None, return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Parity: python/paddle/static/nn/control_flow.py::cond (If op,
+    control_flow_op.h). Traced -> ``lax.cond`` (both branches traced,
+    matching pytrees required); eager -> only the taken branch runs.
+    """
+    pv, traced = _pred_value(pred)
+    if not traced:
+        fn = true_fn if bool(pv) else false_fn
+        return fn() if fn is not None else None
+    try:
+        out = lax.cond(pv, _branch_thunk(true_fn), _branch_thunk(false_fn),
+                       None)
+    except TypeError as e:
+        if isinstance(e, jax.errors.JAXTypeError):
+            raise  # tracer/concretization errors keep their identity so
+            #        to_static's graph-break fallback can still catch them
+        raise TypeError(
+            "cond: true_fn and false_fn must return the same pytree "
+            f"structure, shapes and dtypes under tracing ({e})") from e
+    return _wrap_tree(out)
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None):
+    """``while cond(*vars): vars = body(*vars)``; returns the final vars.
+
+    Parity: python/paddle/static/nn/control_flow.py::while_loop (While
+    op). Traced -> ``lax.while_loop`` (shape/dtype-invariant loop vars,
+    no reverse-mode AD); eager -> Python while on the tape.
+    """
+    if not loop_vars:
+        raise ValueError("loop_vars cannot be empty")
+    p0, traced = _pred_value(cond(*loop_vars))
+    if not traced:
+        vars_ = tuple(loop_vars)
+        pv = p0
+        while bool(pv):
+            out = body(*vars_)
+            vars_ = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+            if len(vars_) != len(loop_vars):
+                raise ValueError(
+                    f"body returned {len(vars_)} vars, expected "
+                    f"{len(loop_vars)}")
+            pv = _pred_value(cond(*vars_))[0]
+        return list(vars_)
+
+    init = tuple(jax.tree_util.tree_map(_unwrap, v, is_leaf=_is_tensor)
+                 for v in loop_vars)
+
+    def cond_fn(carry):
+        pv, _ = _pred_value(cond(*_wrap_tree(list(carry))))
+        return pv
+
+    def body_fn(carry):
+        out = body(*_wrap_tree(list(carry)))
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return tuple(_unwrap_tree(v) for v in out)
+
+    final = lax.while_loop(cond_fn, body_fn, init)
+    return [x for x in _wrap_tree(list(final))]
+
+
+def case(pred_fn_pairs, default: Callable = None,
+         name: Optional[str] = None):
+    """Run the fn of the FIRST true predicate (reference ``case``
+    semantics); ``default`` (or the last fn) if none is true.
+
+    Traced -> a fold of ``lax.cond``s (first-match-wins preserved by
+    nesting from the back).
+    """
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs cannot be empty")
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+    traced = any(_pred_value(p)[1] for p in preds)
+    if not traced:
+        for p, f in zip(preds, fns):
+            if bool(_pred_value(p)[0]):
+                return f()
+        return default()
+    out = _branch_thunk(default)(None)
+    for p, f in reversed(list(zip(preds, fns))):
+        pv, _ = _pred_value(p)
+        prev = out
+        out = lax.cond(pv, _branch_thunk(f), lambda _, prev=prev: prev, None)
+    return _wrap_tree(out)
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name: Optional[str] = None):
+    """Run ``branch_fns[branch_index]``; ``default`` (or the last fn,
+    reference semantics) when the index matches no branch.
+
+    Traced -> ``lax.switch`` over densified branches.
+    """
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) \
+            if branch_fns and callable(branch_fns[0]) \
+            else sorted(branch_fns)
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+    bi, traced = _pred_value(branch_index)
+    if not traced:
+        k = int(jnp.asarray(_unwrap(branch_index)).reshape(()))
+        for key, f in items:
+            if key == k:
+                return f()
+        return default()
+    bi = jnp.asarray(_unwrap(branch_index)).reshape(()).astype(jnp.int32)
+    pos = jnp.full((), len(keys), jnp.int32)    # default slot
+    for i, k in enumerate(keys):
+        pos = jnp.where(bi == k, jnp.int32(i), pos)
+    out = lax.switch(pos, [_branch_thunk(f) for f in fns]
+                     + [_branch_thunk(default)], None)
+    return _wrap_tree(out)
+
+
+def Assert(cond, data=None, summarize: int = 20, name: Optional[str] = None):
+    """Assert ``cond`` holds; on failure print up to ``summarize``
+    elements of each tensor in ``data``.
+
+    Parity: control_flow.py::Assert (build_assert_op). Eager -> raises
+    ValueError immediately. Traced -> ``jax.debug.callback`` raising from
+    the host once the value is available (XLA has no abort op; the error
+    surfaces at the next host sync, the documented best effort).
+    """
+    pv, traced = _pred_value(cond)
+    datavals = [_unwrap(d) for d in (data or [])]
+
+    def _fail(pred, *vals):
+        if not bool(pred):
+            shown = "; ".join(
+                str(jnp.asarray(v).reshape(-1)[:summarize]) for v in vals)
+            raise ValueError(
+                f"Assert{'(' + name + ')' if name else ''} failed. {shown}")
+
+    if not traced:
+        _fail(pv, *datavals)
+        return None
+    jax.debug.callback(_fail, pv, *datavals)
+    return None
+
+
+def Print(input, first_n: int = -1, message: Optional[str] = None,
+          summarize: int = 20, print_tensor_name: bool = True,
+          print_tensor_type: bool = True, print_tensor_shape: bool = True,
+          print_tensor_layout: bool = True, print_tensor_lod: bool = True,
+          print_phase: str = "both"):
+    """Print a tensor's value when it is produced; returns the input
+    (identity, so it can be spliced into a graph). Traced ->
+    ``jax.debug.print`` (prints from the device stream)."""
+    v = _unwrap(input)
+    msg = (message + " ") if message else ""
+    if isinstance(v, jax.core.Tracer):
+        jax.debug.print(msg + "{x}", x=v)
+    else:
+        print(f"{msg}{jnp.asarray(v)}")
+    return input

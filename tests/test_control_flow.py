@@ -1,0 +1,297 @@
+"""Compiled data-dependent control flow (round-4 VERDICT item 2).
+
+Reference parity targets: python/paddle/static/nn/control_flow.py
+(cond/while_loop/case/switch_case/Assert/Print over the IR region ops in
+paddle/fluid/pir/dialect/operator/ir/control_flow_op.h). Here the same
+API lowers to lax.cond / lax.while_loop / lax.switch, and to_static
+captures raw Python ``if tensor:`` branches into lax.cond (zero graph
+breaks) via jit/cond_capture.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.framework.monitor import stat_get
+
+
+def _breaks():
+    try:
+        return stat_get("to_static_graph_breaks")
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------- static.nn
+
+def test_cond_eager_runs_taken_branch_with_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    out = static.nn.cond(paddle.sum(x) > 1.0,
+                         lambda: x * 3.0, lambda: x - 1.0)
+    out.sum().backward()
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_cond_traced_lowers_to_lax_cond():
+    import jax
+
+    def f(x):
+        t = paddle.Tensor(x)
+        out = static.nn.cond(paddle.sum(t) > 0,
+                             lambda: t * 2.0, lambda: t - 5.0)
+        return out._value
+
+    jaxpr = str(jax.make_jaxpr(f)(np.ones(3, np.float32)))
+    assert "cond" in jaxpr
+    np.testing.assert_allclose(jax.jit(f)(np.ones(3, np.float32)),
+                               2.0 * np.ones(3))
+    np.testing.assert_allclose(jax.jit(f)(-np.ones(3, np.float32)),
+                               -6.0 * np.ones(3))
+
+
+def test_while_loop_eager_and_traced_parity():
+    import jax
+
+    def counted(i0, s0):
+        i, s = static.nn.while_loop(
+            lambda i, s: i < 10,
+            lambda i, s: [i + 1, s + i.astype("float32")],
+            [i0, s0])
+        return i, s
+
+    i, s = counted(paddle.to_tensor(0), paddle.to_tensor(0.0))
+    assert int(i.numpy()) == 10 and float(s.numpy()) == 45.0
+
+    def traced(iv, sv):
+        i, s = counted(paddle.Tensor(iv), paddle.Tensor(sv))
+        return i._value, s._value
+
+    jaxpr = str(jax.make_jaxpr(traced)(np.int32(0), np.float32(0)))
+    assert "while" in jaxpr
+    iv, sv = jax.jit(traced)(np.int32(0), np.float32(0))
+    assert int(iv) == 10 and float(sv) == 45.0
+
+
+def test_switch_case_and_case():
+    import jax
+
+    fns = {1: lambda: paddle.full([2], 1.0),
+           3: lambda: paddle.full([2], 3.0)}
+    out = static.nn.switch_case(paddle.to_tensor(3), fns,
+                                default=lambda: paddle.full([2], -1.0))
+    np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+    out = static.nn.switch_case(paddle.to_tensor(7), fns,
+                                default=lambda: paddle.full([2], -1.0))
+    np.testing.assert_allclose(out.numpy(), [-1.0, -1.0])
+
+    def f(idx):
+        out = static.nn.switch_case(
+            paddle.Tensor(idx),
+            {1: lambda: paddle.full([2], 1.0),
+             3: lambda: paddle.full([2], 3.0)},
+            default=lambda: paddle.full([2], -1.0))
+        return out._value
+
+    np.testing.assert_allclose(jax.jit(f)(np.int32(1)), [1.0, 1.0])
+    np.testing.assert_allclose(jax.jit(f)(np.int32(9)), [-1.0, -1.0])
+
+    # case: first true predicate wins
+    x = paddle.to_tensor(0.4)
+    out = static.nn.case(
+        [(x > 0.5, lambda: paddle.full([1], 1.0)),
+         (x > 0.2, lambda: paddle.full([1], 2.0))],
+        default=lambda: paddle.full([1], 9.0))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def g(v):
+        t = paddle.Tensor(v)
+        out = static.nn.case(
+            [(t > 0.5, lambda: paddle.full([1], 1.0)),
+             (t > 0.2, lambda: paddle.full([1], 2.0))],
+            default=lambda: paddle.full([1], 9.0))
+        return out._value
+
+    np.testing.assert_allclose(jax.jit(g)(np.float32(0.9)), [1.0])
+    np.testing.assert_allclose(jax.jit(g)(np.float32(0.4)), [2.0])
+    np.testing.assert_allclose(jax.jit(g)(np.float32(0.0)), [9.0])
+
+
+def test_assert_and_print():
+    static.nn.Assert(paddle.to_tensor(True))
+    with pytest.raises(ValueError):
+        static.nn.Assert(paddle.to_tensor(1.0) > 2.0,
+                         data=[paddle.to_tensor([1.0, 2.0])])
+    out = static.nn.Print(paddle.to_tensor([1.0]), message="cf-test")
+    np.testing.assert_allclose(out.numpy(), [1.0])
+
+
+# ------------------------------------------------- to_static branch capture
+
+def test_to_static_captures_python_if_zero_graph_breaks():
+    """A raw Python `if tensor:` now compiles into lax.cond instead of
+    graph-breaking (round-3 behavior was permanent eager fallback)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 3.0
+        return y + 1.0
+
+    b0 = _breaks()
+    out_pos = f(paddle.to_tensor([1.0, 1.0]))
+    out_neg = f(paddle.to_tensor([-1.0, -1.0]))
+    np.testing.assert_allclose(out_pos.numpy(), [3.0, 3.0])
+    np.testing.assert_allclose(out_neg.numpy(), [-3.0, -3.0])
+    assert _breaks() == b0, "graph break happened; capture failed"
+    assert stat_get("to_static_cond_captures") >= 1
+
+
+def test_to_static_nested_branches_capture():
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            if paddle.max(x) > 10.0:
+                return x * 100.0
+            return x * 2.0
+        return -x
+
+    b0 = _breaks()
+    np.testing.assert_allclose(f(paddle.to_tensor([20.0])).numpy(), [2000.0])
+    np.testing.assert_allclose(f(paddle.to_tensor([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(f(paddle.to_tensor([-4.0])).numpy(), [4.0])
+    assert _breaks() == b0
+
+
+def test_to_static_branch_trains_compiled():
+    """VERDICT acceptance: a model with a data-dependent branch trains
+    fully compiled — gradients flow through the captured lax.cond."""
+    from paddle_tpu import nn
+
+    class Gated(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 4)
+            self.b = nn.Linear(4, 4)
+
+        def forward(self, x):
+            if paddle.mean(x) > 0:          # data-dependent Python branch
+                return self.a(x)
+            return self.b(x)
+
+    net = Gated()
+    a0 = net.a.weight.numpy().copy()
+    b0_w = net.b.weight.numpy().copy()
+    static_net = paddle.jit.to_static(net)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters())
+    xs = [np.random.rand(8, 4).astype(np.float32) - off
+          for off in (0.0, 1.0, 0.0, 1.0)]
+    b0 = _breaks()
+    losses = []
+    for x in xs * 4:
+        out = static_net(paddle.to_tensor(x))
+        loss = paddle.mean((out - 1.0) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert _breaks() == b0, "branch capture graph-broke"
+    assert losses[-1] < losses[0]
+    # both experts actually trained (each side of the branch got grads)
+    assert not np.allclose(net.a.weight.numpy(), a0)
+    assert not np.allclose(net.b.weight.numpy(), b0_w)
+
+
+def test_to_static_mismatched_branches_fall_back_eager():
+    """Documented fallback: branches with different output shapes cannot
+    be captured; the call graph-breaks to eager and stays correct."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            return x[:1]
+        return x
+
+    with pytest.warns(UserWarning):
+        out = f(paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(), [1.0])
+    b1 = _breaks()
+    out = f(paddle.to_tensor([-1.0, -2.0]))  # cached as broken -> eager
+    np.testing.assert_allclose(out.numpy(), [-1.0, -2.0])
+    assert _breaks() == b1 + 1
+
+
+def test_to_static_path_budget_overflow_falls_back():
+    from paddle_tpu.flags import flags
+    old = flags.to_static_max_cond_paths
+    paddle.set_flags({"to_static_max_cond_paths": 4})
+    try:
+        @paddle.jit.to_static
+        def f(x):
+            y = x
+            for _ in range(4):               # 16 paths > budget of 4
+                if paddle.sum(y) > 0:
+                    y = y * 1.5
+                else:
+                    y = y + 1.0
+            return y
+
+        out = f(paddle.to_tensor([1.0]))     # eager fallback, correct
+        np.testing.assert_allclose(out.numpy(), [1.5 ** 4])
+    finally:
+        paddle.set_flags({"to_static_max_cond_paths": old})
+
+
+def test_to_static_unbounded_while_falls_back_not_hang():
+    """Review finding: a data-dependent `while tensor:` must graph-break
+    to eager (bounded exploration runs), not recurse forever."""
+
+    @paddle.jit.to_static
+    def f(x):
+        while paddle.sum(x) > 0:
+            x = x - 1.0
+        return x
+
+    with pytest.warns(UserWarning):
+        out = f(paddle.to_tensor([3.0]))
+    np.testing.assert_allclose(out.numpy(), [0.0])
+
+
+def test_to_static_structure_mismatch_falls_back():
+    """Review finding: branches differing only in pytree STRUCTURE (same
+    leaf count) must fall back to eager, not silently unflatten the True
+    path's values into the False path's structure."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            return {"a": x * 2.0}
+        return (x - 3.0,)
+
+    with pytest.warns(UserWarning):
+        out = f(paddle.to_tensor([1.0]))
+    assert isinstance(out, dict) and set(out) == {"a"}
+    np.testing.assert_allclose(out["a"].numpy(), [2.0])
+
+
+def test_to_static_bool_inside_nested_cond_falls_back():
+    """Review finding: a raw Python bool inside a static.nn.cond branch
+    hits an inner trace; must graph-break cleanly, not crash with
+    UnexpectedTracerError."""
+
+    @paddle.jit.to_static
+    def f(x):
+        def tf():
+            if paddle.max(x) > 10.0:
+                return x * 100.0
+            return x * 2.0
+        return static.nn.cond(paddle.sum(x) > 0, tf, lambda: -x)
+
+    with pytest.warns(UserWarning):
+        out = f(paddle.to_tensor([20.0]))
+    np.testing.assert_allclose(out.numpy(), [2000.0])
+    np.testing.assert_allclose(f(paddle.to_tensor([-2.0])).numpy(), [2.0])
