@@ -409,6 +409,8 @@ def bench_unet(profile=False):
     tgt = rng.normal(size=x.shape).astype(np.float32)
     with mesh:
         step_time = _measure_steps(trainer, (x, t, ctx, tgt), steps)
+        if profile and on_tpu:
+            _trace_profile(trainer, (x, t, ctx, tgt), steps, "unet")
     n = sum(p.size for p in model.parameters())
     # step FLOPs from the compiled single-step module (convs dominate; an
     # analytic count would re-derive what XLA already knows)

@@ -27,7 +27,9 @@ from paddle_tpu.framework.tensor import Tensor, to_tensor
 __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
     "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
-    "Multinomial", "Geometric", "kl_divergence", "register_kl",
+    "Multinomial", "Geometric", "Cauchy", "Gumbel", "StudentT", "Poisson",
+    "Binomial", "ContinuousBernoulli", "Independent", "MultivariateNormal",
+    "ExponentialFamily", "kl_divergence", "register_kl",
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
     "ExpTransform", "IndependentTransform", "PowerTransform",
     "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
@@ -484,6 +486,16 @@ def register_kl(p_cls, q_cls):
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
     fn = _KL_TABLE.get((type(p), type(q)))
     if fn is None:
+        # subclass dispatch: most-specific registered pair wins, ties
+        # resolved by LEFT specificity first (the reference dispatch()'s
+        # lexicographic total order on (cls_p, cls_q))
+        matches = [(cp, cq) for cp, cq in _KL_TABLE
+                   if isinstance(p, cp) and isinstance(q, cq)]
+        if matches:
+            best = min(matches, key=lambda m: (
+                type(p).__mro__.index(m[0]), type(q).__mro__.index(m[1])))
+            fn = _KL_TABLE[best]
+    if fn is None:
         raise NotImplementedError(
             f"kl_divergence({type(p).__name__}, {type(q).__name__})")
     return fn(p, q)
@@ -520,3 +532,9 @@ def _kl_uniform(p, q):
 def _kl_exponential(p, q):
     r = q.rate / p.rate
     return paddle.log(1.0 / r) + r - 1.0
+
+
+from paddle_tpu.distribution.extras import (  # noqa: E402,F401
+    Binomial, Cauchy, ContinuousBernoulli, ExponentialFamily, Gumbel,
+    Independent, MultivariateNormal, Poisson, StudentT,
+)

@@ -1,0 +1,549 @@
+"""Distribution families closing the round-3 tail (VERDICT item 10).
+
+Reference parity: python/paddle/distribution/{cauchy,gumbel,poisson,
+binomial,continuous_bernoulli,multivariate_normal,independent,
+exponential_family}.py (+ student_t capability). Same conventions as
+paddle_tpu/distribution/__init__.py: Tensor math everywhere so log_prob/
+entropy/kl ride the autograd tape, rsample reparameterizes through
+functional-PRNG base noise, sample detaches.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+from paddle_tpu.distribution import (  # circular-safe: loaded after core
+    Distribution, _noise, _shape, _t, register_kl,
+)
+
+__all__ = ["Cauchy", "Gumbel", "StudentT", "Poisson", "Binomial",
+           "ContinuousBernoulli", "Independent", "MultivariateNormal",
+           "ExponentialFamily"]
+
+_EULER = 0.5772156649015329
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+class Cauchy(Distribution):
+    """Reference: python/paddle/distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        eps = _noise(lambda k, s: jax.random.cauchy(k, s),
+                     _shape(shape) + self.batch_shape)
+        return self.loc + self.scale * eps
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -paddle.log(self.scale) - math.log(math.pi) \
+            - paddle.log1p(z * z)
+
+    def cdf(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return paddle.atan(z) / math.pi + 0.5
+
+    def entropy(self):
+        return paddle.log(self.scale) + math.log(4 * math.pi) \
+            + paddle.zeros(list(self.batch_shape))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # Chyzak & Nielsen (2019) closed form
+    sq = (p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2
+    return paddle.log(sq / (4.0 * p.scale * q.scale))
+
+
+class Gumbel(Distribution):
+    """Reference: python/paddle/distribution/gumbel.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * _EULER
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return paddle.sqrt(self.variance)
+
+    def rsample(self, shape=()):
+        g = _noise(lambda k, s: jax.random.gumbel(k, s),
+                   _shape(shape) + self.batch_shape)
+        return self.loc + self.scale * g
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(z + paddle.exp(-z)) - paddle.log(self.scale)
+
+    def cdf(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return paddle.exp(-paddle.exp(-z))
+
+    def entropy(self):
+        return paddle.log(self.scale) + 1.0 + _EULER \
+            + paddle.zeros(list(self.batch_shape))
+
+
+class StudentT(Distribution):
+    """Student's t (df, loc, scale). Reference capability:
+    python/paddle/distribution/student_t.py (newer snapshots)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return paddle.where(self.df > 1.0,
+                            paddle.broadcast_to(
+                                self.loc, list(self.batch_shape))
+                            if self.batch_shape else self.loc,
+                            paddle.full_like(self.df, float("nan")))
+
+    @property
+    def variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2.0)
+        inf = paddle.full_like(self.df, float("inf"))
+        nan = paddle.full_like(self.df, float("nan"))
+        return paddle.where(self.df > 2.0, v,
+                            paddle.where(self.df > 1.0, inf, nan))
+
+    def rsample(self, shape=()):
+        """t = normal / sqrt(chi2/df). Pathwise gradients are exact for
+        loc/scale; for df they flow only through the explicit
+        ``/sqrt(chi2/df)`` factor — the gamma draw itself is detached
+        (no implicit-reparameterization term), so fitting df by rsample
+        gradients is approximate."""
+        sh = _shape(shape) + self.batch_shape
+        z = _noise(lambda k, s: jax.random.normal(k, s), sh)
+        g = _noise(lambda k, s: jax.random.gamma(
+            k, jnp.broadcast_to(0.5 * self.df.value, s)), sh)
+        chi2 = 2.0 * g
+        return self.loc + self.scale * z / paddle.sqrt(chi2 / self.df)
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        half = 0.5 * (self.df + 1.0)
+        return paddle.lgamma(half) - paddle.lgamma(0.5 * self.df) \
+            - 0.5 * paddle.log(self.df * math.pi) - paddle.log(self.scale) \
+            - half * paddle.log1p(z * z / self.df)
+
+    def entropy(self):
+        half = 0.5 * (self.df + 1.0)
+        return half * (paddle.digamma(half) - paddle.digamma(0.5 * self.df)) \
+            + 0.5 * paddle.log(self.df) + _betaln_(0.5 * self.df,
+                                                   _t(0.5)) \
+            + paddle.log(self.scale) + paddle.zeros(list(self.batch_shape))
+
+
+def _betaln_(a, b):
+    return paddle.lgamma(a) + paddle.lgamma(b) - paddle.lgamma(a + b)
+
+
+class Poisson(Distribution):
+    """Reference: python/paddle/distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        sh = _shape(shape) + self.batch_shape
+        out = _noise(lambda k, s: jax.random.poisson(
+            k, jnp.broadcast_to(self.rate.value, s), s), sh)
+        return out.astype("float32")
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * paddle.log(self.rate) - self.rate - paddle.lgamma(v + 1.0)
+
+    def entropy(self):
+        # series approximation matching the reference implementation's
+        # moment expansion for large rate; exact summation is used below
+        # a small-rate threshold
+        r = self.rate
+        large = 0.5 * paddle.log(2 * math.pi * math.e * r) \
+            - 1.0 / (12.0 * r) - 1.0 / (24.0 * r * r)
+        ks = jnp.arange(0.0, 30.0)
+        rv = jnp.asarray(r.value)[..., None]
+        logpmf = (ks * jnp.log(jnp.maximum(rv, 1e-30)) - rv
+                  - jax.scipy.special.gammaln(ks + 1.0))
+        pmf = jnp.exp(logpmf)
+        small = Tensor((-pmf * logpmf).sum(-1))
+        return paddle.where(r > 10.0, large, small)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return p.rate * (paddle.log(p.rate) - paddle.log(q.rate)) \
+        + q.rate - p.rate
+
+
+class Binomial(Distribution):
+    """Reference: python/paddle/distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        sh = _shape(shape) + self.batch_shape
+        out = _noise(lambda k, s: jax.random.binomial(
+            k, jnp.broadcast_to(self.total_count.value, s),
+            jnp.broadcast_to(self.probs.value, s), shape=s), sh)
+        return out.astype("float32")
+
+    def log_prob(self, value):
+        v = _t(value)
+        n, p = self.total_count, self.probs
+        eps = 1e-12
+        comb = paddle.lgamma(n + 1.0) - paddle.lgamma(v + 1.0) \
+            - paddle.lgamma(n - v + 1.0)
+        return comb + v * paddle.log(p + eps) \
+            + (n - v) * paddle.log(1.0 - p + eps)
+
+    def entropy(self):
+        # sum over the support (reference computes the exact sum); under
+        # jit total_count is traced and can't size the support -> use a
+        # static truncation (terms beyond n contribute exactly 0 via the
+        # ks <= n mask, so this only costs compute, not accuracy, as long
+        # as n < 128)
+        try:
+            nmax = int(jnp.max(self.total_count.value))
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            nmax = 127
+        ks = jnp.arange(0.0, nmax + 1.0)
+        n = self.total_count.value[..., None]
+        p = jnp.clip(self.probs.value[..., None], 1e-12, 1 - 1e-12)
+        logpmf = (jax.scipy.special.gammaln(n + 1.0)
+                  - jax.scipy.special.gammaln(ks + 1.0)
+                  - jax.scipy.special.gammaln(n - ks + 1.0)
+                  + ks * jnp.log(p) + (n - ks) * jnp.log1p(-p))
+        logpmf = jnp.where(ks <= n, logpmf, -jnp.inf)
+        pmf = jnp.exp(logpmf)
+        return Tensor(-(pmf * jnp.where(pmf > 0, logpmf, 0.0)).sum(-1))
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial(p, q):
+    eps = 1e-12
+    return p.total_count * (
+        p.probs * (paddle.log(p.probs + eps) - paddle.log(q.probs + eps))
+        + (1.0 - p.probs) * (paddle.log(1.0 - p.probs + eps)
+                             - paddle.log(1.0 - q.probs + eps)))
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference: python/paddle/distribution/continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        lo, hi = self._lims
+        return paddle.logical_or(self.probs < lo, self.probs > hi)
+
+    def _log_norm(self):
+        """log C(p); C = 2*atanh(1-2p)/(1-2p) away from 1/2, -> log 2 at
+        1/2 (Taylor-stable blend, reference's cut_probs trick)."""
+        x = 1.0 - 2.0 * self._cut()
+        exact = paddle.log(2.0 * paddle.atanh(x) / x)
+        mid = self.probs - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0) * mid * mid
+        return paddle.where(self._outside(), exact, taylor)
+
+    def _cut(self):
+        """probs with the near-1/2 region replaced by a safe constant —
+        the reference's cut_probs trick. jnp.where propagates NaN grads
+        from the UNSELECTED branch, so the singular exact formulas must
+        never see probs ~ 0.5 even when the Taylor branch is selected."""
+        lo, _ = self._lims
+        safe = paddle.clip(self.probs, 1e-6, 1 - 1e-6)
+        return paddle.where(self._outside(), safe,
+                            paddle.full_like(safe, lo))
+
+    @property
+    def mean(self):
+        cut = self._cut()
+        exact = cut / (2.0 * cut - 1.0) \
+            + 1.0 / (2.0 * paddle.atanh(1.0 - 2.0 * cut))
+        mid = self.probs - 0.5
+        taylor = 0.5 + mid / 3.0
+        return paddle.where(self._outside(), exact, taylor)
+
+    def rsample(self, shape=()):
+        u = _noise(lambda k, s: jax.random.uniform(k, s, minval=1e-6,
+                                                   maxval=1 - 1e-6),
+                   _shape(shape) + self.batch_shape)
+        return self.icdf(u)
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def icdf(self, value):
+        u = _t(value)
+        p = self._cut()
+        q = 1.0 - p
+        exact = (paddle.log1p(u * (p / q - 1.0))
+                 / (paddle.log(p) - paddle.log(q)))
+        return paddle.where(self._outside(), exact, u)
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = paddle.clip(self.probs, 1e-6, 1 - 1e-6)
+        return v * paddle.log(p) + (1.0 - v) * paddle.log(1.0 - p) \
+            + self._log_norm()
+
+    def entropy(self):
+        # E[-log p(X)] via the closed-form mean
+        p = paddle.clip(self.probs, 1e-6, 1 - 1e-6)
+        m = self.mean
+        return -(m * paddle.log(p) + (1.0 - m) * paddle.log(1.0 - p)) \
+            - self._log_norm()
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _kl_continuous_bernoulli(p, q):
+    pp = paddle.clip(p.probs, 1e-6, 1 - 1e-6)
+    qp = paddle.clip(q.probs, 1e-6, 1 - 1e-6)
+    m = p.mean
+    return m * (paddle.log(pp) - paddle.log(qp)) \
+        + (1.0 - m) * (paddle.log(1.0 - pp) - paddle.log(1.0 - qp)) \
+        + p._log_norm() - q._log_norm()
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims of ``base`` as event dims.
+    Reference: python/paddle/distribution/independent.py."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {reinterpreted_batch_rank} > "
+                f"base batch rank {len(base.batch_shape)}")
+        self.base = base
+        self.reinterpreted_batch_rank = reinterpreted_batch_rank
+        cut = len(base.batch_shape) - reinterpreted_batch_rank
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_rightmost(self, x):
+        for _ in range(self.reinterpreted_batch_rank):
+            x = paddle.sum(x, axis=-1)
+        return x
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self.base.entropy())
+
+
+class MultivariateNormal(Distribution):
+    """Reference: python/paddle/distribution/multivariate_normal.py."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        given = [a is not None for a in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril must be given")
+        if scale_tril is not None:
+            self._L = _t(scale_tril)
+        elif covariance_matrix is not None:
+            self._L = Tensor(jnp.linalg.cholesky(
+                _t(covariance_matrix).value))
+        else:
+            prec = _t(precision_matrix).value
+            # cov = inv(prec); cholesky via inverse of prec's factor
+            self._L = Tensor(jnp.linalg.cholesky(jnp.linalg.inv(prec)))
+        d = self.loc.shape[-1]
+        super().__init__(jnp.broadcast_shapes(
+            self.loc.shape[:-1], self._L.shape[:-2]), (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        Lv = self._L.value
+        return Tensor(Lv @ jnp.swapaxes(Lv, -1, -2))
+
+    @property
+    def variance(self):
+        Lv = self._L.value
+        return Tensor(jnp.sum(Lv * Lv, axis=-1))
+
+    def rsample(self, shape=()):
+        sh = _shape(shape) + self.batch_shape + self.event_shape
+        eps = _noise(lambda k, s: jax.random.normal(k, s), sh)
+        return self.loc + paddle.matmul(
+            self._L, eps.unsqueeze(-1)).squeeze(-1)
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        d = _t(value) - self.loc
+        # solve L y = d  ->  maha = |y|^2
+        y = Tensor(jax.scipy.linalg.solve_triangular(
+            jnp.broadcast_to(self._L.value,
+                             d.shape[:-1] + tuple(self._L.shape[-2:])),
+            d.value[..., None], lower=True))
+        maha = paddle.sum(y.squeeze(-1) ** 2, axis=-1)
+        half_logdet = paddle.sum(paddle.log(Tensor(jnp.abs(
+            jnp.diagonal(self._L.value, axis1=-2, axis2=-1)))), axis=-1)
+        k = self.event_shape[0]
+        return -0.5 * maha - half_logdet - k * _HALF_LOG_2PI
+
+    def entropy(self):
+        half_logdet = paddle.sum(paddle.log(Tensor(jnp.abs(
+            jnp.diagonal(self._L.value, axis1=-2, axis2=-1)))), axis=-1)
+        k = self.event_shape[0]
+        return half_logdet + 0.5 * k * (1.0 + math.log(2 * math.pi))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    Lp, Lq = p._L.value, q._L.value
+    k = p.event_shape[0]
+    # broadcast BOTH factors to the joint batch (q may carry more batch
+    # dims than p)
+    bshape = jnp.broadcast_shapes(Lp.shape[:-2], Lq.shape[:-2])
+    Lp = jnp.broadcast_to(Lp, bshape + Lp.shape[-2:])
+    Lq = jnp.broadcast_to(Lq, bshape + Lq.shape[-2:])
+    # tr(Σq⁻¹ Σp) = |Lq⁻¹ Lp|_F² ; maha through Lq solve
+    M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+    tr = jnp.sum(M * M, axis=(-2, -1))
+    d = (q.loc - p.loc).value[..., None]
+    y = jax.scipy.linalg.solve_triangular(
+        jnp.broadcast_to(Lq, d.shape[:-2] + Lq.shape[-2:]), d, lower=True)
+    maha = jnp.sum(y[..., 0] ** 2, axis=-1)
+    logdet = (jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+        Lq, axis1=-2, axis2=-1))), -1)
+        - jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            Lp, axis1=-2, axis2=-1))), -1))
+    return Tensor(logdet + 0.5 * (tr + maha - k))
+
+
+class ExponentialFamily(Distribution):
+    """Base class carrying the natural-parameter / log-normalizer
+    interface (reference: python/paddle/distribution/exponential_family.py,
+    Bregman-divergence KL via autodiff of the log normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily(p, q):
+    """Bregman divergence of the log normalizers, as ONE tape-recorded op
+    whose body differentiates the log normalizer with jax AD — gradients
+    w.r.t. every natural parameter (and through them the distributions'
+    learnable parameters) are exact, including the ∇²A term that a
+    naive 'treat ∇A as a constant' formulation drops. Reference:
+    exponential_family.py + kl.py _kl_expfamily_expfamily (which
+    differentiates its static graph the same way)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    from paddle_tpu.ops.registry import OpDef, apply_op
+    p_nat = [n if isinstance(n, Tensor) else _t(n)
+             for n in p._natural_parameters]
+    q_nat = [n if isinstance(n, Tensor) else _t(n)
+             for n in q._natural_parameters]
+    k = len(p_nat)
+
+    def impl(*nats):
+        pn, qn = nats[:k], nats[k:]
+
+        def lognorm(ns):
+            out = p._log_normalizer(*[Tensor(n) for n in ns])
+            return out.value if isinstance(out, Tensor) else jnp.asarray(out)
+
+        grads = jax.grad(lambda ns: jnp.sum(lognorm(ns)))(tuple(pn))
+        acc = lognorm(qn) - lognorm(pn)
+        for g, a, b in zip(grads, pn, qn):
+            acc = acc - g * (b - a)
+        return acc
+
+    opdef = OpDef("expfamily_bregman_kl", impl, n_outputs=1)
+    return apply_op(opdef, tuple(p_nat + q_nat), {})

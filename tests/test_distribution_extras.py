@@ -1,0 +1,285 @@
+"""Round-4 distribution tail (VERDICT item 10): Cauchy, Gumbel, StudentT,
+Poisson, Binomial, ContinuousBernoulli, Independent, MultivariateNormal,
+ExponentialFamily — log_prob/moments/sampling/KL sanity vs closed forms.
+
+Reference: python/paddle/distribution/{cauchy,gumbel,poisson,binomial,
+continuous_bernoulli,multivariate_normal,independent,exponential_family}.py
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def test_cauchy_logprob_cdf_entropy_kl():
+    c = D.Cauchy(1.0, 2.0)
+    x = 3.0
+    z = (x - 1.0) / 2.0
+    np.testing.assert_allclose(
+        float(c.log_prob(x).numpy()),
+        -math.log(math.pi * 2.0 * (1 + z * z)), rtol=1e-6)
+    np.testing.assert_allclose(float(c.cdf(1.0).numpy()), 0.5, atol=1e-6)
+    np.testing.assert_allclose(float(c.entropy().numpy()),
+                               math.log(8 * math.pi), rtol=1e-6)
+    # KL(p, p) == 0
+    np.testing.assert_allclose(
+        float(D.kl_divergence(c, D.Cauchy(1.0, 2.0)).numpy()), 0.0,
+        atol=1e-7)
+    s = c.sample([500])
+    assert s.shape == (500,)
+
+
+def test_gumbel_moments_and_sampling():
+    g = D.Gumbel(2.0, 0.5)
+    np.testing.assert_allclose(float(g.mean.numpy()),
+                               2.0 + 0.5 * 0.57721566, rtol=1e-5)
+    np.testing.assert_allclose(float(g.variance.numpy()),
+                               (math.pi ** 2 / 6) * 0.25, rtol=1e-5)
+    paddle.seed(0)
+    s = g.rsample([4000]).numpy()
+    np.testing.assert_allclose(s.mean(), float(g.mean.numpy()), atol=0.05)
+    # pdf integrates: log_prob at mode (=loc) is -log(scale) - 1
+    np.testing.assert_allclose(float(g.log_prob(2.0).numpy()),
+                               -math.log(0.5) - 1.0, rtol=1e-6)
+
+
+def test_studentt_logprob_matches_formula_and_heavy_tail():
+    t = D.StudentT(4.0, 0.0, 1.0)
+    lp = float(t.log_prob(0.0).numpy())
+    expect = (math.lgamma(2.5) - math.lgamma(2.0)
+              - 0.5 * math.log(4 * math.pi))
+    np.testing.assert_allclose(lp, expect, rtol=1e-5)
+    n = D.Normal(0.0, 1.0)
+    assert float(t.log_prob(6.0).numpy()) > float(n.log_prob(6.0).numpy())
+    paddle.seed(1)
+    s = t.rsample([2000]).numpy()
+    assert abs(np.median(s)) < 0.1
+
+
+def test_poisson_logprob_entropy_kl():
+    p = D.Poisson(4.0)
+    np.testing.assert_allclose(
+        float(p.log_prob(3.0).numpy()),
+        3 * math.log(4.0) - 4.0 - math.lgamma(4.0), rtol=1e-6)
+    # exact-sum entropy branch (rate <= 10)
+    ks = np.arange(60)
+    pmf = np.exp(ks * np.log(4.0) - 4.0
+                 - np.array([math.lgamma(k + 1) for k in ks]))
+    np.testing.assert_allclose(float(p.entropy().numpy()),
+                               -(pmf * np.log(pmf)).sum(), rtol=1e-3)
+    q = D.Poisson(2.0)
+    np.testing.assert_allclose(
+        float(D.kl_divergence(p, q).numpy()),
+        4.0 * math.log(2.0) + 2.0 - 4.0, rtol=1e-6)
+    paddle.seed(2)
+    s = p.sample([3000]).numpy()
+    np.testing.assert_allclose(s.mean(), 4.0, atol=0.15)
+
+
+def binom_lp(n, k, p):
+    return (math.lgamma(n + 1) - math.lgamma(k + 1) - math.lgamma(n - k + 1)
+            + k * math.log(p) + (n - k) * math.log(1 - p))
+
+
+def test_binomial_logprob_mean_kl_real():
+    b = D.Binomial(10.0, 0.3)
+    np.testing.assert_allclose(float(b.log_prob(3.0).numpy()),
+                               binom_lp(10, 3, 0.3), rtol=1e-5)
+    np.testing.assert_allclose(float(b.mean.numpy()), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(float(b.variance.numpy()), 2.1, rtol=1e-5)
+    q = D.Binomial(10.0, 0.5)
+    kl = 10 * (0.3 * math.log(0.3 / 0.5) + 0.7 * math.log(0.7 / 0.5))
+    np.testing.assert_allclose(float(D.kl_divergence(b, q).numpy()), kl,
+                               rtol=1e-4)
+    paddle.seed(3)
+    s = b.sample([2000]).numpy()
+    np.testing.assert_allclose(s.mean(), 3.0, atol=0.15)
+    ent = float(b.entropy().numpy())
+    pmf = np.exp([binom_lp(10, k, 0.3) for k in range(11)])
+    np.testing.assert_allclose(ent, -(pmf * np.log(pmf)).sum(), rtol=1e-4)
+
+
+def test_continuous_bernoulli_normalization_and_midpoint():
+    cb = D.ContinuousBernoulli(0.3)
+    # density integrates to ~1 over [0, 1]
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+    pdf = np.exp(cb.log_prob(paddle.to_tensor(xs)).numpy())
+    np.testing.assert_allclose(np.trapezoid(pdf, xs), 1.0, rtol=1e-3)
+    # p=0.5 region: uniform density (log C = log 2 ... x terms cancel)
+    cbm = D.ContinuousBernoulli(0.5)
+    np.testing.assert_allclose(
+        float(cbm.log_prob(0.25).numpy()), 0.0, atol=1e-3)
+    # rsample lands in [0,1] and KL(p,p)=0
+    paddle.seed(4)
+    s = cb.rsample([1000]).numpy()
+    assert (s >= 0).all() and (s <= 1).all()
+    np.testing.assert_allclose(
+        float(D.kl_divergence(cb, D.ContinuousBernoulli(0.3)).numpy()),
+        0.0, atol=1e-6)
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(np.zeros((4, 3), np.float32), np.ones((4, 3), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (4,)
+    assert ind.event_shape == (3,)
+    x = np.zeros((4, 3), np.float32)
+    np.testing.assert_allclose(
+        ind.log_prob(x).numpy(),
+        base.log_prob(x).numpy().sum(-1), rtol=1e-6)
+    with pytest.raises(ValueError):
+        D.Independent(base, 3)
+
+
+def test_multivariate_normal_logprob_entropy_kl():
+    cov = np.array([[2.0, 0.3], [0.3, 1.0]], np.float32)
+    loc = np.array([1.0, -1.0], np.float32)
+    m = D.MultivariateNormal(loc, covariance_matrix=cov)
+    x = np.array([0.5, 0.0], np.float32)
+    d = x - loc
+    maha = d @ np.linalg.inv(cov) @ d
+    expect = -0.5 * (maha + 2 * math.log(2 * math.pi)
+                     + math.log(np.linalg.det(cov)))
+    np.testing.assert_allclose(float(m.log_prob(x).numpy()), expect,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m.entropy().numpy()),
+        0.5 * math.log(np.linalg.det(cov))
+        + (1 + math.log(2 * math.pi)), rtol=1e-5)
+    np.testing.assert_allclose(m.variance.numpy(), np.diag(cov), rtol=1e-5)
+    # KL vs standard normal, closed form
+    q = D.MultivariateNormal(np.zeros(2, np.float32),
+                             covariance_matrix=np.eye(2, dtype=np.float32))
+    kl = 0.5 * (np.trace(cov) + loc @ loc - 2
+                - math.log(np.linalg.det(cov)))
+    np.testing.assert_allclose(float(D.kl_divergence(m, q).numpy()), kl,
+                               rtol=1e-5)
+    paddle.seed(5)
+    s = m.rsample([4000]).numpy()
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.1)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+
+
+def test_scale_tril_and_precision_construction_agree():
+    cov = np.array([[2.0, 0.3], [0.3, 1.0]], np.float32)
+    L = np.linalg.cholesky(cov).astype(np.float32)
+    prec = np.linalg.inv(cov).astype(np.float32)
+    loc = np.zeros(2, np.float32)
+    x = np.array([0.7, -0.2], np.float32)
+    lps = [float(D.MultivariateNormal(loc, covariance_matrix=cov)
+                 .log_prob(x).numpy()),
+           float(D.MultivariateNormal(loc, scale_tril=L).log_prob(x).numpy()),
+           float(D.MultivariateNormal(loc, precision_matrix=prec)
+                 .log_prob(x).numpy())]
+    np.testing.assert_allclose(lps[0], lps[1], rtol=1e-5)
+    np.testing.assert_allclose(lps[0], lps[2], rtol=1e-4)
+    with pytest.raises(ValueError):
+        D.MultivariateNormal(loc, covariance_matrix=cov, scale_tril=L)
+
+
+def test_exponential_family_bregman_kl_matches_normal():
+    class NormalEF(D.ExponentialFamily):
+        def __init__(self, loc, scale):
+            self.loc = paddle.to_tensor(loc)
+            self.scale = paddle.to_tensor(scale)
+            super().__init__(np.shape(loc))
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / (self.scale ** 2),
+                    -0.5 / (self.scale ** 2))
+
+        def _log_normalizer(self, n1, n2):
+            return -(n1 ** 2) / (4.0 * n2) - 0.5 * paddle.log(-2.0 * n2)
+
+    p = NormalEF(0.5, 1.5)
+    q = NormalEF(-0.3, 0.8)
+    got = float(D.kl_divergence(p, q).numpy())
+    expect = float(D.kl_divergence(D.Normal(0.5, 1.5),
+                                   D.Normal(-0.3, 0.8)).numpy())
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_rsample_gradients_flow():
+    loc = paddle.to_tensor(0.3, stop_gradient=False)
+    scale = paddle.to_tensor(1.2, stop_gradient=False)
+    paddle.seed(7)
+    g = D.Gumbel(loc, scale)
+    loss = (g.rsample([64]) ** 2).mean()
+    loss.backward()
+    assert loc.grad is not None and float(np.abs(loc.grad.numpy())) > 0
+    assert scale.grad is not None
+
+
+def test_continuous_bernoulli_no_nan_grads_at_half():
+    """Review fix: the singular exact branches must use cut probs so
+    grads at probs=0.5 are finite (jnp.where propagates unselected-branch
+    NaNs)."""
+    p = paddle.to_tensor(0.5, stop_gradient=False)
+    cb = D.ContinuousBernoulli(p)
+    cb.entropy().backward()
+    assert np.isfinite(p.grad.numpy()).all()
+    p2 = paddle.to_tensor(0.5, stop_gradient=False)
+    paddle.seed(9)
+    D.ContinuousBernoulli(p2).rsample([8]).sum().backward()
+    assert np.isfinite(p2.grad.numpy()).all()
+
+
+def test_mvn_kl_broadcasts_q_batch_over_p():
+    cov = np.eye(2, dtype=np.float32)
+    p = D.MultivariateNormal(np.zeros(2, np.float32), covariance_matrix=cov)
+    q = D.MultivariateNormal(np.zeros((3, 2), np.float32),
+                             covariance_matrix=np.broadcast_to(
+                                 cov, (3, 2, 2)).copy())
+    kl = D.kl_divergence(p, q)
+    assert kl.shape == (3,)
+    np.testing.assert_allclose(kl.numpy(), np.zeros(3), atol=1e-6)
+
+
+def test_expfamily_kl_gradients_flow():
+    class NormalEF(D.ExponentialFamily):
+        def __init__(self, loc, scale):
+            self.loc = loc if isinstance(loc, paddle.Tensor) \
+                else paddle.to_tensor(loc)
+            self.scale = scale if isinstance(scale, paddle.Tensor) \
+                else paddle.to_tensor(scale)
+            super().__init__(())
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / (self.scale ** 2),
+                    -0.5 / (self.scale ** 2))
+
+        def _log_normalizer(self, n1, n2):
+            return -(n1 ** 2) / (4.0 * n2) - 0.5 * paddle.log(-2.0 * n2)
+
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    p = NormalEF(loc, paddle.to_tensor(1.5))
+    q = NormalEF(paddle.to_tensor(-0.3), paddle.to_tensor(0.8))
+    D.kl_divergence(p, q).backward()
+    assert loc.grad is not None
+    np.testing.assert_allclose(float(loc.grad.numpy()), 0.8 / 0.8 ** 2,
+                               rtol=1e-4)
+
+
+def test_entropy_broadcasts_batch_shape():
+    g = D.Gumbel(np.zeros(5, np.float32), 1.0)
+    assert g.entropy().shape == (5,)
+    t = D.StudentT(4.0, np.zeros(3, np.float32), 1.0)
+    assert t.entropy().shape == (3,)
+
+
+def test_binomial_entropy_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    def ent(n, p):
+        return D.Binomial(paddle.Tensor(n), paddle.Tensor(p)).entropy()._value
+
+    got = jax.jit(ent)(jnp.float32(10.0), jnp.float32(0.3))
+    want = float(D.Binomial(10.0, 0.3).entropy().numpy())
+    np.testing.assert_allclose(float(got), want, rtol=1e-5)
