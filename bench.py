@@ -157,7 +157,8 @@ def _emit(metric: str, value: float, unit: str) -> dict:
     return line
 
 
-def _trainer_for(model, loss_fn, lr=1e-4, opt_name="adamw", amp=True):
+def _trainer_for(model, loss_fn, lr=1e-4, opt_name="adamw", amp=True,
+                 multi_precision=True):
     """f32 master weights + bf16 MXU ops via the AMP dispatch hook (the
     trainer's amp_dtype path), which keeps conv/BN dtype handling correct."""
     import jax
@@ -169,7 +170,8 @@ def _trainer_for(model, loss_fn, lr=1e-4, opt_name="adamw", amp=True):
     on_tpu = jax.devices()[0].platform == "tpu"
     if opt_name == "adamw":
         opt = paddle.optimizer.AdamW(learning_rate=lr,
-                                     parameters=model.parameters())
+                                     parameters=model.parameters(),
+                                     multi_precision=multi_precision)
     else:
         opt = paddle.optimizer.Momentum(learning_rate=lr, momentum=0.9,
                                         parameters=model.parameters())
@@ -397,16 +399,26 @@ def bench_unet(profile=False):
         eps = m(x, t, ctx)
         return ((eps - target).astype("float32") ** 2).mean()
 
-    trainer, mesh, on_tpu = _trainer_for(model, loss_fn, lr=1e-4)
+    # bf16 params + optimizer state (the llama-bench treatment) rather
+    # than AMP-with-f32-master: at 748M params the AdamW update alone
+    # moves ~21GB/step in f32 (~26ms of the round-3 207ms device step),
+    # and every activation copy/transpose halves too
+    if on_tpu:
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
+    trainer, mesh, on_tpu = _trainer_for(model, loss_fn, lr=1e-4, amp=False,
+                                         multi_precision=False)
     B = 8 if on_tpu else 1
     side = 64 if on_tpu else 16
     ctx_len, ctx_dim = (77, cfg.context_dim or 1024) if on_tpu else (8, 32)
     steps = 10 if on_tpu else 2
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(B, cfg.in_channels, side, side)).astype(np.float32)
+    import ml_dtypes
+    npdt = ml_dtypes.bfloat16 if on_tpu else np.float32
+    x = rng.normal(size=(B, cfg.in_channels, side, side)).astype(npdt)
     t = rng.integers(0, 1000, (B,)).astype(np.int64)
-    ctx = rng.normal(size=(B, ctx_len, ctx_dim)).astype(np.float32)
-    tgt = rng.normal(size=x.shape).astype(np.float32)
+    ctx = rng.normal(size=(B, ctx_len, ctx_dim)).astype(npdt)
+    tgt = rng.normal(size=x.shape).astype(npdt)
     with mesh:
         step_time = _measure_steps(trainer, (x, t, ctx, tgt), steps)
         if profile and on_tpu:

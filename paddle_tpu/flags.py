@@ -124,6 +124,11 @@ define_flag("eager_jit_ops", True, "cache-and-jit each eager op call (vs. raw di
 define_flag("benchmark", False, "print per-step timing")
 define_flag("log_level", 0, "verbosity level for framework logging (VLOG analog)")
 define_flag("use_fused_attention", True, "use Pallas flash attention when available")
+define_flag("use_fused_group_norm", True,
+            "route GroupNorm (and the fused GroupNorm+SiLU entry) through "
+            "the Pallas kernel (ops/pallas/group_norm.py): one HBM pass "
+            "per direction vs XLA's 4-5 — the round-4 UNet profile showed "
+            "normalization dominating the step")
 define_flag("use_fused_rms_norm", True,
             "route rms_norm through the fused Pallas kernel when eligible")
 define_flag("use_fused_rope", False,

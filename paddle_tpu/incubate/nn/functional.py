@@ -18,7 +18,8 @@ from paddle_tpu.ops.registry import register_op
 __all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
            "fused_layer_norm", "fused_dropout_add", "fused_linear",
            "fused_linear_activation", "fused_feedforward",
-           "fused_multi_head_attention", "swiglu"]
+           "fused_multi_head_attention", "swiglu",
+           "fused_group_norm_silu"]
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -135,3 +136,16 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
         out = F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
                            epsilon=ln_epsilon)
     return out
+
+
+def fused_group_norm_silu(x, weight, bias, groups, epsilon=1e-5,
+                          activation="silu"):
+    """GroupNorm + SiLU in one kernel pass (reference:
+    paddle/phi/kernels/fusion/gpu add_group_norm_silu — the SD-UNet
+    serving fusion). Dispatches through the op registry so the eager
+    tape records it; falls back to the lax composition off-TPU or for
+    unsupported shapes (ops/fused_norm.py group_norm_fused routing)."""
+    from paddle_tpu.ops.registry import op_api
+    act = activation if activation else None
+    return op_api("group_norm_silu")(x, weight, bias, groups=groups,
+                                     epsilon=epsilon, act=act)

@@ -48,6 +48,19 @@ def timestep_embedding(t, dim: int):
     return Tensor(jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1))
 
 
+def _norm_silu(norm, x):
+    """GroupNorm+SiLU through one Pallas pass when eligible (the
+    reference serves SD-UNet through its fused add_group_norm_silu
+    kernel, phi/kernels/fusion); plain composition otherwise."""
+    from paddle_tpu.flags import flags
+    if (flags.use_fused_group_norm and norm.weight is not None
+            and norm.bias is not None):
+        from paddle_tpu.incubate.nn.functional import fused_group_norm_silu
+        return fused_group_norm_silu(x, norm.weight, norm.bias,
+                                     norm.num_groups, norm.epsilon)
+    return F.silu(norm(x))
+
+
 class ResBlock(nn.Layer):
     def __init__(self, in_c, out_c, time_c, groups):
         super().__init__()
@@ -60,9 +73,9 @@ class ResBlock(nn.Layer):
                      else nn.Identity())
 
     def forward(self, x, temb):
-        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv1(_norm_silu(self.norm1, x))
         h = h + self.time_proj(F.silu(temb)).unsqueeze(-1).unsqueeze(-1)
-        h = self.conv2(F.silu(self.norm2(h)))
+        h = self.conv2(_norm_silu(self.norm2, h))
         return h + self.skip(x)
 
 
@@ -184,6 +197,10 @@ class UNet2DConditionModel(nn.Layer):
     def forward(self, x, timesteps, encoder_hidden_states=None):
         cfg = self.config
         temb = self.time_mlp(timestep_embedding(timesteps, cfg.model_channels))
+        # sinusoidal embedding is f32; keep the residual stream in the
+        # model's compute dtype (bf16 training) instead of letting dtype
+        # promotion upcast every block after the first time-bias add
+        temb = temb.astype(self.conv_in.weight.dtype)
         h = self.conv_in(x)
         skips = [h]
         for lvl, blocks in enumerate(self.down_blocks):
@@ -204,4 +221,4 @@ class UNet2DConditionModel(nn.Layer):
                 if len(entry) > 1:
                     h = entry[1](h, encoder_hidden_states)
             h = self.upsamplers[i](h)
-        return self.conv_out(F.silu(self.norm_out(h)))
+        return self.conv_out(_norm_silu(self.norm_out, h))

@@ -706,6 +706,16 @@ def instance_norm(x, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
 @register_op("group_norm")
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
                data_format="NCHW"):
+    """GroupNorm (fused analog: paddle/phi/kernels/fusion add_group_norm_*).
+    Routes to the Pallas kernel (ops/pallas/group_norm.py) when
+    shapes/flags allow; missing affine params become constants whose
+    grads jax drops (zero cotangents on literals)."""
+    from paddle_tpu.ops.fused_norm import _gn_pallas_ok, group_norm_fused
+    if data_format == "NCHW" and x.ndim >= 3 \
+            and _gn_pallas_ok(x, num_groups, epsilon):
+        w = weight if weight is not None else jnp.ones(x.shape[1], x.dtype)
+        b = bias if bias is not None else jnp.zeros(x.shape[1], x.dtype)
+        return group_norm_fused(x, w, b, num_groups, epsilon, None)
     n_, c = x.shape[0], x.shape[1]
     g = num_groups
     r = jnp.reshape(x, (n_, g, c // g) + x.shape[2:])

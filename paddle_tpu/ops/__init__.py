@@ -14,6 +14,7 @@ from paddle_tpu.ops.creation import *  # noqa: F401,F403
 from paddle_tpu.ops.schema_defs import *  # noqa: F401,F403 (schema-codegen ops)
 
 from paddle_tpu.ops import fused_ce as _fused_ce  # noqa: F401 (registers fused_linear_ce)
+from paddle_tpu.ops import fused_norm as _fused_norm  # noqa: F401 (registers group_norm_silu)
 from paddle_tpu.ops import methods as _methods
 
 _methods.monkey_patch_tensor()

@@ -97,3 +97,93 @@ def _bwd(eps, res, g):
 
 
 rms_norm_fused.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused GroupNorm (+SiLU) — reference: paddle/phi/kernels/fusion/gpu
+# add_group_norm_silu / group_norm kernels (the SD-UNet serving path)
+# ---------------------------------------------------------------------------
+
+def group_norm_lax(x, w, b, groups, eps, act=None):
+    """Canonical unfused composition (fallback + pass-pattern source)."""
+    B, C = x.shape[0], x.shape[1]
+    xf = x.astype(jnp.float32).reshape((B, groups, -1))
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xhat = ((xf - m) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, C) + (1,) * (x.ndim - 2)
+    y = xhat * w.reshape(shape).astype(jnp.float32) \
+        + b.reshape(shape).astype(jnp.float32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    return y.astype(x.dtype)
+
+
+def _gn_pallas_ok(x, groups, eps) -> bool:
+    from paddle_tpu.flags import flags
+    if not flags.use_fused_group_norm or not isinstance(eps, (int, float)):
+        return False
+    from paddle_tpu.ops.pallas import group_norm as k
+    return k.supported(jnp.shape(x), groups)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def group_norm_fused(x, w, b, groups, eps, act=None):
+    if _gn_pallas_ok(x, groups, eps):
+        from paddle_tpu.ops.pallas import group_norm as k
+        return k.gn_fwd(x, w, b, groups, eps, act)[0]
+    return group_norm_lax(x, w, b, groups, eps, act)
+
+
+def _gn_fwd(x, w, b, groups, eps, act):
+    if _gn_pallas_ok(x, groups, eps):
+        from paddle_tpu.ops.pallas import group_norm as k
+        out, mean, rstd = k.gn_fwd(x, w, b, groups, eps, act)
+        return out, (x, w, b, mean, rstd)
+    return group_norm_lax(x, w, b, groups, eps, act), (x, w, b, None, None)
+
+
+def _gn_bwd(groups, eps, act, res, g):
+    x, w, b, mean, rstd = res
+    if mean is not None:
+        from paddle_tpu.ops.pallas import group_norm as k
+        return k.gn_bwd(x, w, b, mean, rstd, g, groups, act)
+    # lax fallback: same math, batched
+    B, C = x.shape[0], x.shape[1]
+    cg = C // groups
+    xf = x.astype(jnp.float32).reshape((B, groups, -1))
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    r = lax.rsqrt(var + eps)
+    xhat = ((xf - m) * r).reshape(x.shape)
+    shape = (1, C) + (1,) * (x.ndim - 2)
+    wf = w.reshape(shape).astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    if act == "silu":
+        from paddle_tpu.ops.pallas.group_norm import _silu_bwd
+        z = xhat * wf + b.reshape(shape).astype(jnp.float32)
+        dz = _silu_bwd(z, gf)
+    else:
+        dz = gf
+    red_axes = (0,) + tuple(range(2, x.ndim))
+    dw = jnp.sum(dz * xhat, axis=red_axes).astype(w.dtype)
+    db = jnp.sum(dz, axis=red_axes).astype(b.dtype)
+    dxhat = (dz * wf).reshape((B, groups, -1))
+    mu1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    xh = xhat.reshape((B, groups, -1))
+    mu2 = jnp.mean(dxhat * xh, axis=-1, keepdims=True)
+    dx = (r * (dxhat - mu1 - xh * mu2)).reshape(x.shape).astype(x.dtype)
+    return dx, dw, db
+
+
+group_norm_fused.defvjp(_gn_fwd, _gn_bwd)
+
+
+from paddle_tpu.ops.registry import register_op
+
+
+@register_op("group_norm_silu",
+             ref="paddle/phi/kernels/fusion/gpu add_group_norm_silu "
+                 "(capability analog)")
+def group_norm_silu_op(x, weight, bias, groups, epsilon=1e-5, act="silu"):
+    return group_norm_fused(x, weight, bias, groups, epsilon, act)

@@ -1,0 +1,172 @@
+"""Fused GroupNorm(+SiLU) — Pallas TPU kernels (forward + backward).
+
+Capability analog of the reference's fused GroupNorm kernels
+(paddle/phi/kernels/fusion/gpu/fused_layernorm / add_group_norm_silu —
+the SD-UNet serving path). The round-4 UNet device profile
+(bench_profile_unet.json) showed the model NORMALIZATION-bound, not
+conv-bound: GroupNorm+SiLU chains cost ~60ms of a 207ms step as XLA
+elementwise/reduce fusions making 4-5 HBM passes each. This kernel does
+one read + one write per direction, f32 statistics in VMEM, and folds
+the SiLU (and its backward) into the same pass.
+
+Layout: x is channels-first (B, C, *spatial), flattened to rows of
+(B*C, HW). One grid program handles one (batch, group) block of
+(C/G, HW) rows — stats reduce over the whole block, the per-channel
+affine rides the sublane dim. HW must be a lane multiple (128) on real
+TPU; the 8x8-latent UNet level (HW=64) falls back to XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["supported", "gn_fwd", "gn_bwd"]
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def supported(x_shape, groups: int) -> bool:
+    if len(x_shape) < 3:
+        return False
+    c = x_shape[1]
+    if c % groups:
+        return False
+    hw = 1
+    for d in x_shape[2:]:
+        hw *= d
+    # VMEM ceiling: each program holds the full (C/G, HW) slab (x, out,
+    # grad in bwd, plus f32 temporaries) — bound the f32 slab at 4MB so
+    # ~4 live copies stay inside ~16MB VMEM; larger groups fall back to
+    # XLA, which handled them before this kernel existed
+    if (c // groups) * hw * 4 > 4 * 1024 * 1024:
+        return False
+    if _use_interpret():
+        return True
+    return hw % 128 == 0
+
+
+def _silu_fwd(y):
+    return y * jax.nn.sigmoid(y)
+
+
+def _silu_bwd(z, g):
+    s = jax.nn.sigmoid(z)
+    return g * (s * (1.0 + z * (1.0 - s)))
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref,
+                *, eps, act, out_dtype):
+    xf = x_ref[0].astype(jnp.float32)              # (Cg, HW)
+    m = jnp.mean(xf)
+    # shifted two-pass variance: E[x²]−m² cancels catastrophically for
+    # mean-shifted activations (f32 rounding of E[x²] can exceed the true
+    # variance, going negative -> rsqrt NaN); the second pass stays in
+    # VMEM/registers so it costs VPU time, not HBM traffic
+    d = xf - m
+    var = jnp.mean(d * d)
+    r = jax.lax.rsqrt(var + eps)
+    xhat = (xf - m) * r
+    y = xhat * w_ref[0].astype(jnp.float32) + b_ref[0].astype(jnp.float32)
+    if act == "silu":
+        y = _silu_fwd(y)
+    o_ref[0] = y.astype(out_dtype)
+    # (1,1) vector stores — Mosaic rejects true scalar stores to VMEM
+    mean_ref[0] = jnp.full((1, 1), m, jnp.float32)
+    rstd_ref[0] = jnp.full((1, 1), r, jnp.float32)
+
+
+def gn_fwd(x, w, b, groups: int, eps: float, act=None):
+    """Returns (out, mean, rstd); mean/rstd are (B*G, 1) f32 residuals."""
+    B, C = x.shape[0], x.shape[1]
+    hw = x.size // (B * C)
+    cg = C // groups
+    # 3D blocks: (1, Cg, HW) with the trailing two dims covering the FULL
+    # array dims — Cg is rarely a sublane multiple (e.g. 10 for SD's
+    # C=320, G=32), and Mosaic only allows non-multiple blocks when they
+    # span the whole dimension
+    x3 = x.reshape(B * groups, cg, hw)
+    out, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, act=act, out_dtype=x.dtype),
+        grid=(B * groups,),
+        in_specs=[
+            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, cg, 1), lambda i, g=groups: (i % g, 0, 0)),
+            pl.BlockSpec((1, cg, 1), lambda i, g=groups: (i % g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * groups, cg, hw), x.dtype),
+            jax.ShapeDtypeStruct((B * groups, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * groups, 1, 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x3, w.reshape(groups, cg, 1), b.reshape(groups, cg, 1))
+    return out.reshape(x.shape), mean, rstd
+
+
+def _bwd_kernel(x_ref, w_ref, b_ref, mean_ref, rstd_ref, g_ref,
+                dx_ref, dwp_ref, dbp_ref, *, act, x_dtype):
+    xf = x_ref[0].astype(jnp.float32)
+    m = mean_ref[0, 0, 0]
+    r = rstd_ref[0, 0, 0]
+    xhat = (xf - m) * r
+    w = w_ref[0].astype(jnp.float32)
+    gf = g_ref[0].astype(jnp.float32)
+    if act == "silu":
+        z = xhat * w + b_ref[0].astype(jnp.float32)
+        dz = _silu_bwd(z, gf)
+    else:
+        dz = gf
+    dwp_ref[0] = jnp.sum(dz * xhat, axis=1, keepdims=True)   # (Cg, 1)
+    dbp_ref[0] = jnp.sum(dz, axis=1, keepdims=True)
+    dxhat = dz * w
+    mu1 = jnp.mean(dxhat)
+    mu2 = jnp.mean(dxhat * xhat)
+    dx_ref[0] = (r * (dxhat - mu1 - xhat * mu2)).astype(x_dtype)
+
+
+def gn_bwd(x, w, b, mean, rstd, g, groups: int, act=None):
+    """Returns (dx, dw, db) given the forward residuals."""
+    B, C = x.shape[0], x.shape[1]
+    hw = x.size // (B * C)
+    cg = C // groups
+    x3 = x.reshape(B * groups, cg, hw)
+    g3 = g.reshape(B * groups, cg, hw)
+    dx, dw_parts, db_parts = pl.pallas_call(
+        functools.partial(_bwd_kernel, act=act, x_dtype=x.dtype),
+        grid=(B * groups,),
+        in_specs=[
+            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, cg, 1), lambda i, gr=groups: (i % gr, 0, 0)),
+            pl.BlockSpec((1, cg, 1), lambda i, gr=groups: (i % gr, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, cg, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, cg, 1), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * groups, cg, hw), x.dtype),
+            jax.ShapeDtypeStruct((B * groups, cg, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * groups, cg, 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x3, w.reshape(groups, cg, 1), b.reshape(groups, cg, 1), mean, rstd,
+      g3)
+    # per-(b,g) channel partials -> (C,) by summing the batch axis
+    dw = jnp.sum(dw_parts.reshape(B, C), axis=0).astype(w.dtype)
+    db = jnp.sum(db_parts.reshape(B, C), axis=0).astype(b.dtype)
+    return dx.reshape(x.shape), dw, db
