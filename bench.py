@@ -209,7 +209,8 @@ def bench_llama(profile=False):
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 multi_precision=False)
     trainer = ShardedTrainer(model, opt, lambda m, i, l: m.loss(i, l),
                              mesh, llama_tp_plan(model, mesh))
     rng = np.random.default_rng(0)
@@ -363,9 +364,10 @@ def bench_bert(profile=False):
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
     trainer, mesh, on_tpu = _trainer_for(
-        model, lambda m, i, l: m.loss(i, l), lr=1e-4, amp=False)
+        model, lambda m, i, l: m.loss(i, l), lr=1e-4, amp=False,
+        multi_precision=False)
     B, S = (16, 512) if on_tpu else (2, 64)
-    steps = 10 if on_tpu else 2
+    steps = 20 if on_tpu else 2
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (B, S))
     labels = rng.integers(0, cfg.vocab_size, (B, S))
@@ -482,11 +484,12 @@ def bench_ernie(profile=False):
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 multi_precision=False)
     trainer = ShardedTrainer(model, opt, lambda m, i, l: m.loss(i, l),
                              mesh, plan)
     B, S = (8, 1024) if on_tpu else (2, 64)
-    steps = 10 if on_tpu else 2
+    steps = 20 if on_tpu else 2
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (B, S))
     labels = rng.integers(0, cfg.vocab_size, (B, S))
@@ -622,7 +625,8 @@ def bench_moe():
             p._set_value(p.value.astype(jnp.bfloat16))
     mesh = init_mesh((1, 1, 1), ("dp", "sep", "mp"))
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 multi_precision=False)
     trainer = ShardedTrainer(model, opt, lambda m, i, l: m.loss(i, l),
                              mesh, {})
     B, S = (8, 1024) if on_tpu else (2, 32)

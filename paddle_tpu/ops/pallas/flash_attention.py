@@ -92,11 +92,52 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[:, 0:1] + jnp.log(safe_l)
 
 
+def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       *, scale, causal, offset):
+    """Whole-sequence block: plain softmax attention in VMEM. With one
+    (q, k) block the online-softmax merge is pure overhead — no m/l
+    scratch round-trips, no acc rescale, no alpha exp. Measured 1.8x the
+    merged kernel at the BERT shape (bh=192, S=512, d=64, non-causal)."""
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    o_ref[0] = (pv / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = sq // block_q
     nk = sk // block_k
+    if nq == 1 and nk == 1:
+        return pl.pallas_call(
+            functools.partial(_fwd_single_kernel, scale=scale,
+                              causal=causal, offset=sk - sq),
+            grid=(bh,),
+            in_specs=[pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+                      pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+                      pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0))],
+            out_specs=[pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+                       pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                       jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=interpret,
+        )(q, k, v)
     grid = (bh, nq, nk)
 
     kernel = functools.partial(

@@ -97,3 +97,44 @@ def test_bf16_io():
                          v.astype(jnp.float32), True)
     np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
                                np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("causal,sq,sk", [(False, 64, 64), (False, 32, 64),
+                                          (True, 64, 64), (True, 32, 64)])
+def test_single_block_kernel_matches_reference(causal, sq, sk):
+    """Round-4 single-block specialization (_fwd_single_kernel): when the
+    whole sequence fits one (q,k) block, the merge-free kernel must match
+    reference attention for non-causal, causal, and chunked-prefill
+    (sq<sk bottom-right-aligned offset) shapes — values AND grads."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.default_rng(5)
+    bh, d = 4, 32
+    q = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, sk, d)), jnp.float32)
+    do = jnp.asarray(rng.standard_normal((bh, sq, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def ref(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        if causal:
+            rows = jnp.arange(sq)[:, None] + (sk - sq)
+            cols = jnp.arange(sk)[None, :]
+            s = jnp.where(rows >= cols, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bqk,bkd->bqd", p, v)
+
+    def kern(q, k, v):
+        # block == full seq -> _fwd_single_kernel path
+        return fa._flash(q, k, v, scale, causal, sq, sk, fa._use_interpret())
+
+    out_r, vjp_r = jax.vjp(ref, q, k, v)
+    out_k, vjp_k = jax.vjp(kern, q, k, v)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=2e-4, atol=2e-4)
+    for gr, gk in zip(vjp_r(do), vjp_k(do)):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-3)
