@@ -29,6 +29,13 @@ class Config:
         self._device = "tpu"
         self._memory_optim = True
         self._layer = None
+        self._aot_dir = None
+
+    def set_aot_bundle(self, bundle_dir: str):
+        """Serve from an AOT bundle (inference/bundle.py): StableHLO
+        entries with baked-in weights — the serving process imports no
+        model Python (AnalysisPredictor-from-artifact analog)."""
+        self._aot_dir = bundle_dir
 
     def set_model(self, model_path: str, params_path: Optional[str] = None):
         self.model_path = model_path
@@ -67,6 +74,16 @@ class _IOHandle:
 class Predictor:
     def __init__(self, config: Config):
         self.config = config
+        if getattr(config, "_aot_dir", None) is not None:
+            from paddle_tpu.inference.bundle import AotPredictor
+            aot = AotPredictor(config._aot_dir, device=config._device)
+            self._aot = aot
+            self._layer = None
+            self._input_names = aot.get_input_names()
+            self._output_names = aot.get_output_names()
+            self._feeds, self._results = {}, {}
+            return
+        self._aot = None
         if config._layer is not None:
             self._layer = config._layer
         elif config.model_path is not None:
@@ -96,6 +113,16 @@ class Predictor:
         return _IOHandle(self, name)
 
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        if self._aot is not None:
+            feeds = dict(self._feeds)
+            if inputs is not None:
+                feeds = {n: np.asarray(a)
+                         for n, a in zip(self._input_names, inputs)}
+            self._results = self._aot.run(feeds)
+            self._output_names = list(self._aot.get_output_names())
+            if inputs is not None:
+                return [self._results[n] for n in self._output_names]
+            return True
         if inputs is not None:
             args = [Tensor(np.asarray(a)) for a in inputs]
         else:
@@ -119,6 +146,14 @@ class Predictor:
         inference/generate.py). Only causal-LM layers with a Llama-style
         config are supported; the decoder is cached on the predictor so
         repeated calls reuse the compiled prefill/step executables."""
+        if self._aot is not None:
+            if eos_token_id is not None:
+                raise NotImplementedError(
+                    "AOT bundles run the greedy scan fully on device; "
+                    "per-row eos stopping is a host-loop feature — "
+                    "generate without eos_token_id and trim on the host")
+            return self._aot.generate(input_ids,
+                                      max_new_tokens=max_new_tokens)
         from paddle_tpu.inference.generate import LlamaDecoder
         dec = getattr(self, "_decoder", None)
         if dec is None or dec.max_len < max_len:
@@ -133,5 +168,9 @@ def create_predictor(config: Config) -> Predictor:
 
 
 from paddle_tpu.inference.aot import load_compiled, save_compiled  # noqa: E402,F401
+from paddle_tpu.inference.bundle import (  # noqa: E402,F401
+    AotPredictor, export_decoder_bundle, export_predict_bundle,
+)
 
-__all__ += ["save_compiled", "load_compiled"]
+__all__ += ["save_compiled", "load_compiled", "AotPredictor",
+            "export_predict_bundle", "export_decoder_bundle"]

@@ -1,0 +1,162 @@
+"""AOT predictor bundles (round-4 VERDICT item 3): save in one process,
+load in a FRESH subprocess with no model Python, get batched predict and
+greedy generate parity with the in-process paths.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h +
+paddle_analysis_config.h (configurable predictor over an exported
+artifact, named IO, multiple entries, shape buckets).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _run_fresh(code: str) -> str:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-3000:]
+    return out.stdout
+
+
+def test_predict_bundle_subprocess_parity(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    expect = net(paddle.to_tensor(x)).numpy()
+
+    from paddle_tpu.inference import export_predict_bundle
+    bdir = str(tmp_path / "bundle")
+    export_predict_bundle(net, [x], bdir, input_names=["features"],
+                          output_names=["logits"], extra_batch_sizes=[2])
+    meta = json.load(open(os.path.join(bdir, "bundle.json")))
+    assert meta["inputs"] == ["features"]
+    assert len(meta["buckets"]) == 2
+
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "expect.npy", expect)
+    # fresh process: ONLY the inference surface is imported — loading
+    # must not need the model class or state dict
+    code = textwrap.dedent(f"""
+        import numpy as np
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.inference import Config, create_predictor
+        cfg = Config()
+        cfg.set_aot_bundle({bdir!r})
+        pred = create_predictor(cfg)
+        assert pred.get_input_names() == ["features"]
+        assert pred.get_output_names() == ["logits"]
+        x = np.load({str(tmp_path / 'x.npy')!r})
+        h = pred.get_input_handle("features")
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle("logits").copy_to_cpu()
+        np.testing.assert_allclose(
+            out, np.load({str(tmp_path / 'expect.npy')!r}),
+            rtol=1e-5, atol=1e-5)
+        # second bucket (B=2) serves too
+        out2 = pred.run([x[:2]])[0]
+        np.testing.assert_allclose(
+            out2, np.load({str(tmp_path / 'expect.npy')!r})[:2],
+            rtol=1e-5, atol=1e-5)
+        # unknown shape -> clear bucket error
+        try:
+            pred.run([x[:3]])
+            raise SystemExit("bucket miss should raise")
+        except ValueError as e:
+            assert "bucket" in str(e)
+        print("PREDICT_OK")
+    """)
+    assert "PREDICT_OK" in _run_fresh(code)
+
+
+@pytest.mark.slow
+def test_decoder_bundle_subprocess_generate_parity(tmp_path):
+    from paddle_tpu.inference import export_decoder_bundle
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64)
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    dec = LlamaDecoder(model, max_len=64)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int64)
+    expect = dec.generate(ids, max_new_tokens=6)
+
+    bdir = str(tmp_path / "dec_bundle")
+    export_decoder_bundle(dec, bdir, prompt_lens=[8], decode_steps=[5, 16],
+                          batch_sizes=[2])
+    np.save(tmp_path / "ids.npy", ids)
+    np.save(tmp_path / "expect.npy", expect)
+
+    code = textwrap.dedent(f"""
+        import numpy as np
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.inference import Config, create_predictor
+        cfg = Config()
+        cfg.set_aot_bundle({bdir!r})
+        pred = create_predictor(cfg)
+        ids = np.load({str(tmp_path / 'ids.npy')!r})
+        out = pred.generate(ids, max_new_tokens=6)
+        np.testing.assert_array_equal(
+            out, np.load({str(tmp_path / 'expect.npy')!r}))
+        # larger decode bucket (16 >= 9) serves a longer request, trimmed
+        out10 = pred.generate(ids, max_new_tokens=10)
+        assert out10.shape == (2, 18)
+        assert (out10[:, :14] == out[:, :14]).all()
+        print("GENERATE_OK")
+    """)
+    assert "GENERATE_OK" in _run_fresh(code)
+
+
+def test_decoder_bundle_multi_batch_and_limits(tmp_path):
+    """Review fixes: every exported batch size is servable (per-B cache
+    metadata), max_len overflow raises, and eos via the predictor raises
+    NotImplementedError instead of silently diverging."""
+    from paddle_tpu.inference import AotPredictor, Config, \
+        create_predictor, export_decoder_bundle
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=32)
+    paddle.seed(5)
+    model = LlamaForCausalLM(cfg)
+    dec = LlamaDecoder(model, max_len=32)
+    bdir = str(tmp_path / "b")
+    export_decoder_bundle(dec, bdir, prompt_lens=[4], decode_steps=[4],
+                          batch_sizes=[1, 3])
+    pred = AotPredictor(bdir)
+    rng = np.random.default_rng(2)
+    for B in (1, 3):
+        ids = rng.integers(0, 64, (B, 4)).astype(np.int64)
+        out = pred.generate(ids, max_new_tokens=5)
+        np.testing.assert_array_equal(
+            out, dec.generate(ids, max_new_tokens=5))
+    with pytest.raises(ValueError, match="max_len"):
+        pred.generate(np.zeros((1, 4), np.int64), max_new_tokens=40)
+    with pytest.raises(ValueError, match="prefill bucket"):
+        pred.generate(np.zeros((2, 4), np.int64), max_new_tokens=5)
+    c = Config()
+    c.set_aot_bundle(bdir)
+    p = create_predictor(c)
+    with pytest.raises(NotImplementedError):
+        p.generate(np.zeros((1, 4), np.int64), max_new_tokens=5,
+                   eos_token_id=2)
