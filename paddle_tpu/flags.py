@@ -165,6 +165,11 @@ define_flag("train_rng_impl", "rbg",
             "RNG path — threefry mask generation alone cost ~36 ms/step on "
             "the 183M-param dropout-0.1 GPT config (v5e); 'threefry2x32' "
             "restores the jax default (cross-backend reproducible streams)")
+define_flag("decompose_fused_ops", False,
+            "trace-time decomposition mode (passes.decompose_fused): "
+            "every fused/Pallas-routed op runs its canonical lax "
+            "composition so passes and exporters see base primitives "
+            "only (reference: paddle/fluid/primitive/composite/)")
 define_flag("to_static_max_cond_paths", 16,
             "path budget for capturing data-dependent Python bools into "
             "lax.cond inside to_static (jit/cond_capture.py): each "

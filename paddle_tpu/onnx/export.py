@@ -8,10 +8,16 @@ framework (passes/library.decomposition_rules), and the remaining base
 prims map 1:1 onto ONNX ops, serialized with the dependency-free wire
 writer in onnx/proto.py.
 
-Covers the feed-forward/conv model families (Linear/Conv/Pool/Norm/
-activation/softmax chains — LeNet, MLPs, VGG-style nets). Ops outside the
-mapping raise with the offending primitive named. onnx/runtime.py can
-execute the exported bytes with numpy for verification.
+Covers the feed-forward/conv families (Linear/Conv/Pool/Norm/activation/
+softmax — LeNet, MLPs, VGG-style nets) AND, since round 4, the attention
+families: models trace under ``passes.decompose_fused`` so flash
+attention / fused norms / the chunked lm-head CE lower to base prims,
+general ``dot_general`` contractions map to ONNX Einsum, and embedding
+``gather`` maps to ONNX Gather — BERT-base and Llama-style decoders
+export with runtime-verified parity (tests/test_onnx_export.py). Ops
+outside the mapping raise with the offending primitive named.
+onnx/runtime.py can execute the exported bytes with numpy for
+verification.
 """
 
 from __future__ import annotations
@@ -79,6 +85,39 @@ def _attr_ints(name: str, vs) -> Msg:
         m.int64(8, int(v))
     m.int64(20, 7)
     return m
+
+
+def _attr_s(name: str, v: str) -> Msg:
+    return Msg().string(1, name).string(4, v).int64(20, 3)
+
+
+def _einsum_equation(dn, lhs_ndim: int, rhs_ndim: int) -> str:
+    """dot_general dimension_numbers -> einsum equation, with the jax
+    output layout (batch dims, then lhs free, then rhs free)."""
+    import string as _string
+
+    ((lc, rc), (lb, rb)) = dn
+    letters = iter(_string.ascii_lowercase)
+    l = [None] * lhs_ndim
+    r = [None] * rhs_ndim
+    for i, j in zip(lb, rb):
+        c = next(letters)
+        l[i] = c
+        r[j] = c
+    for i, j in zip(lc, rc):
+        c = next(letters)
+        l[i] = c
+        r[j] = c
+    for i in range(lhs_ndim):
+        if l[i] is None:
+            l[i] = next(letters)
+    for j in range(rhs_ndim):
+        if r[j] is None:
+            r[j] = next(letters)
+    out = ([l[i] for i in lb]
+           + [l[i] for i in range(lhs_ndim) if i not in lb and i not in lc]
+           + [r[j] for j in range(rhs_ndim) if j not in rb and j not in rc])
+    return f"{''.join(l)},{''.join(r)}->{''.join(out)}"
 
 
 class _Graph:
@@ -294,12 +333,42 @@ def _map_eqn(g: _Graph, eqn) -> None:
     elif p == "dot_general":
         ((lc, rc), (lb, rb)) = params["dimension_numbers"]
         lhs_ndim = len(eqn.invars[0].aval.shape)
+        rhs_ndim = len(eqn.invars[1].aval.shape)
         if (not lb and not rb and tuple(lc) == (lhs_ndim - 1,)
                 and tuple(rc) == (0,)):
             g.node("MatMul", ins, outs)
         else:
+            # general contraction (attention q·kᵀ, batched matmuls, ...)
+            g.node("Einsum", ins, outs, [_attr_s(
+                "equation", _einsum_equation(params["dimension_numbers"],
+                                             lhs_ndim, rhs_ndim))])
+    elif p == "gather":
+        dn = params["dimension_numbers"]
+        op_shape = eqn.invars[0].aval.shape
+        idx_shape = eqn.invars[1].aval.shape
+        ss = tuple(params["slice_sizes"])
+        # the embedding-lookup pattern (jnp.take along axis 0): indices
+        # (..., 1) pick whole rows of a (V, ...) table
+        n_batch = len(idx_shape) - 1
+        if (tuple(dn.start_index_map) == (0,)
+                and tuple(dn.collapsed_slice_dims) == (0,)
+                and idx_shape[-1] == 1
+                and ss == (1,) + tuple(op_shape[1:])
+                and tuple(dn.offset_dims) == tuple(
+                    range(n_batch, n_batch + len(op_shape) - 1))):
+            flat = f"{outs[0]}_idx"
+            g.node("Reshape", [ins[1], g.const(np.asarray(
+                [int(d) for d in idx_shape[:-1]], np.int64), "ishape")],
+                [flat])
+            g.node("Gather", [ins[0], flat], outs, [_attr_i("axis", 0)])
+        else:
             raise NotImplementedError(
-                f"dot_general dims {params['dimension_numbers']}")
+                f"gather pattern {dn} slice_sizes={ss}")
+    elif p == "erfc":
+        tmp = f"{outs[0]}_erf"
+        g.node("Erf", ins, [tmp])
+        g.node("Sub", [g.const(np.asarray(
+            1, _np_dtype(eqn.invars[0].aval.dtype))), tmp], outs)
     elif p == "conv_general_dilated":
         dn = params["dimension_numbers"]
         if dn.lhs_spec != (0, 1, 2, 3) or dn.rhs_spec != (0, 1, 2, 3) or \
@@ -412,7 +481,13 @@ def to_model_bytes(layer, example_inputs, opset_version: int = 13) -> bytes:
             outs = out if isinstance(out, (tuple, list)) else (out,)
             return [o._value for o in outs]
 
-        closed = jax.make_jaxpr(fn)(vals, *[jnp.asarray(x) for x in xs])
+        # fused/Pallas-routed ops trace as their canonical lax
+        # compositions (passes.decompose_fused) — flash attention,
+        # fused norms, and the chunked lm-head CE would otherwise emit
+        # opaque pallas_call / scan equations no ONNX op maps to
+        from paddle_tpu.passes import decompose_fused
+        with decompose_fused():
+            closed = jax.make_jaxpr(fn)(vals, *[jnp.asarray(x) for x in xs])
         closed = _inline_calls(closed)
         closed = rewrite_jaxpr(closed, decomposition_rules(), recurse=False)
         closed = _inline_calls(closed)

@@ -40,6 +40,8 @@ def _attrs(node_fields) -> Dict[str, object]:
             out[name] = float(a[2][0])
         elif atype == 2:
             out[name] = int(_signed(a[3][0]))
+        elif atype == 3:          # STRING: AttributeProto.s (field 4)
+            out[name] = a[4][0]
         elif atype == 7:
             out[name] = [int(_signed(v)) for v in a.get(8, [])]
         elif atype == 4:
@@ -212,6 +214,18 @@ def run_model(data: bytes, inputs: List[np.ndarray]) -> List[np.ndarray]:
                         "avg")
         elif op == "ArgMax":
             r = np.argmax(x[0], axis=a["axis"])
+        elif op in ("Sin", "Cos", "Floor", "Ceil", "Sign", "Not"):
+            fn = {"Sin": np.sin, "Cos": np.cos, "Floor": np.floor,
+                  "Ceil": np.ceil, "Sign": np.sign,
+                  "Not": np.logical_not}[op]
+            r = fn(x[0])
+        elif op == "Einsum":
+            eq = a["equation"]
+            eq = eq.decode() if isinstance(eq, bytes) else eq
+            r = np.einsum(eq, *x)
+        elif op == "Gather":
+            r = np.take(x[0], x[1].astype(np.int64),
+                        axis=int(a.get("axis", 0)))
         elif op in ("Equal", "Less", "Greater", "LessOrEqual",
                     "GreaterOrEqual"):
             fn = {"Equal": np.equal, "Less": np.less,

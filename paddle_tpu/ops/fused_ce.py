@@ -192,11 +192,35 @@ def fused_lm_loss(hidden, head, labels, ignore_index: int = -100):
                                      ignore_index=ignore_index)
 
 
+def fused_ce_lax(hidden, head, labels, ignore_index: int = -100):
+    """Canonical decomposition of the fused lm-head CE: materialized
+    logits + stable logsumexp in base lax prims — same semantics as the
+    chunked kernel to f32 roundoff. Used by passes.decompose_fused (and
+    through it the ONNX exporter), which cannot lower the kernel's
+    lax.scan over vocab chunks."""
+    labels = labels.astype(jnp.int32)
+    logits = jax.lax.dot_general(
+        hidden, head, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m = jnp.max(logits, axis=1, keepdims=True)
+    lse = (m[:, 0] + jnp.log(jnp.sum(jnp.exp(logits - m), axis=1)))
+    V = head.shape[1]
+    safe = jnp.clip(labels, 0, V - 1)
+    gold = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+    not_ignored = labels != ignore_index
+    in_range = (labels >= 0) & (labels < V)
+    denom = jnp.maximum(jnp.sum(not_ignored), 1)
+    return jnp.sum(jnp.where(not_ignored & in_range, lse - gold, 0.0)) / denom
+
+
 @register_op("fused_linear_ce",
              ref="paddle/phi/kernels/fusion/ + cross_entropy_with_softmax "
                  "(capability analog)")
 def fused_linear_ce_op(hidden, head, labels, chunk: int = None,
                        ignore_index: int = -100):
+    from paddle_tpu.flags import flags
+    if flags.decompose_fused_ops:
+        return fused_ce_lax(hidden, head, labels, ignore_index)
     if chunk is None:
         chunk = auto_chunk(hidden.shape[0], head.shape[1])
     return fused_linear_cross_entropy(hidden, head, labels, chunk,

@@ -5,12 +5,15 @@ See rewrite.py for the engine and library.py for the built-in rules."""
 from paddle_tpu.passes.rewrite import (EqnRule, MatchInfo, PassManager,
                                        RewriteRule, dce_jaxpr, rewrite,
                                        rewrite_jaxpr)
-from paddle_tpu.passes.library import (DEFAULT_DECOMPOSITIONS, amp_cast_rules,
-                                       decompose_rule, decomposition_rules,
+from paddle_tpu.passes.library import (DEFAULT_DECOMPOSITIONS,
+                                       FUSED_ROUTING_OFF, amp_cast_rules,
+                                       decompose_fused, decompose_rule,
+                                       decomposition_rules,
                                        fuse_rms_norm_rule)
 
 __all__ = [
     "EqnRule", "MatchInfo", "PassManager", "RewriteRule", "dce_jaxpr",
     "rewrite", "rewrite_jaxpr", "DEFAULT_DECOMPOSITIONS", "amp_cast_rules",
     "decompose_rule", "decomposition_rules", "fuse_rms_norm_rule",
+    "decompose_fused", "FUSED_ROUTING_OFF",
 ]

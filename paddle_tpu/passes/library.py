@@ -21,6 +21,7 @@ from paddle_tpu.passes.rewrite import EqnRule, MatchInfo, RewriteRule
 __all__ = [
     "fuse_rms_norm_rule", "amp_cast_rules", "decompose_rule",
     "DEFAULT_DECOMPOSITIONS", "decomposition_rules",
+    "decompose_fused", "FUSED_ROUTING_OFF",
 ]
 
 
@@ -180,3 +181,50 @@ DEFAULT_DECOMPOSITIONS: Dict[str, Callable[[dict], Callable]] = {
 def decomposition_rules(table: Optional[Dict[str, Callable]] = None):
     table = DEFAULT_DECOMPOSITIONS if table is None else table
     return [decompose_rule(k, v) for k, v in table.items()]
+
+
+# --------------------------------------------------------------------------
+# fused-op decomposition mode (reference: paddle/fluid/primitive/composite/
+# composite.h + python/paddle/decomposition/ — see-through for passes and
+# exporters)
+# --------------------------------------------------------------------------
+
+# every fused/Pallas routing flag and the value that forces the canonical
+# lax composition; plus the decompose_fused_ops master switch consumed by
+# entries whose kernel is not flag-gated (chunked fused CE)
+FUSED_ROUTING_OFF: Dict[str, object] = {
+    "decompose_fused_ops": True,
+    "use_fused_rms_norm": False,
+    "use_fused_group_norm": False,
+    "use_fused_attention": False,
+    "use_fused_lm_ce": False,
+    "use_fused_rope": False,
+    "use_decode_attention": False,
+}
+
+
+class decompose_fused:
+    """Context manager: inside it, every fused op (fused_rms_norm,
+    fused GroupNorm+SiLU, flash/decode attention, fused rope, chunked
+    fused lm-head CE, fused_linear_activation/swiglu) traces as its
+    canonical base-prim composition — no pallas_call, no vocab-chunk
+    scan. Routing happens at trace time, so wrapping a trace (NOT just a
+    call) is what decomposes a jaxpr:
+
+        with passes.decompose_fused():
+            jaxpr = jax.make_jaxpr(fn)(*args)
+
+    The ONNX exporter traces under this context; parity tests assert
+    decomposed == fused numerics for every entry (test_passes.py).
+    """
+
+    def __enter__(self):
+        from paddle_tpu.flags import flags, get_flags, set_flags
+        self._old = {k: get_flags(k)[k] for k in FUSED_ROUTING_OFF}
+        set_flags(dict(FUSED_ROUTING_OFF))
+        return self
+
+    def __exit__(self, *exc):
+        from paddle_tpu.flags import set_flags
+        set_flags(self._old)
+        return False

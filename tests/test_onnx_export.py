@@ -85,3 +85,44 @@ def test_unsupported_primitive_is_named():
     x = np.random.default_rng(4).normal(size=(2, 8)).astype(np.float32)
     with pytest.raises(NotImplementedError, match="sort"):
         onnx.to_model_bytes(WithSort(), [x])
+
+
+def test_export_bert_parity_with_runtime():
+    """Round-4 VERDICT item 7: attention-family export. BERT-base-shaped
+    MLM forward exports (decompose_fused trace: flash/fused-CE/norms ->
+    base prims; Einsum for attention contractions, Gather for embedding
+    lookups) and the numpy runtime reproduces the framework output."""
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    from paddle_tpu.onnx import runtime
+    from paddle_tpu.onnx.export import to_model_bytes
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, dropout=0.0)
+    paddle.seed(0)
+    model = BertForMaskedLM(cfg)
+    model.eval()
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16))
+    expect = model(paddle.to_tensor(ids)).numpy()
+    data = to_model_bytes(model, [ids])
+    out = runtime.run_model(data, [ids])[0]
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_export_llama_parity_with_runtime():
+    """Rope + RMSNorm + GQA + SwiGLU decoder exports and verifies."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.onnx import runtime
+    from paddle_tpu.onnx.export import to_model_bytes
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    paddle.seed(1)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.random.default_rng(1).integers(0, 128, (2, 16))
+    expect = model(paddle.to_tensor(ids)).numpy()
+    data = to_model_bytes(model, [ids])
+    out = runtime.run_model(data, [ids])[0]
+    np.testing.assert_allclose(out, expect, rtol=3e-4, atol=3e-4)
