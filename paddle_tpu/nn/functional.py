@@ -49,7 +49,7 @@ __all__ = [
     "label_smooth", "square_error_cost", "sigmoid_focal_loss",
     "triplet_margin_loss", "pairwise_distance",
     # misc
-    "pad", "sequence_mask", "temporal_shift",
+    "pad", "sequence_mask", "temporal_shift", "class_center_sample",
 ]
 
 from paddle_tpu.ops.manipulation import pad, one_hot  # noqa: E402  (re-export)
@@ -1221,3 +1221,36 @@ __all__ += [
     "spectral_norm", "thresholded_relu",
     "triplet_margin_with_distance_loss",
 ]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers for margin-based softmax training
+    (python/paddle/nn/functional/common.py::class_center_sample,
+    phi class_center_sample kernel). All POSITIVE classes in ``label``
+    are kept; negative classes fill up to ``num_samples``. Returns
+    (remapped_label, sampled_class_index). Sampling is data-dependent
+    (unique counts), so this op is eager-only — inside jit, sample on
+    the host per step and feed the result. ``group``: restrict to a
+    model-parallel shard's class range [group.rank*num_classes_local, ...)
+    is handled by callers; here num_classes is THIS shard's count."""
+    import numpy as _np
+
+    lab = _np.asarray(label.numpy() if isinstance(label, Tensor)
+                      else label).astype(_np.int64)
+    pos = _np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rng_key = rnd.split_key()
+        seed = int(_np.asarray(jax.random.randint(
+            rng_key, (), 0, 2 ** 31 - 1)))
+        g = _np.random.default_rng(seed)
+        neg_pool = _np.setdiff1d(_np.arange(num_classes, dtype=_np.int64),
+                                 pos, assume_unique=True)
+        extra = g.choice(neg_pool, size=num_samples - len(pos),
+                         replace=False)
+        sampled = _np.concatenate([pos, extra])
+    remap = _np.full((num_classes,), -1, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled)))

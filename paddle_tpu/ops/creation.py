@@ -22,6 +22,7 @@ __all__ = [
     "eye", "meshgrid", "rand", "randn", "randint", "randperm", "uniform",
     "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
     "tril_indices", "triu_indices", "clone", "numel", "diagflat",
+    "binomial", "complex",
 ]
 
 
@@ -194,3 +195,21 @@ def tril_indices(row, col, offset=0):
 def triu_indices(row, col=None, offset=0):
     r, c = np.triu_indices(row, offset, col if col is not None else row)
     return _wrap(jnp.asarray(np.stack([r, c]), dtype=jnp.int64))
+
+
+def binomial(count, prob, name=None):
+    """Draws from Binomial(count, prob) elementwise
+    (paddle/phi/kernels/cpu/binomial_kernel.cc analog; int64 output)."""
+    c = count.value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob.value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    shape = jnp.broadcast_shapes(jnp.shape(c), jnp.shape(p))
+    out = jax.random.binomial(_key(), jnp.broadcast_to(c, shape).astype(jnp.float32),
+                              jnp.broadcast_to(p, shape).astype(jnp.float32))
+    return _wrap(out.astype(jnp.int64))
+
+
+def complex(real, imag, name=None):
+    """complex64/128 from real+imaginary parts (complex_kernel.cc)."""
+    r = real.value if isinstance(real, Tensor) else jnp.asarray(real)
+    i = imag.value if isinstance(imag, Tensor) else jnp.asarray(imag)
+    return _wrap(jax.lax.complex(r, i))

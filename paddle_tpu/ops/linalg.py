@@ -19,6 +19,7 @@ __all__ = [
     "cholesky_solve", "lu", "matrix_power", "matrix_rank", "det", "slogdet",
     "eig", "eigh", "eigvals", "eigvalsh", "lstsq", "cond", "cov", "corrcoef",
     "cross", "histogram", "bincount", "multi_dot",
+    "lu_unpack",
 ]
 
 
@@ -219,3 +220,37 @@ def bincount(x, weights=None, minlength=0):
 @register_op("multi_dot")
 def multi_dot(xs):
     return jnp.linalg.multi_dot(list(xs))
+
+
+@register_op("lu_unpack",
+             ref="paddle/phi/kernels/lu_unpack_kernel.h")
+def lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    """(P, L, U) from lu() output. pivots are 1-based sequential row
+    swaps (LAPACK convention, as paddle.linalg.lu returns)."""
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_mat[..., :, :k], -1) \
+            + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+    if unpack_pivots:
+        piv = pivots.astype(jnp.int32) - 1      # 0-based swap targets
+        perm0 = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32),
+                                 pivots.shape[:-1] + (m,))
+
+        def swap(perm, i):
+            j = piv[..., i]
+            pi = jnp.take(perm, i, axis=-1)
+            pj = jnp.take_along_axis(perm, j[..., None], axis=-1)[..., 0]
+            perm = jnp.where(jnp.arange(m) == i, pj[..., None], perm)
+            perm = jnp.where(jnp.arange(m) == j[..., None],
+                             pi[..., None], perm)
+            return perm, None
+
+        perm, _ = jax.lax.scan(swap, perm0,
+                               jnp.arange(pivots.shape[-1]))
+        # rows of P: P @ A applies the permutation; perm[i] = source row
+        P = jax.nn.one_hot(perm, m, axis=-1, dtype=lu_mat.dtype)
+        P = jnp.swapaxes(P, -1, -2)
+    return P, L, U

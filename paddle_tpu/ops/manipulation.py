@@ -23,7 +23,7 @@ __all__ = [
     "nonzero", "unique", "repeat_interleave", "unstack", "moveaxis",
     "swapaxes", "as_complex", "as_real", "diagonal", "diag", "diag_embed",
     "tril", "triu", "rot90", "one_hot", "pad", "crop", "tensordot",
-    "scatter_nd", "unfold_axis",
+    "scatter_nd", "unfold_axis", "as_strided", "view_dtype", "shape",
 ]
 
 
@@ -461,3 +461,46 @@ def unfold_axis(x, axis, size, step):
     out = jnp.take(x, idx, axis=axis)                    # windows at `axis`
     # paddle: windows stay at axis, window-size dim goes LAST
     return jnp.moveaxis(out, axis + 1, -1)
+
+
+@register_op("as_strided",
+             ref="paddle/phi/kernels/stride/as_strided_kernel.cc")
+def as_strided(x, shape, stride, offset=0):
+    """Strided view over x's flattened buffer. XLA has no aliasing views,
+    so this materializes the gather — semantics (incl. overlapping
+    windows) match the reference; the compiler fuses the gather into
+    consumers where profitable."""
+    flat = jnp.reshape(x, (-1,))
+    idx = jnp.asarray(offset, jnp.int32)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s, dtype=jnp.int32) * int(st)
+    return jnp.take(flat, idx.reshape(tuple(int(s) for s in shape)))
+
+
+@register_op("view_dtype",
+             ref="paddle/phi/kernels/stride/view_kernel.cc (bitcast view)")
+def view_dtype(x, dtype):
+    """Reinterpret the buffer as another dtype (bitcast). Same total
+    byte count required; the trailing dim rescales by the size ratio."""
+    import numpy as _np
+    from paddle_tpu.framework.dtype import convert_dtype
+    dt = jnp.dtype(convert_dtype(dtype))
+    src = jnp.dtype(x.dtype)
+    if dt.itemsize == src.itemsize:
+        return lax.bitcast_convert_type(x, dt)
+    if src.itemsize % dt.itemsize == 0:
+        out = lax.bitcast_convert_type(x, dt)  # adds a trailing dim
+        return out.reshape(x.shape[:-1] + (-1,))
+    k = dt.itemsize // src.itemsize
+    if x.shape[-1] % k:
+        raise ValueError(
+            f"view dtype {src}->{dt}: last dim {x.shape[-1]} not a "
+            f"multiple of {k}")
+    return lax.bitcast_convert_type(
+        x.reshape(x.shape[:-1] + (x.shape[-1] // k, k)), dt)
+
+
+@register_op("shape", differentiable=False,
+             ref="paddle/phi/kernels/shape_kernel.cc")
+def shape(x):
+    return jnp.asarray(x.shape, jnp.int32)

@@ -229,3 +229,53 @@ def monkey_patch_tensor() -> None:
         return manipulation.transpose(self, list(range(self.ndim))[::-1])
 
     Tensor.t = t
+
+
+def _patch_round4_methods():
+    """Round-4 op-compat tail: Tensor.to / view / exponential_ (reference
+    tensor_patch_methods analogs)."""
+    from paddle_tpu.framework import random as rnd
+    import jax
+
+    def _to(self, *args, **kwargs):
+        """Tensor.to(dtype) / .to(place[, dtype]): dtype casts apply,
+        places are a no-op (PJRT owns placement)."""
+        from paddle_tpu.framework.dtype import convert_dtype
+
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in (
+                    "float32", "float64", "float16", "bfloat16", "int8",
+                    "int16", "int32", "int64", "uint8", "bool"):
+                dtype = a
+                continue
+            try:  # dtype OBJECTS (paddle.float16, np/jnp dtypes) count too
+                dtype = convert_dtype(a)
+            except Exception:
+                pass  # a place/device spec: placement is PJRT's (no-op)
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def _view(self, shape_or_dtype):
+        """Tensor.view: reshape for shapes, bitcast for dtypes (the
+        reference's zero-copy view; XLA may materialize)."""
+        if isinstance(shape_or_dtype, (list, tuple)):
+            return manipulation.reshape(self, shape_or_dtype)
+        return manipulation.view_dtype(self, shape_or_dtype)
+
+    def _exponential_(self, lam=1.0):
+        """In-place fill with Exponential(lam) samples."""
+        u = jax.random.uniform(rnd.split_key(), self.shape,
+                               minval=1e-7, maxval=1.0)
+        self._set_value((-jnp.log(u) / lam).astype(self._value.dtype))
+        return self
+
+    Tensor.to = _to
+    Tensor.view = _view
+    Tensor.view_as = lambda self, other: manipulation.reshape(
+        self, list(other.shape))
+    Tensor.exponential_ = _exponential_
+
+
+_patch_round4_methods()

@@ -1,0 +1,296 @@
+"""Reference-yaml op-compat table (VERDICT round-4 item 5).
+
+Analog of paddle/phi/api/yaml/op_compat.yaml: a mechanical mapping from
+every op name in the reference's ops.yaml + legacy_ops.yaml (441 names)
+to where the capability lives in this framework. Four resolution tiers:
+
+- same-name: the registry (``OPS``) or a public namespace carries the
+  exact name (scanned automatically, see ``NAMESPACES``);
+- alias: renamed/re-homed equivalent — value is a dotted path rooted at
+  ``paddle_tpu`` that the audit IMPORTS AND VALIDATES;
+- analog ("=..."): the capability exists under a different factoring
+  (e.g. GSPMD sharding replaces c_embedding); prose names the owner;
+- absent ("~..."): genuinely not built, with the engineering reason.
+
+``audit()`` returns the full classification; tests/test_op_sweep.py
+asserts >=95%% of yaml names resolve (same-name/alias/analog) and every
+absence carries a reason.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+__all__ = ["OP_COMPAT", "audit", "yaml_op_names"]
+
+_YAML_FILES = ("/root/reference/paddle/phi/api/yaml/ops.yaml",
+               "/root/reference/paddle/phi/api/yaml/legacy_ops.yaml")
+
+# alias: value = dotted attr path under paddle_tpu (validated by audit());
+# analog: "=prose"; absent: "~reason"
+OP_COMPAT: Dict[str, str] = {
+    # ---- optimizers (yaml *_ ops are the apply kernels; the optimizer
+    #      classes own the same math as one compiled update) ----
+    "sgd_": "optimizer.SGD", "momentum_": "optimizer.Momentum",
+    "adagrad_": "optimizer.Adagrad", "adam_": "optimizer.Adam",
+    "adamw_": "optimizer.AdamW", "adamax_": "optimizer.Adamax",
+    "adadelta_": "optimizer.Adadelta", "asgd_": "optimizer.ASGD",
+    "rprop_": "optimizer.Rprop", "rmsprop_": "optimizer.RMSProp",
+    "lamb_": "optimizer.Lamb",
+    "fused_adam_": "=multi-tensor adam: the compiled train step applies "
+                   "every param in ONE XLA program (parallel/train.py)",
+    "merged_adam_": "=same as fused_adam_: XLA fuses the per-param "
+                    "updates; no separate multi-tensor kernel needed",
+    "merged_momentum_": "=see merged_adam_",
+    "average_accumulates_": "~ModelAverage/EMA optimizer infra not built; "
+                            "the optimizer state machinery (optimizer/"
+                            "optimizer.py) is where it would slot",
+    # ---- collectives (c_* fluid ops -> distributed API over mesh
+    #      collectives) ----
+    "c_allgather": "distributed.all_gather",
+    "c_allreduce_max": "distributed.all_reduce",
+    "c_allreduce_min": "distributed.all_reduce",
+    "c_allreduce_prod": "distributed.all_reduce",
+    "c_allreduce_sum": "distributed.all_reduce",
+    "c_broadcast": "distributed.broadcast",
+    "c_concat": "distributed.all_gather",
+    "c_reduce_sum": "distributed.reduce",
+    "c_identity": "assign",
+    "c_embedding": "=tensor-parallel embedding is the GSPMD-sharded "
+                   "nn.Embedding (models/llama.py llama_tp_plan shards "
+                   "the table; XLA inserts the collective)",
+    "c_sync_calc_stream": "=XLA owns stream ordering; documented no-op "
+                          "surface in device/__init__.py",
+    "c_sync_comm_stream": "=see c_sync_calc_stream",
+    # ---- amp / numerics ----
+    "check_finite_and_unscale_": "amp.GradScaler",
+    "update_loss_scaling_": "amp.GradScaler",
+    "check_numerics": "amp.debugging.check_numerics",
+    "enable_check_model_nan_inf": "set_flags",
+    "disable_check_model_nan_inf": "set_flags",
+    "accuracy_check": "=CINN-vs-dense accuracy alignment op; this build's "
+                      "equivalent gate is tests/op_test.py numeric-diff "
+                      "harness + utils/subgraph_checker.py",
+    # ---- losses / activations renames ----
+    "bce_loss": "nn.functional.binary_cross_entropy",
+    "sigmoid_cross_entropy_with_logits":
+        "nn.functional.binary_cross_entropy_with_logits",
+    "cross_entropy_with_softmax":
+        "nn.functional.softmax_with_cross_entropy",
+    "kldiv_loss": "nn.functional.kl_div",
+    "logsigmoid": "nn.functional.log_sigmoid",
+    "tanh_shrink": "nn.functional.tanhshrink",
+    "identity_loss": "=IPU-only loss-marker op in the reference; mean/sum "
+                     "reductions cover the math",
+    "warpctc": "nn.functional.ctc_loss",
+    "warprnnt": "~RNN-T loss not built (ctc_loss covers the CTC family); "
+                "a lax.scan alignment DP is the natural TPU form",
+    "margin_cross_entropy": "=margin softmax = F.class_center_sample + "
+                            "cross_entropy composition; the fused "
+                            "hybrid-parallel kernel is not rebuilt",
+    # ---- interpolate family ----
+    "bicubic_interp": "nn.functional.interpolate",
+    "bilinear_interp": "nn.functional.interpolate",
+    "linear_interp": "nn.functional.interpolate",
+    "nearest_interp": "nn.functional.interpolate",
+    "trilinear_interp": "nn.functional.interpolate",
+    # ---- conv / pool renames ----
+    "depthwise_conv2d": "nn.functional.conv2d",
+    "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
+    "conv2d_transpose_bias": "nn.functional.conv2d_transpose",
+    "pool2d": "nn.functional.avg_pool2d",
+    "pool3d": "nn.functional.avg_pool3d",
+    "max_pool2d_with_index": "nn.functional.max_pool2d",
+    "max_pool3d_with_index": "nn.functional.max_pool3d",
+    "unpool": "nn.functional.max_unpool2d",
+    "unpool3d": "nn.functional.max_unpool3d",
+    "pad3d": "nn.functional.pad",
+    "shuffle_channel": "nn.functional.channel_shuffle",
+    "deformable_conv": "vision.ops.deform_conv2d",
+    "cudnn_lstm": "nn.LSTM",
+    "rnn": "nn.RNN",
+    "sync_batch_norm_": "nn.SyncBatchNorm",
+    "fused_batch_norm_act": "=XLA fuses BN+activation chains (SURVEY "
+                            "§7.1: elementwise fusion is the compiler's)",
+    "fused_bn_add_activation": "=see fused_batch_norm_act",
+    "fused_gemm_epilogue":
+        "incubate.nn.functional.fused_linear_activation",
+    "fused_multi_transformer":
+        "incubate.nn.functional.fused_multi_head_attention",
+    "fused_softmax_mask": "nn.functional.softmax_mask_fuse",
+    "fused_softmax_mask_upper_triangle":
+        "nn.functional.softmax_mask_fuse",
+    # ---- attention ----
+    "flash_attn": "nn.functional.flash_attention",
+    "flash_attn_qkvpacked": "nn.functional.flash_attention",
+    "memory_efficient_attention":
+        "nn.functional.scaled_dot_product_attention",
+    "flash_attn_unpadded": "~varlen/ragged attention: TPU static-shape "
+                           "contract means bucketed padding + the dense "
+                           "flash kernel; a ragged kernel is not built",
+    "flash_attn_varlen_qkvpacked": "~see flash_attn_unpadded",
+    "flash_attn_with_sparse_mask": "~sparse-mask flash variant not "
+                                   "built; dense mask path covers "
+                                   "correctness (sdpa attn_mask)",
+    "masked_multihead_attention_": "=decode-attention Pallas kernel "
+                                   "(ops/pallas/decode_attention.py) "
+                                   "serves the cache-attention role",
+    # ---- random / init ----
+    "gaussian": "normal",
+    "gaussian_inplace": "normal",
+    "uniform_inplace": "uniform",
+    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
+    "dirichlet": "distribution.Dirichlet",
+    "exponential_": "Tensor.exponential_",
+    "top_p_sampling": "=inference/generate.py _sample_logits "
+                      "(temperature/top-k/top-p filtered sampling)",
+    "random_routing": "=dropless MoE (incubate/nn/moe.py) routes all "
+                      "tokens; capacity-based random routing is a "
+                      "dropping variant not used on TPU",
+    # ---- fft ----
+    "fft_c2c": "fft.fft", "fft_r2c": "fft.rfft", "fft_c2r": "fft.irfft",
+    # ---- quantization ----
+    "dequantize_abs_max": "quantization.dequantize",
+    "dequantize_log": "quantization.dequantize",
+    "fake_quantize_abs_max": "quantization.fake_quantize",
+    "fake_quantize_moving_average_abs_max": "quantization.fake_quantize",
+    "fake_quantize_range_abs_max": "quantization.fake_quantize",
+    "weight_dequantize": "quantization.dequantize",
+    "apply_per_channel_scale": "=per-channel scales are applied inside "
+                               "quantization.weight_only_linear / the "
+                               "int8 Pallas matmul tile",
+    # ---- tensor manipulation renames ----
+    "fill": "Tensor.fill_",
+    "fill_diagonal_tensor": "~sub-diagonal tensor fill not built; "
+                            "diag_embed + where covers the common cases",
+    "assign_out_": "assign",
+    "assign_value_": "assign",
+    "full_batch_size_like": "full",
+    "full_int_array": "full",
+    "full_with_tensor": "full",
+    "copy_to": "Tensor.to",
+    "memcpy_d2h": "=PJRT owns transfers (Tensor.numpy is the D2H path)",
+    "memcpy_h2d": "=PJRT owns transfers (to_tensor is the H2D path)",
+    "npu_identity": "assign",
+    "trans_layout": "=XLA layout assignment owns physical layouts",
+    "merge_selected_rows": "~selected-rows sparse-gradient format is not "
+                           "used: embedding grads are dense under jax AD",
+    "coalesce_tensor": "=XLA fuses buffers; no bucket fusion needed "
+                       "(SURVEY D18 by-design)",
+    "reverse": "flip",
+    "elementwise_pow": "pow",
+    "mean_all": "mean",
+    "split_with_num": "split",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "index_select_strided": "index_select",
+    "set_value": "=Tensor.__setitem__ (jnp .at functional updates)",
+    "set_value_with_tensor": "=Tensor.__setitem__",
+    "tensor_unfold": "unfold_axis",
+    "view_shape": "Tensor.view",
+    "inverse": "linalg.inv",
+    "matrix_rank_tol": "linalg.matrix_rank",
+    "data": "static.data",
+    "embedding_grad_dense": "=jax AD produces the dense embedding "
+                            "gradient (vjp of gather); no separate op",
+    # ---- vision tail ----
+    "generate_proposals": "~RPN proposal generation not built; the "
+                          "detection zoo beyond nms/roi_align/yolo_box "
+                          "lives in PaddleDetection externally too",
+    "matrix_nms": "~see generate_proposals",
+    "multiclass_nms3": "~see generate_proposals (single-class nms IS "
+                       "built: vision.ops.nms)",
+    "psroi_pool": "~position-sensitive roi pool not built; roi_align/"
+                  "roi_pool cover the common detectors",
+    "detection_map": "~mAP evaluation is host-side metric code in every "
+                     "ecosystem (pycocotools); not an op",
+    "yolo_box_head": "~yolo_box IS built (vision.ops.yolo_box); the "
+                     "fused head/loss training kernels are not",
+    "yolo_loss": "~see yolo_box_head",
+    "crf_decoding": "text.viterbi_decode",
+    # ---- graph sampling ----
+    "graph_khop_sampler": "~data-dependent neighbor sampling is host "
+                          "input-pipeline work on TPU; on-device message "
+                          "passing IS built (geometric.send_u_recv &co)",
+    "graph_sample_neighbors": "~see graph_khop_sampler",
+    "weighted_sample_neighbors": "~see graph_khop_sampler",
+    "reindex_graph": "~see graph_khop_sampler",
+    "segment_pool": "geometric.segment_sum",
+    # ---- misc ----
+    "auc": "metric.Auc",
+    "moe": "incubate.nn.MoELayer",
+    "clip_by_norm": "nn.ClipGradByNorm",
+}
+
+# names the automatic scan resolves via these namespaces
+NAMESPACE_PATHS = (
+    "", "nn.functional", "linalg", "fft", "geometric", "vision.ops",
+    "signal", "quantization", "text", "incubate.nn.functional",
+    "distributed", "metric", "static", "distribution", "nn",
+)
+
+
+def yaml_op_names():
+    names = set()
+    for f in _YAML_FILES:
+        try:
+            with open(f) as fh:
+                for line in fh:
+                    m = re.match(r"- op\s*:\s*(\w+)", line)
+                    if m:
+                        names.add(m.group(1))
+        except OSError:
+            pass
+    return sorted(names)
+
+
+def _lookup(path: str):
+    import paddle_tpu as paddle
+
+    obj = paddle
+    if path.startswith("Tensor."):
+        from paddle_tpu.framework.tensor import Tensor
+        return getattr(Tensor, path.split(".", 1)[1])
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def audit() -> Dict[str, Tuple[str, str]]:
+    """Classify every reference yaml op name.
+
+    Returns {name: (tier, detail)} with tier in
+    {"same-name", "alias", "analog", "absent", "UNRESOLVED"}; alias
+    targets are import-validated (a bad path shows as UNRESOLVED)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.registry import OPS
+
+    mods = []
+    for p in NAMESPACE_PATHS:
+        try:
+            mods.append(_lookup(p) if p else paddle)
+        except AttributeError:
+            pass
+
+    out: Dict[str, Tuple[str, str]] = {}
+    for n in yaml_op_names():
+        entry = OP_COMPAT.get(n)
+        if entry is not None:
+            if entry.startswith("~"):
+                out[n] = ("absent", entry[1:])
+            elif entry.startswith("="):
+                out[n] = ("analog", entry[1:])
+            else:
+                try:
+                    _lookup(entry)
+                    out[n] = ("alias", entry)
+                except AttributeError:
+                    out[n] = ("UNRESOLVED", f"bad alias target {entry!r}")
+            continue
+        base = n[:-1] if n.endswith("_") else n
+        if n in OPS or base in OPS or any(
+                hasattr(m, n) or hasattr(m, base) for m in mods):
+            out[n] = ("same-name", "")
+        else:
+            out[n] = ("UNRESOLVED", "no mapping")
+    return out

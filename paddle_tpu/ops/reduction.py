@@ -12,7 +12,7 @@ from paddle_tpu.ops.registry import register_op
 __all__ = [
     "sum", "mean", "prod", "max", "min", "amax", "amin", "argmax", "argmin",
     "all", "any", "std", "var", "median", "nanmedian", "nansum", "nanmean",
-    "logsumexp", "count_nonzero", "mode", "quantile",
+    "logsumexp", "count_nonzero", "mode", "quantile", "reduce_as",
 ]
 
 
@@ -148,3 +148,14 @@ def mode(x, axis=-1, keepdim=False):
 @register_op("quantile")
 def quantile(x, q, axis=None, keepdim=False):
     return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+@register_op("reduce_as", ref="paddle/phi/kernels/reduce_as_kernel.h")
+def reduce_as(x, target):
+    """Sum x down to target's shape (the broadcast-inverse reduction)."""
+    tshape = tuple(target.shape)
+    nd = len(x.shape) - len(tshape)
+    axes = tuple(range(nd)) + tuple(
+        i + nd for i, t in enumerate(tshape) if t == 1 and x.shape[i + nd] != 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return jnp.reshape(out, tshape)

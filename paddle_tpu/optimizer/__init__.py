@@ -1,5 +1,7 @@
 """paddle_tpu.optimizer (python/paddle/optimizer analog)."""
 
-from paddle_tpu.optimizer.optimizer import Adagrad, Momentum, Optimizer, RMSProp, SGD  # noqa: F401
+from paddle_tpu.optimizer.optimizer import (Adadelta, Adagrad, Adamax,  # noqa: F401
+                                            ASGD, Momentum, Optimizer,
+                                            RMSProp, Rprop, SGD)
 from paddle_tpu.optimizer.adam import Adam, AdamW, Lamb  # noqa: F401
 from paddle_tpu.optimizer import lr  # noqa: F401

@@ -246,3 +246,153 @@ class RMSProp(Optimizer):
         v = self._momentum * st["velocity"] + lr * g / denom
         new_st["velocity"] = v
         return (p.astype(jnp.float32) - v).astype(p.dtype), new_st
+
+
+class Adadelta(Optimizer):
+    """python/paddle/optimizer/adadelta.py analog."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _static_args(self):
+        return (self._epsilon, self._rho)
+
+    def _init_static(self, epsilon, rho):
+        self._epsilon, self._rho = epsilon, rho
+
+    def init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def update(self, g, st, p, lr, wd):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        rho, eps = self._rho, self._epsilon
+        e_g = rho * st["avg_squared_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(st["avg_squared_update"] + eps) \
+            / jnp.sqrt(e_g + eps)
+        e_u = rho * st["avg_squared_update"] + (1 - rho) * upd * upd
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": e_g, "avg_squared_update": e_u}
+
+
+class Adamax(Optimizer):
+    """python/paddle/optimizer/adamax.py analog (infinity-norm Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    def _init_static(self, beta1, beta2, epsilon):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment": jnp.zeros_like(p, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p, dtype=jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def update(self, g, st, p, lr, wd):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * st["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * st["inf_norm"], jnp.abs(g))
+        b1p = st["beta1_pow"] * b1
+        upd = lr * m / ((1 - b1p) * (u + self._epsilon))
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class ASGD(Optimizer):
+    """python/paddle/optimizer/asgd.py analog (averaged SGD over a
+    trailing window; the reference keeps a d/y running pair — here the
+    standard Polyak tail average)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = batch_num
+
+    def _static_args(self):
+        return (self._batch_num,)
+
+    def _init_static(self, batch_num):
+        self._batch_num = batch_num
+
+    def init_state(self, p):
+        return {"avg": p.astype(jnp.float32),
+                "step": jnp.zeros((), jnp.float32)}
+
+    def update(self, g, st, p, lr, wd):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        new_p = p.astype(jnp.float32) - lr * g
+        step = st["step"] + 1.0
+        avg = st["avg"] + (new_p - st["avg"]) / jnp.minimum(
+            step, float(self._batch_num))
+        return new_p.astype(p.dtype), {"avg": avg, "step": step}
+
+    def apply_averaged(self):
+        """Swap every parameter to its Polyak tail average (the point of
+        ASGD: evaluate/deploy the averaged weights). Returns the list of
+        pre-swap values so callers can ``restore()`` for training."""
+        backups = []
+        for p in self._parameter_list:
+            st = self._accumulators.get(id(p))
+            if st is None:
+                backups.append(None)
+                continue
+            backups.append(p.value)
+            p._set_value(st["avg"].astype(p.value.dtype))
+        return backups
+
+    def restore(self, backups):
+        """Undo ``apply_averaged``."""
+        for p, b in zip(self._parameter_list, backups):
+            if b is not None:
+                p._set_value(b)
+
+
+class Rprop(Optimizer):
+    """python/paddle/optimizer/rprop.py analog (sign-based resilient
+    propagation; per-element adaptive step)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _static_args(self):
+        return (self._lr_range, self._etas)
+
+    def _init_static(self, lr_range, etas):
+        self._lr_range, self._etas = lr_range, etas
+
+    def init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p, dtype=jnp.float32),
+                "lr_elem": jnp.full_like(p, float(self.get_lr()),
+                                         dtype=jnp.float32)}
+
+    def update(self, g, st, p, lr, wd):
+        g = g.astype(jnp.float32)
+        eta_minus, eta_plus = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(g * st["prev_grad"])
+        factor = jnp.where(sign > 0, eta_plus,
+                           jnp.where(sign < 0, eta_minus, 1.0))
+        lr_e = jnp.clip(st["lr_elem"] * factor, lo, hi)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p.astype(jnp.float32) - lr_e * jnp.sign(g_eff)
+        return new_p.astype(p.dtype), \
+            {"prev_grad": g_eff, "lr_elem": lr_e}

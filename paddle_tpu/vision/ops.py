@@ -27,7 +27,7 @@ from paddle_tpu.ops.registry import register_op
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
            "prior_box", "yolo_box", "deform_conv2d", "DeformConv2D",
-           "distribute_fpn_proposals"]
+           "distribute_fpn_proposals", "decode_jpeg", "read_file"]
 
 
 def _box_iou_impl(boxes1, boxes2):
@@ -482,3 +482,23 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     order = jnp.argsort(lvl, stable=True)
     restore = jnp.argsort(order, stable=True)
     return masks + (restore,)
+
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Reference: paddle/phi/kernels/gpu/decode_jpeg_kernel.cu (nvjpeg).
+    This build has no image codec (no nvjpeg analog on TPU hosts, and the
+    environment is egress-limited — no libjpeg binding is shipped);
+    decode on the host with PIL/cv2 and feed arrays instead."""
+    raise NotImplementedError(
+        "decode_jpeg: no JPEG codec in the TPU build — decode on the host "
+        "(PIL/cv2) and pass the decoded array")
+
+
+def read_file(filename, name=None):
+    """Reference: paddle/phi/kernels/cpu/read_file_kernel.cc. Host file IO
+    belongs to the input pipeline here (paddle_tpu.io readers); kept as a
+    named raiser for op-compat parity."""
+    raise NotImplementedError(
+        "read_file: use paddle_tpu.io datasets / plain Python file IO; "
+        "the op-based file reader is a GPU-pipeline construct")

@@ -534,6 +534,14 @@ SPECS = {
                        grad=[0]),
     "unfold_axis": spec([f(2, 6)], kw=dict(axis=1, size=3, step=2),
                         grad=[0]),
+    "as_strided": spec([f(12)], kw=dict(shape=[3, 4], stride=[4, 1]),
+                       grad=[0]),
+    "view_dtype": spec([f(2, 4)], kw=dict(dtype="int32"), grad=[]),
+    "shape": spec([f(2, 3)], grad=[]),
+    "reduce_as": spec([f(3, 4), f(1, 4)], grad=[0]),
+    "lu_unpack": spec([f(3, 3), ii(3, lo=1, hi=3)], grad=[], sel=0),
+    "group_norm_silu": spec([f(2, 4, 4, 4), f(4), f(4)],
+                            kw=dict(groups=2), grad=[0, 1, 2], atol=5e-3),
 }
 
 # randomness ops: forward-shape check only, with an explicit PRNG key
@@ -770,3 +778,90 @@ def test_sweep_coverage():
     frac = len(covered) / len(OPS)
     assert frac >= 0.9, f"op sweep covers {frac:.0%}; missing: {missing}"
     assert not missing, f"uncovered ops: {missing}"
+
+
+def test_op_compat_yaml_audit():
+    """Round-4 VERDICT item 5: every reference yaml op name (ops.yaml +
+    legacy_ops.yaml, 441 names) classifies via the op_compat table —
+    >=95% resolve (same-name / validated alias / named analog), zero
+    UNRESOLVED, and every absence carries a written reason.
+    Reference: paddle/phi/api/yaml/op_compat.yaml."""
+    from paddle_tpu.ops.op_compat import audit
+
+    a = audit()
+    if not a:
+        pytest.skip("reference yaml not available")
+    unresolved = {n: d for n, (t, d) in a.items() if t == "UNRESOLVED"}
+    assert not unresolved, unresolved
+    resolved = sum(1 for t, _ in a.values()
+                   if t in ("same-name", "alias", "analog"))
+    assert resolved / len(a) >= 0.95, f"{resolved}/{len(a)}"
+    for n, (t, d) in a.items():
+        if t == "absent":
+            assert len(d) > 20 or d.startswith("see "), \
+                f"absence {n} needs a real reason"
+
+
+def test_round4_tail_ops():
+    """The genuinely-missing yaml tail implemented in round 4."""
+    import jax.numpy as jnp
+
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32))
+    np.testing.assert_allclose(
+        paddle.as_strided(x, [5, 3], [2, 1]).numpy(),
+        np.lib.stride_tricks.as_strided(
+            np.arange(12, dtype=np.float32), (5, 3), (8, 4)))
+    assert paddle.shape(x).numpy().tolist() == [12]
+    assert paddle.view_dtype(x, "int32").numpy().dtype == np.int32
+
+    a = np.random.default_rng(0).standard_normal((3, 4, 4)).astype(np.float32)
+    lu_, piv, _ = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
+                               rtol=1e-4, atol=1e-5)
+
+    paddle.seed(0)
+    b = paddle.binomial(paddle.full([2000], 10.0), paddle.full([2000], 0.3))
+    assert abs(float(b.numpy().mean()) - 3.0) < 0.2
+
+    import paddle_tpu.nn.functional as F
+    lab = paddle.to_tensor(np.array([3, 7, 3, 90], np.int64))
+    rl, sc = F.class_center_sample(lab, 100, 8)
+    s = sc.numpy()
+    assert len(s) == 8 and len(set(s.tolist())) == len(s)
+    assert (s[rl.numpy()] == lab.numpy()).all()
+
+    with pytest.raises(NotImplementedError, match="codec"):
+        paddle.vision.ops.decode_jpeg(paddle.to_tensor(np.zeros(4, np.uint8)))
+
+    np.testing.assert_allclose(
+        paddle.reduce_as(paddle.to_tensor(np.ones((3, 4), np.float32)),
+                         paddle.to_tensor(np.ones((1, 4), np.float32))
+                         ).numpy(), np.full((1, 4), 3.0))
+
+
+def test_round4_optimizer_tail_converges():
+    """Adadelta/Adamax/ASGD/Rprop: loss decreases on a small regression."""
+    import paddle_tpu.optimizer as O
+    from paddle_tpu import nn
+
+    X = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+    Y = (X @ np.array([1.0, -2.0, 0.5, 3.0], np.float32))[:, None]
+    for cls, kw, iters in ((O.Adadelta, dict(learning_rate=1.0), 200),
+                           (O.Adamax, dict(learning_rate=0.05), 30),
+                           (O.ASGD, dict(learning_rate=0.05, batch_num=4),
+                            30),
+                           (O.Rprop, dict(learning_rate=0.01), 30)):
+        paddle.seed(1)
+        net = nn.Linear(4, 1)
+        opt = cls(parameters=net.parameters(), **kw)
+        first = None
+        for _ in range(iters):
+            loss = ((net(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2
+                    ).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first or float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.7, (cls.__name__, first,
+                                                   float(loss.numpy()))

@@ -189,3 +189,39 @@ def test_fused_ce_ignore_index_semantics_match_unfused():
                                    rtol=1e-4, atol=1e-6)
         np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw0),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_rprop_honors_learning_rate_and_to_accepts_dtype_objects():
+    """Round-4 review: Rprop seeds per-element steps from learning_rate
+    (was hardcoded 1e-3); Tensor.to accepts dtype OBJECTS, not only
+    strings; ASGD exposes its Polyak average via apply_averaged."""
+    import paddle_tpu.optimizer as O
+
+    net = nn.Linear(4, 1)
+    opt = O.Rprop(learning_rate=0.5, parameters=net.parameters())
+    loss = (net(paddle.to_tensor(np.ones((2, 4), np.float32))) ** 2).mean()
+    loss.backward()
+    opt.step()
+    st = list(opt._accumulators.values())[0]
+    assert float(np.asarray(st["lr_elem"]).max()) >= 0.5
+
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    assert str(t.to(paddle.float16).dtype).endswith("float16")
+    assert str(t.to("bfloat16").dtype).endswith("bfloat16")
+
+    net2 = nn.Linear(4, 1)
+    opt2 = O.ASGD(learning_rate=0.1, parameters=net2.parameters(),
+                  batch_num=8)
+    for _ in range(3):
+        l2 = (net2(paddle.to_tensor(np.ones((2, 4), np.float32))) ** 2
+              ).mean()
+        l2.backward()
+        opt2.step()
+        opt2.clear_grad()
+    w0 = net2.weight.numpy().copy()
+    backups = opt2.apply_averaged()
+    st2 = list(opt2._accumulators.values())[0]
+    np.testing.assert_allclose(net2.weight.numpy(),
+                               np.asarray(st2["avg"]), rtol=1e-6)
+    opt2.restore(backups)
+    np.testing.assert_allclose(net2.weight.numpy(), w0)
