@@ -1,4 +1,24 @@
-"""paddle_tpu.audio — audio features (python/paddle/audio/ analog)."""
+"""paddle_tpu.audio — audio features, WAV IO, datasets
+(python/paddle/audio/ analog: features/ functional/ backends/ datasets/)."""
 
-from paddle_tpu.audio import functional  # noqa: F401
-from paddle_tpu.audio.features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+from paddle_tpu.audio import backends, datasets, functional  # noqa: F401
+
+
+def load(*args, **kwargs):
+    """Dispatch to the CURRENT backend (honors backends.set_backend)."""
+    return backends.load(*args, **kwargs)
+
+
+def save(*args, **kwargs):
+    return backends.save(*args, **kwargs)
+
+
+def info(*args, **kwargs):
+    return backends.info(*args, **kwargs)
+from paddle_tpu.audio.features import (  # noqa: F401
+    MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram,
+)
+
+__all__ = ["functional", "features", "backends", "datasets",
+           "info", "load", "save",
+           "MFCC", "LogMelSpectrogram", "MelSpectrogram", "Spectrogram"]

@@ -1,0 +1,38 @@
+"""audio.backends — WAV IO (python/paddle/audio/backends/ analog).
+
+The reference's default backend is itself a pure-Python ``wave``-module
+codec (backends/wave_backend.py); this is the same design: stdlib wave
+for PCM WAV load/save/info, no native audio dependency. soundfile-style
+extra backends register via ``set_backend`` the way init_backend.py
+dispatches."""
+
+from paddle_tpu.audio.backends.wave_backend import info, load, save  # noqa: F401
+
+_BACKENDS = {"wave_backend": {"info": info, "load": load, "save": save}}
+_CURRENT = "wave_backend"
+
+__all__ = ["info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend", "register_backend"]
+
+
+def list_available_backends():
+    return sorted(_BACKENDS)
+
+
+def get_current_backend():
+    return _CURRENT
+
+
+def register_backend(name, *, info, load, save):
+    _BACKENDS[name] = {"info": info, "load": load, "save": save}
+
+
+def set_backend(backend_name: str):
+    global _CURRENT, info, load, save
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not registered; available: "
+            f"{list_available_backends()}")
+    _CURRENT = backend_name
+    b = _BACKENDS[backend_name]
+    info, load, save = b["info"], b["load"], b["save"]

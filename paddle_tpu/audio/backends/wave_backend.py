@@ -1,0 +1,87 @@
+"""Pure-stdlib WAV codec (python/paddle/audio/backends/wave_backend.py
+analog): PCM 8/16/32-bit load/save/info via the ``wave`` module."""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save"]
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding="PCM_U" if f.getsampwidth() == 1
+                         else "PCM_S")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (waveform Tensor, sample_rate). normalize=True scales PCM
+    to [-1, 1] float32 (the reference wave backend's convention);
+    channels_first gives (C, T)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        width = f.getsampwidth()
+        nch = f.getnchannels()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dt = _WIDTH_DTYPE.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported PCM width {width * 8} bits")
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16):
+    """float [-1,1] or integer PCM -> WAV file."""
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                                   # -> (T, C)
+    width = bits_per_sample // 8
+    if width not in _WIDTH_DTYPE:
+        raise ValueError(f"unsupported bits_per_sample {bits_per_sample}")
+    if np.issubdtype(arr.dtype, np.floating):
+        scale = float(2 ** (bits_per_sample - 1))
+        if width == 1:
+            arr = np.clip(arr * 128.0 + 128.0, 0, 255).astype(np.uint8)
+        else:
+            arr = np.clip(arr * scale, -scale,
+                          scale - 1).astype(_WIDTH_DTYPE[width])
+    else:
+        arr = arr.astype(_WIDTH_DTYPE[width])
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

@@ -1,0 +1,12 @@
+"""audio.datasets — ESC50 / TESS (python/paddle/audio/datasets/ analog).
+
+The reference downloads archives; this environment is egress-limited, so
+the datasets read an existing local extraction (pass ``root``) and raise
+with the expected layout when missing — the feature pipeline (waveform
+-> Spectrogram/MelSpectrogram/MFCC) is identical."""
+
+from paddle_tpu.audio.datasets.dataset import (  # noqa: F401
+    ESC50, TESS, AudioClassificationDataset,
+)
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]

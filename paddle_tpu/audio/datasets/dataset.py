@@ -1,0 +1,122 @@
+"""Audio classification datasets over local files.
+
+Reference: python/paddle/audio/datasets/{dataset,esc50,tess}.py. Same
+feature modes ('raw' waveform or 'mfcc'/'logmelspectrogram'/
+'melspectrogram'/'spectrogram' via audio.features), same label
+conventions; acquisition is local-dir (egress-limited environment)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+_FEAT = {"raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+         "mfcc"}
+
+
+class AudioClassificationDataset(Dataset):
+    """files + labels -> (feature, label) pairs."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = 16000,
+                 **feat_kwargs):
+        if feat_type not in _FEAT:
+            raise ValueError(f"feat_type must be one of {sorted(_FEAT)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat_kwargs = feat_kwargs
+        self._extractor = None
+
+    def _features(self, wav):
+        if self.feat_type == "raw":
+            return wav
+        if self._extractor is None:
+            from paddle_tpu.audio import features as Fa
+            cls = {"spectrogram": Fa.Spectrogram,
+                   "melspectrogram": Fa.MelSpectrogram,
+                   "logmelspectrogram": Fa.LogMelSpectrogram,
+                   "mfcc": Fa.MFCC}[self.feat_type]
+            kw = dict(self._feat_kwargs)
+            if self.feat_type != "spectrogram":
+                kw.setdefault("sr", self.sample_rate)
+            self._extractor = cls(**kw)
+        return self._extractor(wav.unsqueeze(0)).squeeze(0)
+
+    def __getitem__(self, idx):
+        from paddle_tpu.audio.backends import load
+        wav, _sr = load(self.files[idx])
+        mono = wav.mean(axis=0) if wav.shape[0] > 1 else wav.squeeze(0)
+        return self._features(mono), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _require(root: Optional[str], name: str, layout: str) -> str:
+    if root is None or not os.path.isdir(root):
+        raise RuntimeError(
+            f"{name}: pass root= pointing at a local extraction "
+            f"(downloads are disabled in this environment). Expected "
+            f"layout: {layout}")
+    return root
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds; labels parsed from the canonical
+    '{fold}-{src}-{take}-{target}.wav' filenames."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", root: Optional[str] = None,
+                 **kwargs):
+        root = _require(root, "ESC50", "<root>/audio/*.wav (ESC-50 naming)")
+        audio_dir = os.path.join(root, "audio") \
+            if os.path.isdir(os.path.join(root, "audio")) else root
+        files, labels = [], []
+        for fn in sorted(os.listdir(audio_dir)):
+            if not fn.endswith(".wav"):
+                continue
+            parts = fn[:-4].split("-")
+            fold, target = int(parts[0]), int(parts[-1])
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(os.path.join(audio_dir, fn))
+                labels.append(target)
+        super().__init__(files, labels, feat_type,
+                         sample_rate=kwargs.pop("sample_rate", 44100),
+                         **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set; label = emotion directory/suffix."""
+
+    emotions = ["angry", "disgust", "fear", "happy", "neutral",
+                "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 root: Optional[str] = None, **kwargs):
+        root = _require(root, "TESS", "<root>/**/*_<emotion>.wav")
+        files, labels = [], []
+        for dirpath, _dirs, fns in os.walk(root):
+            for fn in sorted(fns):
+                if not fn.endswith(".wav"):
+                    continue
+                emo = fn[:-4].split("_")[-1].lower()
+                if emo not in self.emotions:
+                    continue
+                files.append(os.path.join(dirpath, fn))
+                labels.append(self.emotions.index(emo))
+        idx = np.arange(len(files))
+        fold = idx % n_folds + 1
+        keep = (fold != split) if mode == "train" else (fold == split)
+        files = [f for f, k in zip(files, keep) if k]
+        labels = [l for l, k in zip(labels, keep) if k]
+        super().__init__(files, labels, feat_type,
+                         sample_rate=kwargs.pop("sample_rate", 24414),
+                         **kwargs)

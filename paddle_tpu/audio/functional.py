@@ -89,17 +89,103 @@ def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho"):
     return Tensor(dct.T.astype(np.float32))
 
 
-def get_window(window: str, win_length: int, fftbins: bool = True):
-    n = win_length
-    if window in ("hann", "hanning"):
-        w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / n)
-    elif window == "hamming":
-        w = 0.54 - 0.46 * np.cos(2 * np.pi * np.arange(n) / n)
-    elif window in ("rect", "boxcar", "ones"):
-        w = np.ones(n)
-    elif window == "blackman":
-        x = 2 * np.pi * np.arange(n) / n
-        w = 0.42 - 0.5 * np.cos(x) + 0.08 * np.cos(2 * x)
+def get_window(window, win_length: int, fftbins: bool = True):
+    """Window function by name (python/paddle/audio/functional/window.py
+    family). ``window`` may be a name or a scipy-style flat tuple
+    ``(name, param...)`` for parameterized windows (gaussian/std,
+    tukey/alpha, kaiser/beta, exponential/tau, general_gaussian/(p, sig)).
+    ``fftbins=True`` gives the periodic (DFT-even) variant exactly as
+    scipy does: the symmetric window of length N+1 with the last sample
+    dropped."""
+    if isinstance(window, str):
+        name, params = window, ()
     else:
-        raise ValueError(f"unsupported window {window!r}")
+        name, params = window[0], tuple(window[1:])
+    if win_length <= 1:
+        return Tensor(np.ones(max(win_length, 0), np.float32))
+    if fftbins:
+        w = _symmetric_window(name, params, win_length + 1)[:win_length]
+    else:
+        w = _symmetric_window(name, params, win_length)
     return Tensor(w.astype(np.float32))
+
+
+def _symmetric_window(name, params, M: int):
+    n = M - 1
+    k = np.arange(M)
+    if name in ("hann", "hanning"):
+        return 0.5 - 0.5 * np.cos(2 * np.pi * k / n)
+    if name == "hamming":
+        return 0.54 - 0.46 * np.cos(2 * np.pi * k / n)
+    if name in ("rect", "boxcar", "ones", "rectangular"):
+        return np.ones(M)
+    if name == "blackman":
+        return (0.42 - 0.5 * np.cos(2 * np.pi * k / n)
+                + 0.08 * np.cos(4 * np.pi * k / n))
+    if name == "nuttall":
+        return (0.3635819 - 0.4891775 * np.cos(2 * np.pi * k / n)
+                + 0.1365995 * np.cos(4 * np.pi * k / n)
+                - 0.0106411 * np.cos(6 * np.pi * k / n))
+    if name == "bartlett":
+        return 1.0 - np.abs(2.0 * k / n - 1.0)
+    if name == "triang":
+        m = (M + 1) // 2
+        if M % 2:
+            ramp = np.arange(1, m + 1) / ((M + 1) / 2.0)
+        else:
+            ramp = (2 * np.arange(1, m + 1) - 1) / M
+        return np.concatenate([ramp, ramp[::-1][M % 2:]])
+    if name == "cosine":
+        return np.sin(np.pi * (k + 0.5) / M)
+    if name == "bohman":
+        x = np.abs(2.0 * k / n - 1.0)
+        return (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    if name == "gaussian":
+        std = float(params[0]) if params else 7.0
+        return np.exp(-0.5 * ((k - n / 2.0) / std) ** 2)
+    if name == "general_gaussian":
+        p = float(params[0]) if params else 1.0
+        sig = float(params[1]) if len(params) > 1 else 7.0
+        return np.exp(-0.5 * np.abs((k - n / 2.0) / sig) ** (2 * p))
+    if name == "exponential":
+        tau = float(params[0]) if params else 1.0
+        return np.exp(-np.abs(k - n / 2.0) / tau)
+    if name == "tukey":
+        alpha = float(params[0]) if params else 0.5
+        if alpha <= 0:
+            return np.ones(M)
+        if alpha >= 1:
+            return 0.5 - 0.5 * np.cos(2 * np.pi * k / n)
+        w = np.ones(M)
+        edge = int(np.floor(alpha * n / 2.0))
+        x = k[:edge + 1]
+        taper = 0.5 * (1 + np.cos(np.pi * (2.0 * x / (alpha * n) - 1)))
+        w[:edge + 1] = taper
+        w[M - edge - 1:] = taper[::-1]
+        return w
+    if name == "kaiser":
+        beta = float(params[0]) if params else 12.0
+        return np.kaiser(M, beta)
+    if name == "taylor":
+        # nbar-bar Taylor window; params = (nbar, sidelobe-dB)
+        nbar = int(params[0]) if params else 4
+        sll = float(params[1]) if len(params) > 1 else 30.0
+        B = 10 ** (sll / 20)
+        A = np.arccosh(B) / np.pi
+        s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar)
+        Fm = np.zeros(nbar - 1)
+        signs = (-1) ** (ma + 1)
+        m2 = ma ** 2
+        for mi in range(len(ma)):
+            numer = signs[mi] * np.prod(
+                1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+            denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(
+                1 - m2[mi] / m2[mi + 1:])
+            Fm[mi] = numer / denom
+        w = np.ones(M)
+        for mi in range(len(ma)):
+            w = w + 2 * Fm[mi] * np.cos(
+                2 * np.pi * ma[mi] * (k - (M - 1) / 2.0) / M)
+        return w / w.max()
+    raise ValueError(f"unsupported window {name!r}")

@@ -1,5 +1,6 @@
-"""paddle_tpu.text (python/paddle/text/ analog): viterbi decode + dataset
-stubs (datasets require downloads; no egress here)."""
+"""paddle_tpu.text (python/paddle/text/ analog): viterbi decode + the
+seven reference datasets over LOCAL files (text/datasets.py — downloads
+are disabled in this environment, parsing/vocab semantics match)."""
 
 from __future__ import annotations
 
@@ -9,7 +10,12 @@ import jax.numpy as jnp
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.ops.registry import register_op
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
+
+from paddle_tpu.text.datasets import (  # noqa: E402,F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 
 
 @register_op("viterbi_decode")

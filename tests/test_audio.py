@@ -1,0 +1,126 @@
+"""Audio surface (round-4 expansion of the weak audio module): WAV
+backend roundtrip, window family, feature pipeline, local datasets.
+Reference: python/paddle/audio/{backends,functional,features,datasets}."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+def test_wav_backend_roundtrip(tmp_path):
+    sr = 16000
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    wav = 0.5 * np.sin(2 * np.pi * 440 * t)
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, wav, sr)
+    meta = audio.info(path)
+    assert (meta.sample_rate, meta.num_channels,
+            meta.bits_per_sample) == (sr, 1, 16)
+    back, sr2 = audio.load(path)
+    assert sr2 == sr and back.shape == (1, sr)
+    np.testing.assert_allclose(back.numpy()[0], wav, atol=2e-4)
+    # stereo + offset/num_frames + 32-bit
+    st = np.stack([wav, -wav])
+    p2 = str(tmp_path / "st.wav")
+    audio.save(p2, st, sr, bits_per_sample=32)
+    seg, _ = audio.load(p2, frame_offset=100, num_frames=50)
+    assert seg.shape == (2, 50)
+    np.testing.assert_allclose(seg.numpy()[0], wav[100:150], atol=1e-6)
+
+
+def test_backend_registry():
+    assert audio.backends.get_current_backend() == "wave_backend"
+    assert "wave_backend" in audio.backends.list_available_backends()
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
+
+
+def test_window_family_properties():
+    from paddle_tpu.audio.functional import get_window
+
+    names = ["hann", "hamming", "blackman", "nuttall", "bartlett",
+             "triang", "cosine", "bohman", "taylor", "boxcar"]
+    for nm in names:
+        w = get_window(nm, 128).numpy()
+        assert w.shape == (128,) and np.isfinite(w).all(), nm
+        assert w.max() <= 1.0 + 1e-6 and w.max() > 0.5, nm
+    for spec in [("gaussian", 20.0), ("tukey", 0.5), ("kaiser", 8.0),
+                 ("exponential", 40.0), ("general_gaussian", 1.5, 20.0)]:
+        w = get_window(spec, 128).numpy()
+        assert w.shape == (128,) and np.isfinite(w).all(), spec
+    # periodic vs symmetric hann endpoints
+    sym = get_window("hann", 64, fftbins=False).numpy()
+    np.testing.assert_allclose(sym[0], 0.0, atol=1e-7)
+    np.testing.assert_allclose(sym[-1], 0.0, atol=1e-7)
+
+
+def test_feature_pipeline_on_wav(tmp_path):
+    sr = 8000
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    wav = 0.5 * np.sin(2 * np.pi * 500 * t)
+    path = str(tmp_path / "f.wav")
+    audio.save(path, wav, sr)
+    loaded, _ = audio.load(path)
+    mel = audio.MelSpectrogram(sr=sr, n_fft=256, n_mels=32)(loaded)
+    assert mel.shape[0] == 1 and mel.shape[1] == 32
+    mfcc = audio.MFCC(sr=sr, n_mfcc=13)(loaded)
+    assert mfcc.shape[1] == 13
+
+
+def test_esc50_local_layout(tmp_path):
+    sr = 8000
+    adir = tmp_path / "audio"
+    adir.mkdir()
+    rng = np.random.default_rng(0)
+    for fold in (1, 2):
+        for take in range(2):
+            target = take + fold
+            audio.save(str(adir / f"{fold}-1001-A-{target}.wav"),
+                       rng.standard_normal(sr).astype(np.float32) * 0.1, sr)
+    ds = audio.datasets.ESC50(mode="train", split=1, root=str(tmp_path),
+                              sample_rate=sr)
+    assert len(ds) == 2                     # folds != 1
+    feat, label = ds[0]
+    assert feat.shape == (sr,) and int(label) in (2, 3)
+    dte = audio.datasets.ESC50(mode="dev", split=1, root=str(tmp_path),
+                               feat_type="mfcc", n_mfcc=13, n_fft=256,
+                               sample_rate=sr)
+    f2, _ = dte[0]
+    assert f2.shape[0] == 13
+    with pytest.raises(RuntimeError, match="root"):
+        audio.datasets.ESC50(root=str(tmp_path / "missing"))
+
+
+
+def test_window_matches_scipy_periodic():
+    """Review fix: fftbins=True must be the scipy DFT-even variant
+    (symmetric N+1, last dropped) for ALL window types."""
+    scipy_signal = pytest.importorskip("scipy.signal")
+    from paddle_tpu.audio.functional import get_window
+
+    for spec in ["hann", "blackman", "triang", "cosine", "bohman",
+                 ("tukey", 0.4), ("gaussian", 10.0), ("kaiser", 8.0)]:
+        for fftbins in (True, False):
+            np.testing.assert_allclose(
+                get_window(spec, 64, fftbins).numpy(),
+                scipy_signal.get_window(spec, 64, fftbins),
+                atol=1e-6, err_msg=f"{spec} fftbins={fftbins}")
+
+
+def test_package_level_load_honors_backend_switch(tmp_path):
+    """Review fix: audio.load dispatches at call time."""
+    calls = []
+    audio.backends.register_backend(
+        "probe", info=lambda p: calls.append("info"),
+        load=lambda p, **k: calls.append("load"),
+        save=lambda *a, **k: calls.append("save"))
+    try:
+        audio.backends.set_backend("probe")
+        audio.load("whatever.wav")
+        assert calls == ["load"]
+    finally:
+        audio.backends.set_backend("wave_backend")

@@ -1,0 +1,97 @@
+"""Text datasets over local files (python/paddle/text/datasets parity:
+parsing, vocab building, split semantics on synthetic canonical files)."""
+
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu import text
+
+
+def test_uci_housing(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = rng.random((50, 14))
+    p = tmp_path / "housing.data"
+    # canonical file wraps records across ragged lines (11 + 3 values)
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(" ".join(f"{v:.6f}" for v in r[:11]) + "\n")
+            f.write(" ".join(f"{v:.6f}" for v in r[11:]) + "\n")
+    tr = text.UCIHousing(str(p), mode="train")
+    te = text.UCIHousing(str(p), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    with pytest.raises(RuntimeError, match="data_file"):
+        text.UCIHousing(None)
+
+
+def test_imdb_tarball(tmp_path):
+    tar_path = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {"aclImdb/train/pos/0.txt": "good good movie !",
+            "aclImdb/train/neg/0.txt": "bad bad movie ?",
+            "aclImdb/test/pos/0.txt": "good story .",
+            "aclImdb/test/neg/0.txt": "bad story ."}
+    import io
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, content in docs.items():
+            data = content.encode()
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    ds = text.Imdb(str(tar_path), mode="train", cutoff=1)
+    assert len(ds) == 2
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    assert "movie" in ds.word_idx and "<unk>" in ds.word_idx
+    te = text.Imdb(str(tar_path), mode="test", cutoff=1)
+    assert len(te) == 2
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    train = tmp_path / "ptb.train.txt"
+    valid = tmp_path / "ptb.valid.txt"
+    train.write_text("a b c d e\na b c a b\n")
+    valid.write_text("a b c\n")
+    ng = text.Imikolov(str(train), data_type="NGRAM", window_size=3,
+                       min_word_freq=1)
+    assert all(len(w) == 3 for w in ng)
+    sq = text.Imikolov(str(train), data_type="SEQ", mode="valid",
+                       min_word_freq=1)
+    src, trg = sq[0]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_movielens(tmp_path):
+    (tmp_path / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation|Children's\n"
+        "2::Jumanji (1995)::Adventure\n")
+    (tmp_path / "users.dat").write_text(
+        "1::F::1::10::48067\n2::M::56::16::70072\n")
+    (tmp_path / "ratings.dat").write_text(
+        "1::1::5::978300760\n2::2::3::978302109\n1::2::4::978301968\n")
+    ds = text.Movielens(str(tmp_path), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    uid, gender, age, job, mid, title, cats, rating = ds[0]
+    assert gender in ("F", "M") and 1 <= rating <= 5
+
+
+def test_conll05_and_wmt(tmp_path):
+    c = tmp_path / "srl.txt"
+    c.write_text("The B-A0\ncat I-A0\nsat O\n\nDogs B-A0\nbark O\n")
+    ds = text.Conll05st(str(c))
+    assert len(ds) == 2
+    w, l = ds[0]
+    assert len(w) == 3 and len(l) == 3
+
+    p = tmp_path / "pairs.txt"
+    p.write_text("hello world\tbonjour monde\nbye world\tau revoir\n")
+    wmt = text.WMT14(str(p))
+    src, trg_in, trg_out = wmt[0]
+    assert trg_in[0] == 0 and trg_out[-1] == 1       # <s> ... <e>
+    assert "world" in wmt.src_dict
+    # per-side vocab caps honored (review fix)
+    w16 = text.WMT16(str(p), src_dict_size=4, trg_dict_size=30000)
+    assert len(w16.src_dict) == 4 and len(w16.trg_dict) > 4
