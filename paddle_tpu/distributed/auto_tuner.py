@@ -3,9 +3,10 @@
 Redesign of python/paddle/distributed/auto_tuner/ (tuner.py:21, search.py,
 prune.py, recorder.py): grid/heuristic candidate generation over
 {dp, mp, pp, sep, micro-batch, recompute}, pruning by divisibility and
-memory estimates, then measured trials (the reference launches real
-subprocesses; single-controller TPU just compiles + times each config on
-the live mesh).
+memory estimates, then measured trials — either in-process on the live mesh (fast, but an
+OOM kills the tuner) or launcher-isolated via SubprocessTrialRunner
+(each candidate in a fresh process, exactly the reference's
+tuner.py:21 subprocess-launch design).
 """
 
 from __future__ import annotations
@@ -16,7 +17,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["AutoTuner", "Candidate", "default_candidates", "estimate_memory",
-           "prune_by_memory"]
+           "prune_by_memory", "SubprocessTrialRunner", "current_candidate"]
 
 
 @dataclass
@@ -164,3 +165,77 @@ class AutoTuner:
         return sorted(
             (c for c in self.history if "tokens_per_sec" in c.metrics),
             key=lambda c: -c.metrics["tokens_per_sec"])
+
+
+def current_candidate() -> Optional[Candidate]:
+    """Inside a subprocess trial: the candidate this process should
+    benchmark (set by SubprocessTrialRunner), or None."""
+    import json
+    import os
+
+    raw = os.environ.get("PADDLE_AUTOTUNER_CANDIDATE")
+    if not raw:
+        return None
+    return Candidate(**json.loads(raw))
+
+
+class SubprocessTrialRunner:
+    """Launcher-isolated trials (the reference tuner launches a real
+    distributed job per candidate, auto_tuner/tuner.py:21): each
+    candidate runs in a FRESH python process, so an OOM / compiler crash
+    / hang marks that candidate infeasible instead of killing the tuner.
+
+    ``trial_script`` is a user python file that reads its candidate via
+    :func:`current_candidate` and prints ONE json line
+    ``{"tokens_per_sec": N}`` to stdout. Pass an instance as
+    ``AutoTuner(run_trial=...)``."""
+
+    def __init__(self, trial_script: str, timeout_s: float = 600.0,
+                 python: Optional[str] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.trial_script = trial_script
+        self.timeout_s = timeout_s
+        self.python = python
+        self.extra_env = dict(extra_env or {})
+
+    def __call__(self, cand: Candidate) -> float:
+        import json
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        # the trial process must be able to import this framework even
+        # when it was imported from a source checkout not on PYTHONPATH
+        import paddle_tpu
+        pkg_root = os.path.dirname(os.path.dirname(paddle_tpu.__file__))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        payload = {k: getattr(cand, k) for k in
+                   ("dp", "mp", "pp", "sep", "micro_batches",
+                    "use_recompute", "sharding_stage")}
+        env["PADDLE_AUTOTUNER_CANDIDATE"] = json.dumps(payload)
+        try:
+            proc = subprocess.run(
+                [self.python or sys.executable, self.trial_script],
+                env=env, capture_output=True, text=True,
+                timeout=self.timeout_s)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"trial timed out after {self.timeout_s:.0f}s (hung "
+                f"compile or deadlocked config)")
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"trial exited {proc.returncode}: "
+                f"{proc.stderr.strip()[-500:]}")
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "tokens_per_sec" in rec:
+                return float(rec["tokens_per_sec"])
+        raise RuntimeError(
+            "trial printed no {'tokens_per_sec': ...} json line; stdout "
+            f"tail: {proc.stdout.strip()[-300:]!r}")
