@@ -12,6 +12,11 @@ tuner.py:21 subprocess-launch design).
 from __future__ import annotations
 
 import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -170,9 +175,6 @@ class AutoTuner:
 def current_candidate() -> Optional[Candidate]:
     """Inside a subprocess trial: the candidate this process should
     benchmark (set by SubprocessTrialRunner), or None."""
-    import json
-    import os
-
     raw = os.environ.get("PADDLE_AUTOTUNER_CANDIDATE")
     if not raw:
         return None
@@ -199,11 +201,6 @@ class SubprocessTrialRunner:
         self.extra_env = dict(extra_env or {})
 
     def __call__(self, cand: Candidate) -> float:
-        import json
-        import os
-        import subprocess
-        import sys
-
         env = dict(os.environ)
         env.update(self.extra_env)
         # the trial process must be able to import this framework even
@@ -216,15 +213,26 @@ class SubprocessTrialRunner:
                    ("dp", "mp", "pp", "sep", "micro_batches",
                     "use_recompute", "sharding_stage")}
         env["PADDLE_AUTOTUNER_CANDIDATE"] = json.dumps(payload)
+        # own session + group kill on timeout: launcher-style trials fork
+        # workers that inherit the captured pipes — killing only the
+        # direct child would leave communicate() blocked on orphans
+        popen = subprocess.Popen(
+            [self.python or sys.executable, self.trial_script],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True)
         try:
-            proc = subprocess.run(
-                [self.python or sys.executable, self.trial_script],
-                env=env, capture_output=True, text=True,
-                timeout=self.timeout_s)
+            out, err = popen.communicate(timeout=self.timeout_s)
         except subprocess.TimeoutExpired:
+            try:
+                os.killpg(popen.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                popen.kill()
+            popen.communicate()
             raise RuntimeError(
                 f"trial timed out after {self.timeout_s:.0f}s (hung "
                 f"compile or deadlocked config)")
+        proc = subprocess.CompletedProcess(popen.args, popen.returncode,
+                                           out, err)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"trial exited {proc.returncode}: "
@@ -234,7 +242,7 @@ class SubprocessTrialRunner:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if "tokens_per_sec" in rec:
+            if isinstance(rec, dict) and "tokens_per_sec" in rec:
                 return float(rec["tokens_per_sec"])
         raise RuntimeError(
             "trial printed no {'tokens_per_sec': ...} json line; stdout "
