@@ -50,6 +50,7 @@ __all__ = [
     "triplet_margin_loss", "pairwise_distance",
     # misc
     "pad", "sequence_mask", "temporal_shift", "class_center_sample",
+    "margin_cross_entropy",
 ]
 
 from paddle_tpu.ops.manipulation import pad, one_hot  # noqa: E402  (re-export)
@@ -1254,3 +1255,31 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap[sampled] = _np.arange(len(sampled))
     return (Tensor(jnp.asarray(remap[lab])),
             Tensor(jnp.asarray(sampled)))
+
+
+@register_op("margin_cross_entropy",
+             ref="python/paddle/nn/functional/loss.py:margin_cross_entropy "
+                 "(ArcFace-family margin softmax)")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace margin softmax: the target-class cosine theta gets
+    cos(m1*theta + m2) - m3 before scaling. ``logits`` are normalized
+    cosines (N, C). The reference's model-parallel variant shards C
+    across ranks with a custom comm kernel; here class-sharded logits
+    are GSPMD shardings — jit the call with logits sharded on the class
+    axis and XLA inserts the softmax collectives."""
+    lbl = label.reshape((-1,)).astype(jnp.int32)
+    C = logits.shape[-1]
+    onehot = jax.nn.one_hot(lbl, C, dtype=logits.dtype)
+    target = jnp.sum(logits * onehot, axis=-1)
+    theta = jnp.arccos(jnp.clip(target, -1.0 + 1e-7, 1.0 - 1e-7))
+    new_target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = logits + onehot * (new_target - target)[:, None]
+    adjusted = adjusted * scale
+    logp = jax.nn.log_softmax(adjusted.astype(jnp.float32), axis=-1)
+    loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    loss = _reduce_loss(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss

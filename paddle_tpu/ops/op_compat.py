@@ -86,9 +86,6 @@ OP_COMPAT: Dict[str, str] = {
     "warpctc": "nn.functional.ctc_loss",
     "warprnnt": "~RNN-T loss not built (ctc_loss covers the CTC family); "
                 "a lax.scan alignment DP is the natural TPU form",
-    "margin_cross_entropy": "=margin softmax = F.class_center_sample + "
-                            "cross_entropy composition; the fused "
-                            "hybrid-parallel kernel is not rebuilt",
     # ---- interpolate family ----
     "bicubic_interp": "nn.functional.interpolate",
     "bilinear_interp": "nn.functional.interpolate",
