@@ -50,7 +50,7 @@ __all__ = [
     "triplet_margin_loss", "pairwise_distance",
     # misc
     "pad", "sequence_mask", "temporal_shift", "class_center_sample",
-    "margin_cross_entropy",
+    "margin_cross_entropy", "flash_attn_varlen",
 ]
 
 from paddle_tpu.ops.manipulation import pad, one_hot  # noqa: E402  (re-export)
@@ -1283,3 +1283,49 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     if return_softmax:
         return loss, jnp.exp(logp).astype(logits.dtype)
     return loss
+
+
+def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                      max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                      dropout=0.0, causal=False, training=True, name=None):
+    """Varlen (packed/unpadded) attention: q/k/v are (total_tokens, H, D)
+    with ``cu_seqlens_*`` the (B+1,) cumulative sequence starts
+    (reference flash_attn_unpadded, phi flash_attn kernels). TPU-native
+    form: static shapes are the deployment contract, so the packed batch
+    runs as ONE dense attention with a segment mask (tokens attend only
+    within their own sequence, optionally causally) — correct for any
+    ragged batch, with the dense kernel's compute cost. Pair with
+    bucketed padding when the total length varies across steps."""
+    key = rnd.split_key() if (dropout > 0.0 and training) else None
+    return _flash_attn_varlen_op(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                 key, scale=scale, dropout=dropout,
+                                 causal=causal, training=training)
+
+
+@register_op("flash_attn_varlen",
+             ref="python/paddle/nn/functional/flash_attention.py:"
+                 "flash_attn_unpadded (segment-masked dense form)")
+def _flash_attn_varlen_op(q, k, v, cu_seqlens_q, cu_seqlens_k, key=None,
+                          scale=None, dropout=0.0, causal=False,
+                          training=True):
+    cq = jnp.asarray(cu_seqlens_q).astype(jnp.int32)
+    ck = jnp.asarray(cu_seqlens_k).astype(jnp.int32)
+    tq, h, d = q.shape
+    tk = k.shape[0]
+    seg_q = jnp.searchsorted(cq, jnp.arange(tq), side="right")
+    seg_k = jnp.searchsorted(ck, jnp.arange(tk), side="right")
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        pos_q = jnp.arange(tq) - jnp.take(cq, seg_q - 1)
+        pos_k = jnp.arange(tk) - jnp.take(ck, seg_k - 1)
+        mask = mask & (pos_q[:, None] >= pos_k[None, :])
+    s = jnp.einsum("qhd,khd->hqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s * (float(scale) if scale is not None else 1.0 / math.sqrt(d))
+    s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout > 0.0 and training and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+    return jnp.einsum("hqk,khd->qhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

@@ -279,3 +279,56 @@ def _patch_round4_methods():
 
 
 _patch_round4_methods()
+
+
+def _patch_fill_diagonal():
+    """Tensor.fill_diagonal_ / fill_diagonal_tensor_ (reference
+    tensor_patch_methods + fill_diagonal kernels)."""
+    import jax.numpy as _jnp
+
+    def _fill_diagonal_(self, value, offset=0, wrap=False):
+        v = self._value
+        if v.ndim == 2:
+            from paddle_tpu.ops.schema_defs import _fill_diagonal
+            self._set_value(_fill_diagonal(v, value, offset, wrap))
+            return self
+        # ndim > 2: reference fills the main HYPER-diagonal (i, i, ..., i)
+        # and requires equal dims
+        if len(set(v.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal_: tensors with ndim > 2 must have equal "
+                f"dims, got {v.shape}")
+        i = _jnp.arange(v.shape[0])
+        self._set_value(v.at[tuple([i] * v.ndim)].set(value))
+        return self
+
+    def _fill_diagonal_tensor(self, y, offset=0, dim1=0, dim2=1):
+        """Returns a copy with tensor ``y`` written along the
+        (dim1, dim2) diagonal (fill_diagonal_tensor_kernel analog)."""
+        v = self._value
+        yv = y._value if isinstance(y, Tensor) else _jnp.asarray(y)
+        if v.ndim != 2 or (dim1, dim2) != (0, 1):
+            raise NotImplementedError(
+                "fill_diagonal_tensor: only 2-D (dim1=0, dim2=1) "
+                "supported")
+        n = min(v.shape[0] + min(offset, 0),
+                v.shape[1] - max(offset, 0), min(v.shape))
+        if tuple(yv.shape) != (n,):
+            raise ValueError(
+                f"fill_diagonal_tensor: y shape {tuple(yv.shape)} != "
+                f"diagonal length ({n},)")
+        i = _jnp.arange(n)
+        out = v.at[i - min(offset, 0), i + max(offset, 0)].set(yv)
+        return Tensor(out)
+
+    def _fill_diagonal_tensor_(self, y, offset=0, dim1=0, dim2=1):
+        out = _fill_diagonal_tensor(self, y, offset, dim1, dim2)
+        self._set_value(out._value)
+        return self
+
+    Tensor.fill_diagonal_ = _fill_diagonal_
+    Tensor.fill_diagonal_tensor = _fill_diagonal_tensor
+    Tensor.fill_diagonal_tensor_ = _fill_diagonal_tensor_
+
+
+_patch_fill_diagonal()

@@ -43,9 +43,7 @@ OP_COMPAT: Dict[str, str] = {
     "merged_adam_": "=same as fused_adam_: XLA fuses the per-param "
                     "updates; no separate multi-tensor kernel needed",
     "merged_momentum_": "=see merged_adam_",
-    "average_accumulates_": "~ModelAverage/EMA optimizer infra not built; "
-                            "the optimizer state machinery (optimizer/"
-                            "optimizer.py) is where it would slot",
+    "average_accumulates_": "incubate.ModelAverage",
     # ---- collectives (c_* fluid ops -> distributed API over mesh
     #      collectives) ----
     "c_allgather": "distributed.all_gather",
@@ -123,10 +121,8 @@ OP_COMPAT: Dict[str, str] = {
     "flash_attn_qkvpacked": "nn.functional.flash_attention",
     "memory_efficient_attention":
         "nn.functional.scaled_dot_product_attention",
-    "flash_attn_unpadded": "~varlen/ragged attention: TPU static-shape "
-                           "contract means bucketed padding + the dense "
-                           "flash kernel; a ragged kernel is not built",
-    "flash_attn_varlen_qkvpacked": "~see flash_attn_unpadded",
+    "flash_attn_unpadded": "nn.functional.flash_attn_varlen",
+    "flash_attn_varlen_qkvpacked": "nn.functional.flash_attn_varlen",
     "flash_attn_with_sparse_mask": "~sparse-mask flash variant not "
                                    "built; dense mask path covers "
                                    "correctness (sdpa attn_mask)",
@@ -159,8 +155,7 @@ OP_COMPAT: Dict[str, str] = {
                                "int8 Pallas matmul tile",
     # ---- tensor manipulation renames ----
     "fill": "Tensor.fill_",
-    "fill_diagonal_tensor": "~sub-diagonal tensor fill not built; "
-                            "diag_embed + where covers the common cases",
+    "fill_diagonal_tensor": "Tensor.fill_diagonal_tensor",
     "assign_out_": "assign",
     "assign_value_": "assign",
     "full_batch_size_like": "full",
@@ -194,7 +189,7 @@ OP_COMPAT: Dict[str, str] = {
     "generate_proposals": "~RPN proposal generation not built; the "
                           "detection zoo beyond nms/roi_align/yolo_box "
                           "lives in PaddleDetection externally too",
-    "matrix_nms": "~see generate_proposals",
+    "matrix_nms": "vision.ops.matrix_nms",
     "multiclass_nms3": "~see generate_proposals (single-class nms IS "
                        "built: vision.ops.nms)",
     "psroi_pool": "~position-sensitive roi pool not built; roi_align/"

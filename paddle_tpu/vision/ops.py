@@ -27,7 +27,7 @@ from paddle_tpu.ops.registry import register_op
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
            "prior_box", "yolo_box", "deform_conv2d", "DeformConv2D",
-           "distribute_fpn_proposals", "decode_jpeg", "read_file"]
+           "distribute_fpn_proposals", "decode_jpeg", "read_file", "matrix_nms"]
 
 
 def _box_iou_impl(boxes1, boxes2):
@@ -502,3 +502,85 @@ def read_file(filename, name=None):
     raise NotImplementedError(
         "read_file: use paddle_tpu.io datasets / plain Python file IO; "
         "the op-based file reader is a GPU-pipeline construct")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2) — decay every box's score by its max IoU with
+    higher-scored same-class boxes in one IoU matrix instead of
+    sequential suppression (paddle/phi/kernels/impl/matrix_nms ref).
+    bboxes (B, N, 4), scores (B, C, N); returns the reference's
+    [label, score, x1, y1, x2, y2] rows per image. Output sizes are
+    data-dependent -> eager-only (host assembly), like the reference's
+    CPU kernel."""
+    import numpy as _np
+
+    bv = _np.asarray(bboxes.numpy() if isinstance(bboxes, Tensor) else bboxes)
+    sv = _np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+    B, C, N = sv.shape
+    all_rows, all_idx, rois_num = [], [], []
+    for b in range(B):
+        rows, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = sv[b, c]
+            keep = _np.nonzero(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[_np.argsort(-sc[keep])]
+            if nms_top_k > -1:
+                order = order[:nms_top_k]
+            boxes = bv[b, order]
+            s = sc[order]
+            x1, y1, x2, y2 = boxes.T
+            off = 0.0 if normalized else 1.0
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            ix1 = _np.maximum(x1[:, None], x1[None, :])
+            iy1 = _np.maximum(y1[:, None], y1[None, :])
+            ix2 = _np.minimum(x2[:, None], x2[None, :])
+            iy2 = _np.minimum(y2[:, None], y2[None, :])
+            iw = _np.maximum(ix2 - ix1 + off, 0)
+            ih = _np.maximum(iy2 - iy1 + off, 0)
+            inter = iw * ih
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+            iou = _np.triu(iou, k=1)             # higher-scored rows only
+            iou_cmax = iou.max(axis=0)           # per box: max IoU w/ better
+            # reference decay_score (matrix_nms_kernel.cc): candidate j is
+            # decayed by min over suppressors i<j of f(iou_ij, cmax_i)
+            # where cmax_i COMPENSATES suppressor i's own suppression
+            cmax = iou_cmax[:, None]
+            if use_gaussian:
+                decay_m = _np.exp((cmax ** 2 - iou ** 2) * gaussian_sigma)
+            else:
+                decay_m = (1 - iou) / _np.maximum(1 - cmax, 1e-10)
+            decay = _np.minimum(_np.triu(decay_m, k=1)
+                                + _np.tril(_np.ones_like(decay_m)),
+                                1.0).min(axis=0)
+            ds = s * decay
+            sel = ds > post_threshold
+            for i in _np.nonzero(sel)[0]:
+                rows.append([float(c), float(ds[i]), *boxes[i].tolist()])
+                idxs.append(int(order[i]) + b * N)
+        if rows:
+            rows_a = _np.asarray(rows, _np.float32)
+            top = _np.argsort(-rows_a[:, 1])
+            if keep_top_k > -1:
+                top = top[:keep_top_k]
+            all_rows.append(rows_a[top])
+            all_idx.extend([idxs[t] for t in top])
+            rois_num.append(len(top))
+        else:
+            rois_num.append(0)
+    out = _np.concatenate(all_rows, axis=0) if all_rows else \
+        _np.zeros((0, 6), _np.float32)
+    # reference API contract: ALWAYS (out, rois_num, index) with None
+    # placeholders for disabled returns (python/paddle/vision/ops.py)
+    out_t = Tensor(jnp.asarray(out))
+    rois_t = Tensor(jnp.asarray(_np.asarray(rois_num, _np.int32))) \
+        if return_rois_num else None
+    idx_t = Tensor(jnp.asarray(_np.asarray(all_idx, _np.int32))) \
+        if return_index else None
+    return out_t, rois_t, idx_t
