@@ -542,6 +542,13 @@ SPECS = {
     "lu_unpack": spec([f(3, 3), ii(3, lo=1, hi=3)], grad=[], sel=0),
     "group_norm_silu": spec([f(2, 4, 4, 4), f(4), f(4)],
                             kw=dict(groups=2), grad=[0, 1, 2], atol=5e-3),
+    "margin_cross_entropy": spec(
+        [f(4, 8, lo=-0.9, hi=0.9), ii(4, lo=0, hi=8)],
+        kw=dict(scale=4.0), grad=[0], atol=5e-3),
+    "flash_attn_varlen": spec(
+        [f(6, 2, 4), f(6, 2, 4), f(6, 2, 4),
+         ii(3, lo=0, hi=1), ii(3, lo=0, hi=1)],
+        kw=dict(causal=False), grad=[0, 1, 2], jit=False, atol=5e-3),
 }
 
 # randomness ops: forward-shape check only, with an explicit PRNG key
