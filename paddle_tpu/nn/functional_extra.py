@@ -355,12 +355,15 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 @register_op("rnnt_loss", ref="paddle warprnnt integration "
              "(paddle/phi/kernels/gpu/warprnnt_kernel.cu analog)")
 def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.0, reduction="mean"):
+              fastemit_lambda=0.001, reduction="mean"):
     """RNN-Transducer loss: log-space alpha recursion over the (T, U+1)
     lattice as a lax.scan over time (the warprnnt capability in pure
     traceable form; gradients come from autodiff of the recursion).
 
     logits: (B, T, U+1, V); labels: (B, U) int; lengths per sample.
+    FastEmit (arXiv:2010.11148) matches warp-transducer: emit-transition
+    gradients scale by (1 + lambda) via a stop-gradient identity that
+    leaves the loss value untouched (reference default 0.001).
     """
     B, T, U1, V = logits.shape
     U = U1 - 1
@@ -371,6 +374,9 @@ def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
     lab_idx = jnp.concatenate([lab, jnp.zeros((B, 1), lab.dtype)], 1)
     emit_lp = jnp.take_along_axis(
         logp, lab_idx[:, None, :, None], axis=-1)[..., 0]      # (B, T, U+1)
+    if fastemit_lambda:
+        emit_lp = emit_lp + fastemit_lambda * (
+            emit_lp - lax.stop_gradient(emit_lp))
 
     neg_inf = jnp.float32(-1e30)
     u_range = jnp.arange(U1)

@@ -82,8 +82,7 @@ OP_COMPAT: Dict[str, str] = {
     "identity_loss": "=IPU-only loss-marker op in the reference; mean/sum "
                      "reductions cover the math",
     "warpctc": "nn.functional.ctc_loss",
-    "warprnnt": "~RNN-T loss not built (ctc_loss covers the CTC family); "
-                "a lax.scan alignment DP is the natural TPU form",
+    "warprnnt": "nn.functional.rnnt_loss",
     # ---- interpolate family ----
     "bicubic_interp": "nn.functional.interpolate",
     "bilinear_interp": "nn.functional.interpolate",

@@ -985,3 +985,59 @@ def test_round4_absence_shrink_ops():
     assert a["flash_attn_unpadded"][0] == "alias"
     assert a["matrix_nms"][0] == "alias"
     assert a["fill_diagonal_tensor"][0] == "alias"
+
+
+def test_rnnt_loss_brute_force_and_fastemit():
+    """warprnnt parity: the lattice DP equals brute-force enumeration of
+    all monotone alignments, and FastEmit scales emit GRADIENTS by
+    (1+lambda) while leaving the loss value untouched (warp-transducer
+    semantics, arXiv:2010.11148)."""
+    import math
+    from itertools import combinations
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    B, T, U1, V = 2, 3, 3, 4
+    logits = rng.standard_normal((B, T, U1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U1 - 1)).astype(np.int32)
+    tlen = np.array([3, 2], np.int64)
+    ulen = np.array([2, 1], np.int64)
+    lpx = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    def brute(b):
+        T_, U_ = int(tlen[b]), int(ulen[b])
+        total = -math.inf
+        for emit_pos in combinations(range(T_ + U_ - 1), U_):
+            t, u, lp = 0, 0, 0.0
+            for i in range(T_ + U_):
+                if i in emit_pos:
+                    lp += lpx[b, t, u, labels[b, u]]
+                    u += 1
+                else:
+                    lp += lpx[b, t, u, 0]
+                    t += 1
+            total = np.logaddexp(total, lp)
+        return -total
+
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(tlen), paddle.to_tensor(ulen),
+                      fastemit_lambda=0.0, reduction="none")
+    np.testing.assert_allclose(got.numpy().ravel(),
+                               [brute(0), brute(1)], rtol=1e-5)
+
+    args = (paddle.to_tensor(labels), paddle.to_tensor(tlen),
+            paddle.to_tensor(ulen))
+    v0 = F.rnnt_loss(paddle.to_tensor(logits), *args, fastemit_lambda=0.0)
+    v1 = F.rnnt_loss(paddle.to_tensor(logits), *args, fastemit_lambda=0.5)
+    np.testing.assert_allclose(float(v0.numpy()), float(v1.numpy()),
+                               rtol=1e-6)
+    g0 = jax.grad(lambda x: F.rnnt_loss(
+        paddle.Tensor(x), *args, fastemit_lambda=0.0).value)(
+        jnp.asarray(logits))
+    g1 = jax.grad(lambda x: F.rnnt_loss(
+        paddle.Tensor(x), *args, fastemit_lambda=0.5).value)(
+        jnp.asarray(logits))
+    assert not np.allclose(np.asarray(g0), np.asarray(g1))
