@@ -191,8 +191,7 @@ OP_COMPAT: Dict[str, str] = {
     "matrix_nms": "vision.ops.matrix_nms",
     "multiclass_nms3": "~see generate_proposals (single-class nms IS "
                        "built: vision.ops.nms)",
-    "psroi_pool": "~position-sensitive roi pool not built; roi_align/"
-                  "roi_pool cover the common detectors",
+
     "detection_map": "~mAP evaluation is host-side metric code in every "
                      "ecosystem (pycocotools); not an op",
     "yolo_box_head": "~yolo_box IS built (vision.ops.yolo_box); the "

@@ -27,7 +27,7 @@ from paddle_tpu.ops.registry import register_op
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
            "prior_box", "yolo_box", "deform_conv2d", "DeformConv2D",
-           "distribute_fpn_proposals", "decode_jpeg", "read_file", "matrix_nms"]
+           "distribute_fpn_proposals", "decode_jpeg", "read_file", "matrix_nms", "psroi_pool"]
 
 
 def _box_iou_impl(boxes1, boxes2):
@@ -210,6 +210,58 @@ def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0):
         samp = img[:, yi][:, :, xi]         # (C, oh*ratio_h, ow*ratio_w)
         samp = samp.reshape(C, oh, ratio_h, ow, ratio_w)
         return samp.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+@register_op("psroi_pool",
+             ref="paddle/phi/kernels/psroi_pool_kernel.h (R-FCN "
+                 "position-sensitive average pooling)")
+def psroi_pool(x, boxes, boxes_num=None, output_size=7,
+               spatial_scale=1.0):
+    """Position-sensitive RoI AVERAGE pooling: input channels are split
+    into oh*ow positional groups; output bin (c, i, j) averages the
+    (c*oh*ow + i*ow + j)-th input channel over that bin's region —
+    static-shape dense sampling like roi_pool above."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    N, C, H, W = x.shape
+    if C % (oh * ow):
+        raise ValueError(
+            f"psroi_pool: input channels {C} must be divisible by "
+            f"output_size product {oh * ow}")
+    c_out = C // (oh * ow)
+    R = boxes.shape[0]
+    if boxes_num is None:
+        img_of_roi = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of_roi = jnp.repeat(jnp.arange(len(boxes_num)),
+                                jnp.asarray(boxes_num),
+                                total_repeat_length=R).astype(jnp.int32)
+    b = boxes * spatial_scale
+    ratio_h = max(2, -(-H // oh))
+    ratio_w = max(2, -(-W // ow))
+    # channel map: bin (i, j) of output channel c reads input channel
+    # c*oh*ow + i*ow + j (the R-FCN position-sensitive layout)
+    chan = (jnp.arange(c_out)[:, None, None] * (oh * ow)
+            + jnp.arange(oh)[None, :, None] * ow
+            + jnp.arange(ow)[None, None, :])            # (c_out, oh, ow)
+
+    def per_roi(r):
+        x1, y1, x2, y2 = b[r]
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ys = y1 + (jnp.arange(oh * ratio_h) + 0.5) * (rh / (oh * ratio_h))
+        xs = x1 + (jnp.arange(ow * ratio_w) + 0.5) * (rw / (ow * ratio_w))
+        yi = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+        img = x[img_of_roi[r]]
+        samp = img[:, yi][:, :, xi]          # (C, oh*rh, ow*rw)
+        samp = samp.reshape(C, oh, ratio_h, ow, ratio_w)
+        pooled = samp.mean(axis=(2, 4))      # (C, oh, ow)
+        return pooled[chan, jnp.arange(oh)[None, :, None],
+                      jnp.arange(ow)[None, None, :]]
 
     return jax.vmap(per_roi)(jnp.arange(R))
 

@@ -576,6 +576,7 @@ SKIP = {
     "nms_mask": "detection family; test_vision_ops.py",
     "roi_align": "detection family; test_vision_ops.py",
     "roi_pool": "detection family; test_vision_ops.py",
+    "psroi_pool": "detection family; test_vision_ops.py",
     "box_coder": "detection family; test_vision_ops.py",
     "prior_box": "detection family; test_vision_ops.py",
     "yolo_box": "detection family; test_vision_ops.py",

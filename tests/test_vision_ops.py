@@ -225,3 +225,28 @@ def test_roi_pool_wide_narrow_output_finds_max():
                                                np.float32)),
                      output_size=(8, 1))
     assert float(out.numpy().max()) == 9.0
+
+
+def test_psroi_pool_position_sensitive_layout():
+    """R-FCN psroi_pool (psroi_pool_kernel.h): output bin (c, i, j)
+    averages input channel c*oh*ow + i*ow + j over the bin's region."""
+    rng = np.random.default_rng(0)
+    oh = ow = 2
+    c_out, H, W = 3, 8, 8
+    C = c_out * oh * ow
+    x = rng.standard_normal((1, C, H, W)).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+    out = paddle.vision.ops.psroi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        np.array([1], np.int32), 2).numpy()
+    for c in range(c_out):
+        for i in range(2):
+            for j in range(2):
+                ch = c * 4 + i * 2 + j
+                region = x[0, ch, i * 4:(i + 1) * 4, j * 4:(j + 1) * 4]
+                np.testing.assert_allclose(out[0, c, i, j], region.mean(),
+                                           rtol=1e-5)
+    with pytest.raises(ValueError, match="divisible"):
+        paddle.vision.ops.psroi_pool(
+            paddle.to_tensor(x[:, :10]), paddle.to_tensor(boxes),
+            np.array([1], np.int32), 2)
