@@ -14,7 +14,9 @@ from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.ops.registry import register_op
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
-           "segment_mean", "segment_max", "segment_min"]
+           "segment_mean", "segment_max", "segment_min",
+           "sample_neighbors", "weighted_sample_neighbors",
+           "reindex_graph", "khop_sampler"]
 
 _REDUCE = {
     "sum": jax.ops.segment_sum,
@@ -84,3 +86,162 @@ def segment_max(data, segment_ids):
 def segment_min(data, segment_ids):
     n = int(jnp.max(segment_ids)) + 1 if segment_ids.shape[0] else 0
     return jax.ops.segment_min(data, segment_ids, n)
+
+
+# ---------------------------------------------------------------------------
+# sampling (python/paddle/geometric/sampling/neighbors.py + reindex.py).
+# Neighbor sampling has data-dependent output sizes, so on TPU it is an
+# input-pipeline (host) stage — these run eagerly on numpy and feed the
+# compiled message-passing ops above (send_u_recv & co).
+# ---------------------------------------------------------------------------
+
+def _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                           return_eids, edge_weight=None):
+    """Shared core for (weighted_)sample_neighbors: per-node uniform or
+    weight-proportional selection without replacement. Zero-weight edges
+    are only drawn after every positive-weight edge (A-Res semantics of
+    the reference kernel)."""
+    import numpy as _np
+
+    from paddle_tpu.framework import random as _rnd
+
+    rowv = _np.asarray(row.numpy() if isinstance(row, Tensor) else row).ravel()
+    cp = _np.asarray(colptr.numpy() if isinstance(colptr, Tensor)
+                     else colptr).ravel()
+    nodes = _np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor)
+                        else input_nodes).ravel()
+    wv = None
+    if edge_weight is not None:
+        wv = _np.asarray(edge_weight.numpy() if isinstance(edge_weight, Tensor)
+                         else edge_weight).ravel().astype(_np.float64)
+    ev = None
+    if eids is not None:
+        ev = _np.asarray(eids.numpy() if isinstance(eids, Tensor)
+                         else eids).ravel()
+    if return_eids and ev is None:
+        raise ValueError("return_eids=True requires eids")
+    seed = int(_np.asarray(jax.random.randint(_rnd.split_key(), (), 0,
+                                              2 ** 31 - 1)))
+    rng = _np.random.default_rng(seed)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = _np.arange(lo, hi)
+        elif wv is None:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        else:
+            w = wv[lo:hi]
+            pos = _np.nonzero(w > 0)[0]
+            if len(pos) >= sample_size:
+                p = w[pos] / w[pos].sum()
+                sel = lo + pos[rng.choice(len(pos), size=sample_size,
+                                          replace=False, p=p)]
+            else:
+                # every positive-weight edge, then zero-weight fill
+                zero = _np.nonzero(w <= 0)[0]
+                fill = rng.choice(len(zero), size=sample_size - len(pos),
+                                  replace=False)
+                sel = lo + _np.concatenate([pos, zero[fill]])
+        out_n.append(rowv[sel])
+        out_c.append(len(sel))
+        if ev is not None:
+            out_e.append(ev[sel])
+    neigh = _np.concatenate(out_n) if out_n else _np.zeros((0,), rowv.dtype)
+    count = _np.asarray(out_c, dtype=rowv.dtype)
+    res = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(count)))
+    if return_eids:
+        e = _np.concatenate(out_e) if out_e else _np.zeros((0,), ev.dtype)
+        res = res + (Tensor(jnp.asarray(e)),)
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (graph_sample_neighbors
+    kernel analog). Returns (out_neighbors, out_count[, out_eids])."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling without replacement
+    (weighted_sample_neighbors kernel analog)."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids,
+                                  edge_weight=edge_weight)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact (x, sampled neighbors) into local ids (graph_reindex
+    kernel analog). Returns (reindex_src, reindex_dst, out_nodes)."""
+    import numpy as _np
+
+    xv = _np.asarray(x.numpy() if isinstance(x, Tensor) else x).ravel()
+    nb = _np.asarray(neighbors.numpy() if isinstance(neighbors, Tensor)
+                     else neighbors).ravel()
+    ct = _np.asarray(count.numpy() if isinstance(count, Tensor)
+                     else count).ravel()
+    out_nodes = list(xv)
+    index = {int(v): i for i, v in enumerate(xv)}
+    src = _np.empty(len(nb), _np.int64)
+    for i, v in enumerate(nb):
+        vi = int(v)
+        if vi not in index:
+            index[vi] = len(out_nodes)
+            out_nodes.append(vi)
+        src[i] = index[vi]
+    dst = _np.repeat(_np.arange(len(xv)), ct)
+    return (Tensor(jnp.asarray(src.astype(xv.dtype))),
+            Tensor(jnp.asarray(dst.astype(xv.dtype))),
+            Tensor(jnp.asarray(_np.asarray(out_nodes, xv.dtype))))
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes,
+                 sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling (graph_khop_sampler analog): per-hop uniform
+    sampling with GLOBAL deduplication across hops. Returns
+    (edge_src, edge_dst, sample_index, reindex_x) — local edge ids into
+    ``sample_index``; ``reindex_x`` are the input nodes' local ids."""
+    import numpy as _np
+
+    if return_eids or sorted_eids is not None:
+        raise NotImplementedError(
+            "khop_sampler: eids tracking is not implemented; call "
+            "sample_neighbors(return_eids=True) per hop instead")
+    xv = _np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor)
+                     else input_nodes).ravel()
+    uniq = list(xv)
+    index = {int(v): i for i, v in enumerate(xv)}
+    frontier = xv
+    src_l, dst_l = [], []
+    for size in sample_sizes:
+        if len(frontier) == 0:
+            break
+        neigh, count = sample_neighbors(row, colptr, frontier,
+                                        sample_size=int(size))
+        nb = neigh.numpy()
+        ct = count.numpy()
+        dst_global = _np.repeat(frontier, ct)
+        new_nodes = []
+        for v in nb:
+            vi = int(v)
+            if vi not in index:
+                index[vi] = len(uniq)
+                uniq.append(vi)
+                new_nodes.append(vi)
+        src_l.append(_np.asarray([index[int(v)] for v in nb], _np.int64))
+        dst_l.append(_np.asarray([index[int(v)] for v in dst_global],
+                                 _np.int64))
+        frontier = _np.asarray(new_nodes, xv.dtype)
+    es = _np.concatenate(src_l) if src_l else _np.zeros((0,), _np.int64)
+    ed = _np.concatenate(dst_l) if dst_l else _np.zeros((0,), _np.int64)
+    uniq_a = _np.asarray(uniq, xv.dtype)
+    return (Tensor(jnp.asarray(es.astype(xv.dtype))),
+            Tensor(jnp.asarray(ed.astype(xv.dtype))),
+            Tensor(jnp.asarray(uniq_a)),
+            Tensor(jnp.asarray(_np.arange(len(xv), dtype=xv.dtype))))

@@ -199,12 +199,8 @@ OP_COMPAT: Dict[str, str] = {
     "yolo_loss": "~see yolo_box_head",
     "crf_decoding": "text.viterbi_decode",
     # ---- graph sampling ----
-    "graph_khop_sampler": "~data-dependent neighbor sampling is host "
-                          "input-pipeline work on TPU; on-device message "
-                          "passing IS built (geometric.send_u_recv &co)",
-    "graph_sample_neighbors": "~see graph_khop_sampler",
-    "weighted_sample_neighbors": "~see graph_khop_sampler",
-    "reindex_graph": "~see graph_khop_sampler",
+    "graph_khop_sampler": "geometric.khop_sampler",
+    "graph_sample_neighbors": "geometric.sample_neighbors",
     "segment_pool": "geometric.segment_sum",
     # ---- misc ----
     "auc": "metric.Auc",

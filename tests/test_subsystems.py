@@ -728,3 +728,68 @@ def test_auto_tuner_subprocess_isolation(tmp_path):
     failed = [c for c in tuner.history if "error" in c.metrics]
     assert len(failed) == 1 and failed[0].mp == 4
     assert "137" in failed[0].metrics["error"]
+
+
+def test_geometric_sampling_family():
+    """Round-4: sample_neighbors / weighted variant / reindex_graph /
+    khop_sampler as host-side input-pipeline stages (reference
+    python/paddle/geometric/{sampling/neighbors,reindex}.py; the
+    reindex case is the reference docstring example verbatim)."""
+    import paddle_tpu as paddle
+
+    G = paddle.geometric
+    row = np.array([1, 2, 3, 0, 2, 0, 1, 4, 0, 3], np.int64)
+    colptr = np.array([0, 3, 5, 8, 9, 10], np.int64)
+    paddle.seed(0)
+    neigh, count = G.sample_neighbors(row, colptr,
+                                      np.array([0, 2], np.int64),
+                                      sample_size=2)
+    assert count.numpy().tolist() == [2, 2]
+    assert set(neigh.numpy()[:2]).issubset({1, 2, 3})
+    assert set(neigh.numpy()[2:]).issubset({0, 1, 4})
+    # full-degree when sample_size=-1, eids passthrough
+    n2, c2, e2 = G.sample_neighbors(row, colptr, np.array([1], np.int64),
+                                    eids=np.arange(10), return_eids=True)
+    assert c2.numpy().tolist() == [2] and e2.numpy().tolist() == [3, 4]
+
+    src, dst, nodes = G.reindex_graph(
+        np.array([0, 1, 2], np.int64),
+        np.array([8, 9, 0, 4, 7, 6, 7], np.int64),
+        np.array([2, 3, 2], np.int64))
+    assert src.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6]
+    assert dst.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2]
+    assert nodes.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6]
+
+    w = np.zeros(10)
+    w[0] = 100.0
+    w[1] = w[2] = 1e-9
+    hits = 0
+    for s in range(20):
+        paddle.seed(s)
+        n, _ = G.weighted_sample_neighbors(row, colptr, w,
+                                           np.array([0], np.int64),
+                                           sample_size=1)
+        hits += int(n.numpy()[0] == 1)
+    assert hits >= 18
+
+    es, ed, uniq, rx = G.khop_sampler(row, colptr,
+                                      np.array([0], np.int64), [2, 2])
+    u = uniq.numpy()
+    assert len(es.numpy()) == len(ed.numpy())
+    assert u[0] == 0 and len(u) >= 3
+    # review fixes: global dedup across hops, reindex_x = seed local ids,
+    # edges reference valid local ids, eids path raises
+    assert len(set(u.tolist())) == len(u)
+    assert rx.numpy().tolist() == [0]
+    assert es.numpy().max() < len(u) and ed.numpy().max() < len(u)
+    with pytest.raises(NotImplementedError):
+        G.khop_sampler(row, colptr, np.array([0], np.int64), [2],
+                       return_eids=True)
+    # weighted: zero-weight edges fill only after positive-weight ones
+    w2 = np.zeros(10)
+    w2[0] = 5.0
+    paddle.seed(1)
+    n3, c3 = G.weighted_sample_neighbors(row, colptr, w2,
+                                         np.array([0], np.int64),
+                                         sample_size=2)
+    assert c3.numpy().tolist() == [2] and 1 in n3.numpy().tolist()
