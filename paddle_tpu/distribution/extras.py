@@ -252,17 +252,27 @@ class Binomial(Distribution):
         return comb + v * paddle.log(p + eps) \
             + (n - v) * paddle.log(1.0 - p + eps)
 
-    def entropy(self):
-        # sum over the support (reference computes the exact sum); under
-        # jit total_count is traced and can't size the support -> use a
-        # static truncation (terms beyond n contribute exactly 0 via the
-        # ks <= n mask, so this only costs compute, not accuracy, as long
-        # as n < 128)
+    def entropy(self, max_count: int | None = None):
+        """Exact support sum. Under jit ``total_count`` is traced and cannot
+        size the support, so the sum is truncated at ``max_count`` (default
+        127); terms with k > n contribute exactly 0 via the mask, so the
+        truncation only loses accuracy if a traced n exceeds ``max_count`` —
+        pass a larger ``max_count`` in that case (passing it explicitly also
+        acknowledges the truncation and silences the warning).
+        """
         try:
             nmax = int(jnp.max(self.total_count.value))
         except (jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError):
-            nmax = 127
+            if max_count is None:
+                import warnings
+                warnings.warn(
+                    "Binomial.entropy under jit truncates the support sum at "
+                    "127; if total_count can exceed that the result is "
+                    "silently wrong — pass entropy(max_count=...) to size "
+                    "the truncation (and silence this warning).",
+                    stacklevel=2)
+            nmax = 127 if max_count is None else max_count
         ks = jnp.arange(0.0, nmax + 1.0)
         n = self.total_count.value[..., None]
         p = jnp.clip(self.probs.value[..., None], 1e-12, 1 - 1e-12)

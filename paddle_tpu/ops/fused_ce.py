@@ -57,8 +57,35 @@ def fused_linear_cross_entropy(hidden, head, labels, chunk: int = 4096,
     to be excluded from the denominator. Callers that followed the old
     "map your sentinel to -1" advice must now pass ``ignore_index=-1``.
     """
+    _warn_legacy_sentinel(labels, ignore_index)
     loss, _ = _fwd_impl(hidden, head, labels, chunk, ignore_index)
     return loss
+
+
+_checked_legacy_sentinel = False
+
+
+def _warn_legacy_sentinel(labels, ignore_index):
+    # Surface callers relying on the pre-round-4 contract ("map your
+    # sentinel to -1"): under the new exact semantics a -1 label with the
+    # default ignore_index=-100 counts in the mean denominator. Only
+    # checkable when labels are concrete (eager); traced labels skip.
+    # Checks only the FIRST eager call — a per-call jnp.any + host sync
+    # would tax the eager hot path for a warning that never fires.
+    global _checked_legacy_sentinel
+    if _checked_legacy_sentinel or ignore_index == -1:
+        return
+    if isinstance(labels, jax.core.Tracer):
+        return
+    _checked_legacy_sentinel = True
+    if bool(jnp.any(jnp.asarray(labels) == -1)):
+        import warnings
+        warnings.warn(
+            "fused_linear_cross_entropy saw labels == -1 with "
+            f"ignore_index={ignore_index}: since round 4 these count in the "
+            "mean denominator (zero loss, larger denominator). Pass "
+            "ignore_index=-1 to exclude them, matching the old behavior.",
+            stacklevel=3)
 
 
 def _fwd_impl(hidden, head, labels, chunk, ignore_index):
@@ -220,6 +247,7 @@ def fused_linear_ce_op(hidden, head, labels, chunk: int = None,
                        ignore_index: int = -100):
     from paddle_tpu.flags import flags
     if flags.decompose_fused_ops:
+        _warn_legacy_sentinel(labels, ignore_index)
         return fused_ce_lax(hidden, head, labels, ignore_index)
     if chunk is None:
         chunk = auto_chunk(hidden.shape[0], head.shape[1])

@@ -24,8 +24,18 @@ from typing import Dict, Tuple
 
 __all__ = ["OP_COMPAT", "audit", "yaml_op_names"]
 
-_YAML_FILES = ("/root/reference/paddle/phi/api/yaml/ops.yaml",
-               "/root/reference/paddle/phi/api/yaml/legacy_ops.yaml")
+import os
+
+
+def _yaml_files():
+    # Reference checkout root; override with PADDLE_TPU_REFERENCE_ROOT on
+    # machines where the reference lives elsewhere. Read per call (not at
+    # import) so setting the env var after import still takes effect.
+    # yaml_op_names() returns [] when the files are absent and
+    # tests/test_op_sweep.py skips explicitly.
+    root = os.environ.get("PADDLE_TPU_REFERENCE_ROOT", "/root/reference")
+    return (os.path.join(root, "paddle/phi/api/yaml/ops.yaml"),
+            os.path.join(root, "paddle/phi/api/yaml/legacy_ops.yaml"))
 
 # alias: value = dotted attr path under paddle_tpu (validated by audit());
 # analog: "=prose"; absent: "~reason"
@@ -218,7 +228,7 @@ NAMESPACE_PATHS = (
 
 def yaml_op_names():
     names = set()
-    for f in _YAML_FILES:
+    for f in _yaml_files():
         try:
             with open(f) as fh:
                 for line in fh:

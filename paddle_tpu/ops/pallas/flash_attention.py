@@ -87,9 +87,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
         l = l_scr[:, 0:1]
+        m = m_scr[:, 0:1]
+        # Fully-masked rows come in two shapes: a q block whose k blocks
+        # were ALL skipped (l == 0, needs the clamp) or a visited block
+        # whose row was fully masked (m == _NEG_INF, p == exp(0) == 1 so
+        # l == block_k and acc holds a uniform V sum). Zero both.
         safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, 0:1] + jnp.log(safe_l)
+        masked_row = m <= _NEG_INF * 0.5
+        o_ref[0] = jnp.where(masked_row, 0.0,
+                             acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(masked_row, _NEG_INF, m + jnp.log(safe_l))
 
 
 def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -113,8 +120,13 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
                              (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    o_ref[0] = (pv / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    # A fully-masked row has m == _NEG_INF (finite), so p == 1 everywhere
+    # and pv/l would be the uniform V average — zero it instead. Currently
+    # defensive: flash_attention_fn rejects causal with sq > sk, the only
+    # way such a row arises through the public surface.
+    masked_row = m <= _NEG_INF * 0.5
+    o_ref[0] = jnp.where(masked_row, 0.0, pv / l).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(masked_row, _NEG_INF, m + jnp.log(l))
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -203,7 +215,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # chunked prefill; query i sees keys <= i + offset)
             mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)                        # (BQ, BK) f32
+        # fully-masked rows carry the fwd sentinel lse == _NEG_INF; without
+        # the guard p = exp(-1e30 - (-1e30)) == 1 would leak garbage dk/dv
+        p = jnp.where(lse <= _NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
         pc = p.astype(do.dtype)
         # dv += p^T do
         dv_scr[:] += jax.lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
@@ -255,7 +269,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # chunked prefill; query i sees keys <= i + offset)
             mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
             s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        # masked-row guard: see _dkv_kernel
+        p = jnp.where(lse <= _NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(k.dtype)

@@ -14,6 +14,8 @@ __all__ = ["Adam", "AdamW", "Lamb"]
 
 
 class Adam(Optimizer):
+    _warned_low_precision_moments = False
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=True, name=None):
@@ -37,6 +39,15 @@ class Adam(Optimizer):
         # UNet profile measured ~45ms/step of f32 adam fusions at 748M)
         mdt = jnp.float32 if (self._multi_precision
                               or p.dtype == jnp.float32) else p.dtype
+        if mdt != jnp.float32 and not Adam._warned_low_precision_moments:
+            Adam._warned_low_precision_moments = True
+            import warnings
+            warnings.warn(
+                "Adam/AdamW with multi_precision=False now keeps moments in "
+                f"the param dtype ({p.dtype}); pass multi_precision=True for "
+                "f32 moments + master weights (pre-round-4 behavior). This "
+                "also changes optimizer checkpoint state dtypes.",
+                stacklevel=3)
         st = {"moment1": jnp.zeros_like(p, dtype=mdt),
               "moment2": jnp.zeros_like(p, dtype=mdt),
               "beta1_pow": jnp.ones((), jnp.float32),
