@@ -175,6 +175,12 @@ define_flag("to_static_max_cond_paths", 16,
             "lax.cond inside to_static (jit/cond_capture.py): each "
             "captured bool doubles the leaf-path count; beyond the budget "
             "the call graph-breaks to eager as in round 3")
+define_flag("to_static_max_while_iters", 8,
+            "iteration bound for capturing a `while tensor:` loop inside "
+            "to_static (jit/cond_capture.py): the same bool site forking "
+            "once per iteration is unrolled up to this many times into "
+            "the lax.cond fold (differentiable); a loop that exceeds the "
+            "bound at runtime raises instead of silently truncating")
 define_flag("default_dtype", "float32", "default floating point dtype")
 define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
 define_flag("profiler_dir", "", "directory for profiler trace output")

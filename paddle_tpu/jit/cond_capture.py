@@ -38,6 +38,7 @@ falls back to the round-3 eager graph-break, observable via the
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Callable, List
 
 import jax
@@ -48,11 +49,15 @@ __all__ = ["explore", "resolve_traced_bool", "CaptureOverflow",
 
 
 class Fork(Exception):
-    """A new data-dependent bool site was hit; carries the predicate."""
+    """A new data-dependent bool site was hit; carries the predicate and
+    the bool site identity (code object + bytecode offset of the caller)
+    so :func:`explore` can recognize a ``while tensor:`` spine — the same
+    site forking once per iteration."""
 
-    def __init__(self, pred):
+    def __init__(self, pred, site=None):
         super().__init__("data-dependent bool (capture fork)")
         self.pred = pred
+        self.site = site
 
 
 class CaptureOverflow(Exception):
@@ -97,20 +102,38 @@ def resolve_traced_bool(value) -> bool:
         d = ctx.decisions[ctx.cursor]
         ctx.cursor += 1
         return d
-    raise Fork(jnp.asarray(value).reshape(()).astype(bool))
+    try:
+        # frame 0 = here, 1 = Tensor.__bool__, 2 = the bool() call site
+        f = sys._getframe(2)
+        site = (id(f.f_code), f.f_lasti)
+    except Exception:
+        site = None
+    raise Fork(jnp.asarray(value).reshape(()).astype(bool), site)
 
 
-def explore(thunk: Callable[[], Any], max_paths: int = 16):
+def explore(thunk: Callable[[], Any], max_paths: int = 16,
+            max_while_iters: int | None = None):
     """Run ``thunk`` under bool-capture; return its output with every
     data-dependent branch folded into ``lax.cond``.
+
+    ``max_while_iters`` (round 5): a ``while tensor:`` loop forks at the
+    SAME bool site once per iteration — an all-True spine that would
+    otherwise explore forever and overflow. When a single site has been
+    forced True ``max_while_iters`` times along a path, the next fork at
+    that site is TRUNCATED: the False branch is taken unconditionally and
+    a runtime check (jax.debug.callback) errors if that path is live with
+    the predicate still True — so a loop that respects the bound compiles
+    exactly (and differentiably, via the lax.cond fold), and one that
+    exceeds it at runtime errors loudly instead of silently truncating.
 
     Zero overhead when no fork occurs (single run, returned as-is)."""
 
     n_runs = 0
     # a full binary tree with max_paths leaves takes 2*max_paths - 1 runs;
     # bounding RUNS (not just completed leaves) also catches the
-    # non-terminating case — a data-dependent `while tensor:` forks on an
-    # all-True spine forever and never completes a single leaf
+    # non-terminating case — a data-dependent `while tensor:` at an
+    # unrecognizable site (site=None) forks on an all-True spine forever
+    # and never completes a single leaf
     max_runs = 2 * max_paths
 
     def run(decisions: List[bool]):
@@ -127,13 +150,14 @@ def explore(thunk: Callable[[], Any], max_paths: int = 16):
         try:
             return ("leaf", thunk())
         except Fork as f:
-            return ("fork", f.pred)
+            return ("fork", f.pred, f.site)
         finally:
             _stack.pop()
 
     n_leaves = 0
 
-    def build(prefix: List[bool]):
+    def build(prefix: List[bool], spine: dict):
+        # spine: per-site count of True decisions along this path
         nonlocal n_leaves
         r = run(prefix)
         if r[0] == "leaf":
@@ -143,21 +167,62 @@ def explore(thunk: Callable[[], Any], max_paths: int = 16):
                     f"data-dependent branch capture exceeded "
                     f"{max_paths} paths")
             return r
-        pred = r[1]
+        pred, site = r[1], r[2]
         from paddle_tpu.framework.monitor import stat_add
+        if (max_while_iters is not None and site is not None
+                and spine.get(site, 0) >= max_while_iters):
+            if not _callbacks_supported():
+                # the truncation contract needs the runtime check; without
+                # host callbacks (axon tunnel) fall back to the round-4
+                # graph-break -> eager path, which is always correct
+                raise CaptureOverflow(
+                    "`while tensor:` exceeded to_static_max_while_iters "
+                    "during capture and this backend has no host "
+                    "callbacks for the runtime bound check")
+            stat_add("to_static_while_truncations")
+            return ("trunc", pred, build(prefix + [False], spine))
         stat_add("to_static_cond_captures")
+        # True extends this site's spine; False is a loop EXIT at this
+        # site — reset its count so a later, sequential loop at the same
+        # site gets a fresh iteration budget
         return ("node", pred,
-                build(prefix + [True]), build(prefix + [False]))
+                build(prefix + [True], {**spine, site: spine.get(site, 0) + 1}),
+                build(prefix + [False], {**spine, site: 0}))
 
-    return _combine(build([]))
+    return _combine(build([], {}))
 
 
-def _combine(tree):
+def _callbacks_supported() -> bool:
+    # the axon PJRT tunnel does not implement host send/recv callbacks
+    # (io_callback / pure_callback / debug.callback); cpu/tpu/gpu do
+    return jax.default_backend() in ("cpu", "tpu", "gpu", "cuda", "rocm")
+
+
+def _trunc_check(violation):
+    if bool(violation):
+        raise RuntimeError(
+            "to_static: a captured `while tensor:` loop exceeded the "
+            "to_static_max_while_iters bound at runtime — its result was "
+            "truncated. Raise paddle.set_flags({'to_static_max_while_iters'"
+            ": N}) above the loop's true trip count, or use "
+            "paddle.static.nn.while_loop(max_iters=...).")
+
+
+def _combine(tree, path_pred=None):
     if tree[0] == "leaf":
         return tree[1]
+    if tree[0] == "trunc":
+        _, pred, sub = tree
+        viol = pred if path_pred is None else jnp.logical_and(path_pred, pred)
+        jax.debug.callback(_trunc_check, viol)
+        return _combine(sub, path_pred)
     _, pred, t, f = tree
-    tv, tdef = jax.tree_util.tree_flatten(_combine(t))
-    fv, fdef = jax.tree_util.tree_flatten(_combine(f))
+    tv, tdef = jax.tree_util.tree_flatten(
+        _combine(t, pred if path_pred is None
+                 else jnp.logical_and(path_pred, pred)))
+    fv, fdef = jax.tree_util.tree_flatten(
+        _combine(f, jnp.logical_not(pred) if path_pred is None
+                 else jnp.logical_and(path_pred, jnp.logical_not(pred))))
     if tdef != fdef:
         raise CaptureMismatch(
             f"branches produced different pytree structures: {tdef} vs "

@@ -124,7 +124,8 @@ class StaticFunction:
             # output structure via static Python branching)
             cell.pop("treedef", None)
             return explore(lambda: body(flat_args, key),
-                           max_paths=flags.to_static_max_cond_paths)
+                           max_paths=flags.to_static_max_cond_paths,
+                           max_while_iters=flags.to_static_max_while_iters)
 
         return impl
 

@@ -15,10 +15,11 @@ TPU-native lowering:
 Contract carried over from XLA's structured ops: under tracing, both/all
 branch functions are traced, so they must be pure and return matching
 pytrees (same structure, shapes and dtypes); ``while_loop`` bodies must
-keep loop-var shapes/dtypes invariant. Reverse-mode autodiff through a
-traced ``while_loop`` is not defined (XLA limitation shared with the
-reference's while op); use ``lax.scan``-style fixed-trip loops (or the
-eager path) when gradients through the loop are needed.
+keep loop-var shapes/dtypes invariant. Reverse-mode autodiff through an
+UNBOUNDED traced ``while_loop`` is not defined (XLA limitation); pass
+``max_iters`` to lower the loop to a masked ``lax.scan``, which supports
+reverse-mode AD — the round-5 analog of the reference's
+``while_grad_block`` (python/paddle/autograd/ir_backward.py:783).
 """
 
 from __future__ import annotations
@@ -102,12 +103,25 @@ def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
 
 
 def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
-               is_test: bool = False, name: Optional[str] = None):
+               is_test: bool = False, name: Optional[str] = None,
+               max_iters: Optional[int] = None):
     """``while cond(*vars): vars = body(*vars)``; returns the final vars.
 
     Parity: python/paddle/static/nn/control_flow.py::while_loop (While
-    op). Traced -> ``lax.while_loop`` (shape/dtype-invariant loop vars,
-    no reverse-mode AD); eager -> Python while on the tape.
+    op, with gradients via ir_backward.py while_grad_block). Traced:
+
+    - ``max_iters=None`` -> ``lax.while_loop``: true data-dependent trip
+      count, forward-only (XLA's while has no reverse-mode AD);
+    - ``max_iters=K`` -> ``lax.scan`` over K steps with an active mask:
+      the body runs K times, updates are select-masked once the
+      predicate goes false, so the result equals the unbounded loop
+      whenever the true trip count is <= K — and reverse-mode AD works
+      (this is the round-5 answer to the reference's while_grad_block).
+      ``K`` must genuinely bound the trip count: the loop is truncated
+      at K regardless of the predicate (the masked tail contributes
+      zero gradient either way).
+
+    Eager -> Python while on the tape (gradients always work).
     """
     if not loop_vars:
         raise ValueError("loop_vars cannot be empty")
@@ -115,27 +129,45 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
     if not traced:
         vars_ = tuple(loop_vars)
         pv = p0
+        n = 0
         while bool(pv):
+            if max_iters is not None and n >= max_iters:
+                break  # bound checked BEFORE the body: max_iters=0 runs it
+                #        zero times, matching the traced scan path
             out = body(*vars_)
             vars_ = tuple(out) if isinstance(out, (list, tuple)) else (out,)
             if len(vars_) != len(loop_vars):
                 raise ValueError(
                     f"body returned {len(vars_)} vars, expected "
                     f"{len(loop_vars)}")
+            n += 1
             pv = _pred_value(cond(*vars_))[0]
         return list(vars_)
 
     init = tuple(jax.tree_util.tree_map(_unwrap, v, is_leaf=_is_tensor)
                  for v in loop_vars)
 
-    def cond_fn(carry):
-        pv, _ = _pred_value(cond(*_wrap_tree(list(carry))))
-        return pv
-
     def body_fn(carry):
         out = body(*_wrap_tree(list(carry)))
         out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
         return tuple(_unwrap_tree(v) for v in out)
+
+    if max_iters is not None:
+        def scan_step(carry, _):
+            active, vars_ = carry
+            new = body_fn(vars_)
+            merged = jax.tree_util.tree_map(
+                lambda n_, o: jnp.where(active, n_, o), new, vars_)
+            still, _ = _pred_value(cond(*_wrap_tree(list(merged))))
+            return (jnp.logical_and(active, still), merged), None
+
+        (_, final), _ = lax.scan(scan_step, (p0, init), None,
+                                 length=int(max_iters))
+        return [x for x in _wrap_tree(list(final))]
+
+    def cond_fn(carry):
+        pv, _ = _pred_value(cond(*_wrap_tree(list(carry))))
+        return pv
 
     final = lax.while_loop(cond_fn, body_fn, init)
     return [x for x in _wrap_tree(list(final))]

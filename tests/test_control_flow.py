@@ -8,6 +8,8 @@ captures raw Python ``if tensor:`` branches into lax.cond (zero graph
 breaks) via jit/cond_capture.py.
 """
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -72,6 +74,34 @@ def test_while_loop_eager_and_traced_parity():
     assert "while" in jaxpr
     iv, sv = jax.jit(traced)(np.int32(0), np.float32(0))
     assert int(iv) == 10 and float(sv) == 45.0
+
+
+def test_while_loop_max_iters_reverse_ad():
+    """Round 5 (VERDICT item 3): while_loop(max_iters=K) lowers to a
+    lax.scan with an active mask, so reverse-mode AD works — the analog
+    of the reference's while_grad_block (autograd/ir_backward.py:783)."""
+    import jax
+    import jax.numpy as jnp
+
+    def newton(av):
+        # Newton iteration for sqrt(a): data-dependent trip count,
+        # bounded at 20; d sqrt(a)/da = 1/(2 sqrt(a))
+        out = static.nn.while_loop(
+            lambda x, a: paddle.abs(x * x - a) > 1e-6,
+            lambda x, a: [(x + a / x) * 0.5, a],
+            [paddle.Tensor(jnp.asarray(1.0)), paddle.Tensor(av)],
+            max_iters=20)
+        return out[0]._value
+
+    val = jax.jit(newton)(jnp.asarray(9.0))
+    assert abs(float(val) - 3.0) < 1e-5
+    g = jax.grad(newton)(jnp.asarray(9.0))
+    assert abs(float(g) - 1.0 / 6.0) < 1e-4
+    # truncation semantics: trip count capped at max_iters
+    x, _ = static.nn.while_loop(
+        lambda i, s: i < 100, lambda i, s: [i + 1, s],
+        [paddle.to_tensor(0), paddle.to_tensor(0.0)], max_iters=5)
+    assert int(x.numpy()) == 5
 
 
 def test_switch_case_and_case():
@@ -246,9 +276,35 @@ def test_to_static_path_budget_overflow_falls_back():
         paddle.set_flags({"to_static_max_cond_paths": old})
 
 
-def test_to_static_unbounded_while_falls_back_not_hang():
-    """Review finding: a data-dependent `while tensor:` must graph-break
-    to eager (bounded exploration runs), not recurse forever."""
+def test_to_static_while_tensor_captures_compiled():
+    """Round 5 (VERDICT item 3): a data-dependent `while tensor:` within
+    the to_static_max_while_iters bound compiles into the lax.cond fold —
+    zero graph breaks, correct per-input trip counts from ONE trace."""
+    breaks0 = stat_get("to_static_graph_breaks")
+
+    @paddle.jit.to_static
+    def f(x):
+        n = paddle.to_tensor(0.0)
+        while paddle.sum(x) > 0:
+            x = x - 1.0
+            n = n + 1.0
+        return x, n
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # graph-break warning -> error
+        out, n = f(paddle.to_tensor([3.0]))
+    np.testing.assert_allclose(out.numpy(), [0.0])
+    assert float(n) == 3
+    out2, n2 = f(paddle.to_tensor([1.0]))   # different trip count
+    np.testing.assert_allclose(out2.numpy(), [0.0])
+    assert float(n2) == 1
+    assert stat_get("to_static_graph_breaks") == breaks0
+    assert stat_get("to_static_while_truncations") >= 1
+
+
+def test_to_static_while_over_bound_errors_loudly():
+    """A captured while whose RUNTIME trip count exceeds the bound must
+    raise (truncation check), never silently return the truncated value."""
 
     @paddle.jit.to_static
     def f(x):
@@ -256,9 +312,83 @@ def test_to_static_unbounded_while_falls_back_not_hang():
             x = x - 1.0
         return x
 
-    with pytest.warns(UserWarning):
-        out = f(paddle.to_tensor([3.0]))
+    import jax
+    with pytest.raises(Exception, match="to_static_max_while_iters"):
+        out = f(paddle.to_tensor([30.0]))   # 30 iters > bound of 8
+        jax.block_until_ready(out._value)
+
+
+def test_to_static_sequential_whiles_fresh_budget():
+    """Review finding: a loop EXIT (False at a site) must reset that
+    site's iteration budget, so two sequential loops within the bound
+    don't pool their counts into a spurious truncation error."""
+
+    @paddle.jit.to_static
+    def f(x):
+        while paddle.sum(x) > 0:        # 6 iterations
+            x = x - 1.0
+        y = x + 6.0
+        while paddle.sum(y) > 0:        # 6 more at (potentially) the
+            y = y - 1.0                 # same rotated bool site
+        return y
+
+    import jax
+    out = f(paddle.to_tensor([6.0]))
+    jax.block_until_ready(out._value)
     np.testing.assert_allclose(out.numpy(), [0.0])
+
+
+def test_while_loop_max_iters_zero_parity():
+    """Review finding: max_iters=0 must run the body ZERO times in both
+    the eager and traced paths."""
+    import jax
+
+    def run(iv):
+        out = static.nn.while_loop(
+            lambda i: i < 10, lambda i: [i + 1.0],
+            [paddle.Tensor(iv) if not isinstance(iv, paddle.Tensor) else iv],
+            max_iters=0)
+        return out[0]
+
+    assert float(run(paddle.to_tensor(0.0)).numpy()) == 0.0
+    assert float(jax.jit(lambda v: run(v)._value)(np.float32(0.0))) == 0.0
+
+
+def test_to_static_while_trains_with_grad():
+    """VERDICT item 3 done-criterion: a model with an adaptive-iteration
+    loop trains fully compiled with grad parity vs the eager loop."""
+    from paddle_tpu import nn
+
+    class Adaptive(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = self.fc(x)
+            # iterate until the activation norm decays under 0.5 (data-
+            # dependent trip count; halving guarantees <= 8 iterations)
+            while paddle.mean(paddle.abs(y)) > 0.5:
+                y = y * 0.5
+            return paddle.sum(y)
+
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+    eager = Adaptive()
+    static_m = Adaptive()
+    static_m.set_state_dict(eager.state_dict())
+    sf = paddle.jit.to_static(static_m)
+
+    loss_e = eager(x)
+    loss_e.backward()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        loss_s = sf(x)
+    loss_s.backward()
+    np.testing.assert_allclose(loss_s.numpy(), loss_e.numpy(), rtol=1e-6)
+    for (n1, p1), (n2, p2) in zip(sorted(eager.named_parameters()),
+                                  sorted(static_m.named_parameters())):
+        np.testing.assert_allclose(p2.grad.numpy(), p1.grad.numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n1)
 
 
 def test_to_static_structure_mismatch_falls_back():
