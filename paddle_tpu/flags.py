@@ -181,6 +181,20 @@ define_flag("to_static_max_while_iters", 8,
             "once per iteration is unrolled up to this many times into "
             "the lax.cond fold (differentiable); a loop that exceeds the "
             "bound at runtime raises instead of silently truncating")
+define_flag("to_static_max_specializations", 4,
+            "per-specialization budget for guard-specializing a function "
+            "that graph-broke on a non-bool concretization "
+            "(jit/conc_capture.py): each distinct set of concretized "
+            "values gets its own compiled program with runtime guards; "
+            "beyond the budget the call stays permanently eager")
+define_flag("to_static_guard_miss_limit", 8,
+            "consecutive guard misses before a guard-specialized "
+            "function stops trying compiled programs (each trial costs "
+            "one wasted execution) and settles on permanent eager")
+define_flag("to_static_max_guard_elems", 64,
+            "largest concretized array (elements) that may be baked into "
+            "a guard-specialized program; larger concretizations make "
+            "the function permanently eager")
 define_flag("default_dtype", "float32", "default floating point dtype")
 define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
 define_flag("profiler_dir", "", "directory for profiler trace output")

@@ -86,6 +86,16 @@ class Tensor:
         return current_place()
 
     def numpy(self) -> np.ndarray:
+        # the single concretization choke point (__int__/__float__/item/
+        # tolist/__array__/__bool__-fallback all land here): under a
+        # to_static guard-specialization context this records the value
+        # (probe) or substitutes the baked one (replay) — see
+        # jit/conc_capture.py
+        from paddle_tpu.jit import conc_capture
+        if conc_capture.active() is not None:
+            r = conc_capture.resolve_numpy(self._logical_value())
+            if r is not None:
+                return r
         return np.asarray(self._logical_value())
 
     def _logical_value(self):

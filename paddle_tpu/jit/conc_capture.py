@@ -1,0 +1,138 @@
+"""Guard specialization around non-bool graph breaks (round-5 VERDICT 4).
+
+The reference's SOT (python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py:1594) splits the bytecode at a graph break and executes
+compiled subgraphs on both sides. This module gets the same effect the
+TPU-native way — whole-program specialization with runtime guards —
+without touching bytecode:
+
+- a ``record`` context rides along the eager fallback call (the "probe"):
+  every concretization (``Tensor.numpy()`` — the single choke point that
+  ``__int__``/``__float__``/``__bool__``-fallback/``item``/``tolist``/
+  ``__array__`` all route through) is recorded in call order;
+- a ``replay`` context rides a fresh jax trace of the same function: each
+  concretization site returns the recorded value as a Python constant (so
+  the trace proceeds compiled THROUGH the break) and, when the site's
+  tensor is traced, its tracer is appended as an extra program output — a
+  runtime GUARD;
+- the caller compares guard outputs against the baked values on every
+  specialized call: equal -> the compiled result is exact; different ->
+  guard miss, re-probe eagerly and (budget permitting) build a new
+  specialization keyed by the new values.
+
+Correctness contract: a specialized program is used only when its guards
+verify, so results are always exact; the costs of a miss are one wasted
+compiled execution plus the eager re-probe. Functions whose concretized
+values change every call (e.g. ``float(loss)`` logging) exhaust
+``flags.to_static_max_specializations`` and settle on permanent eager —
+the round-4 behavior, now the floor instead of the only option.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["ConcContext", "ConcMismatch", "capture", "resolve_numpy",
+           "active"]
+
+
+class ConcMismatch(Exception):
+    """Replay hit a different concretization sequence than the probe."""
+
+
+class ConcContext:
+    __slots__ = ("mode", "values", "cursor", "guards", "guard_idx",
+                 "max_elems", "failed", "trace_state")
+
+    def __init__(self, mode: str, values: Optional[List[np.ndarray]] = None,
+                 max_elems: int = 64):
+        assert mode in ("record", "replay")
+        self.mode = mode
+        self.values: List[np.ndarray] = list(values) if values else []
+        self.cursor = 0
+        self.guards: list = []       # tracers (replay) -> guard outputs
+        self.guard_idx: List[int] = []  # which recorded site each guard is
+        self.max_elems = max_elems
+        self.failed: Optional[str] = None
+        # replay: the trace this context rides; a concretization hit in a
+        # DEEPER trace (lax.cond branch / loop body) cannot become a guard
+        # output — its tracer would escape that inner scope
+        self.trace_state = (jax.core.get_opaque_trace_state()
+                            if mode == "replay" else None)
+
+
+# per-thread, like the sibling trace-key / grad-mode stacks: another
+# thread's Tensor.numpy() (watchdog, DataLoader worker, RPC) must not
+# leak into a probe/replay running on this thread
+_tls = threading.local()
+
+
+def _stack() -> List[ConcContext]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def active() -> Optional[ConcContext]:
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+class capture:
+    """Context manager activating a :class:`ConcContext`."""
+
+    def __init__(self, ctx: ConcContext):
+        self.ctx = ctx
+
+    def __enter__(self):
+        _stack().append(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def resolve_numpy(value):
+    """Called from ``Tensor.numpy()``. Returns the ndarray to hand back,
+    or ``None`` when no context is active (normal concretization)."""
+    ctx = active()
+    if ctx is None:
+        return None
+    if ctx.mode == "record":
+        arr = np.asarray(value)
+        if arr.size > ctx.max_elems:
+            # too big to bake/guard; the probe keeps running correctly,
+            # the specialization just won't be built
+            ctx.failed = (f"concretized {arr.size}-element array exceeds "
+                          f"the guard budget ({ctx.max_elems})")
+            return arr
+        ctx.values.append(np.array(arr, copy=True))
+        return arr
+    # replay
+    if ctx.cursor >= len(ctx.values):
+        raise ConcMismatch(
+            "replay hit more concretization sites than the probe recorded")
+    baked = ctx.values[ctx.cursor]
+    site = ctx.cursor
+    ctx.cursor += 1
+    if isinstance(value, jax.core.Tracer):
+        if jax.core.get_opaque_trace_state() != ctx.trace_state:
+            raise ConcMismatch(
+                "concretization inside a nested traced region (lax.cond "
+                "branch / loop body) cannot be guard-specialized")
+        if (tuple(value.shape) != tuple(baked.shape)
+                or np.dtype(value.dtype) != baked.dtype):
+            raise ConcMismatch(
+                f"concretization site {site} changed shape/dtype between "
+                f"probe ({baked.shape}/{baked.dtype}) and replay "
+                f"({value.shape}/{value.dtype})")
+        ctx.guards.append(value)
+        ctx.guard_idx.append(site)
+        return baked
+    return np.asarray(value)

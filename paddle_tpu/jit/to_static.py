@@ -64,10 +64,14 @@ class StaticFunction:
             pass
         self._cache: Dict[Any, Tuple[OpDef, dict]] = {}
         self._warned_break = False  # one-time graph-break warning
-        self._broken: set = set()   # cache keys that graph-broke: go
-        #                             straight to eager, don't re-trace
+        # cache keys that graph-broke -> specialization state:
+        # {"specs": [{"values", "opdef", "cell"}], "permanent": bool}.
+        # Round 5: a break no longer means permanent eager — the eager
+        # fallback doubles as a probe and later calls run a compiled
+        # guard-specialized program (see _call_broken / conc_capture.py)
+        self._broken: Dict[Any, dict] = {}
 
-    def _make_impl(self, static_kwargs: tuple, training: bool, n_state: int,
+    def _make_body(self, static_kwargs: tuple, training: bool, n_state: int,
                    state_names: Tuple[str, ...], cell: dict):
         layer = self._layer
         fn = self._fn
@@ -112,6 +116,13 @@ class StaticFunction:
             finally:
                 rnd.pop_trace_key()
 
+        return body
+
+    def _make_impl(self, static_kwargs: tuple, training: bool, n_state: int,
+                   state_names: Tuple[str, ...], cell: dict):
+        body = self._make_body(static_kwargs, training, n_state, state_names,
+                               cell)
+
         def impl(*flat_args, key):
             # data-dependent Python bools fork the trace into per-path
             # re-runs combined with lax.cond (jit/cond_capture.py) — the
@@ -126,6 +137,26 @@ class StaticFunction:
             return explore(lambda: body(flat_args, key),
                            max_paths=flags.to_static_max_cond_paths,
                            max_while_iters=flags.to_static_max_while_iters)
+
+        return impl
+
+    def _make_replay_impl(self, static_kwargs: tuple, training: bool,
+                          n_state: int, state_names: Tuple[str, ...],
+                          cell: dict, baked_values: list):
+        """A guard-specialized trace: concretizations replay the probe's
+        recorded values as constants; their traced tensors are appended
+        as guard outputs (jit/conc_capture.py)."""
+        body = self._make_body(static_kwargs, training, n_state, state_names,
+                               cell)
+
+        def impl(*flat_args, key):
+            from paddle_tpu.jit import conc_capture
+            cell.pop("treedef", None)
+            ctx = conc_capture.ConcContext("replay", values=baked_values)
+            with conc_capture.capture(ctx):
+                outs = body(flat_args, key)
+            cell["guard_idx"] = list(ctx.guard_idx)
+            return tuple(outs) + tuple(ctx.guards)
 
         return impl
 
@@ -163,14 +194,14 @@ class StaticFunction:
             state_tensors = []
 
         cache_key = (static_kwargs, training, state_names)
-        if cache_key in self._broken:
-            # a prior call graph-broke on this specialization: skip the
-            # (expensive, guaranteed-to-fail) re-trace entirely
-            from paddle_tpu.framework.monitor import stat_add
-            stat_add("to_static_graph_breaks")
-            if self._layer is not None:
-                return self._layer(*args, **kwargs)
-            return self._fn(*args, **kwargs)
+        state = self._broken.get(cache_key)
+        if state is not None:
+            # a prior call graph-broke on this specialization: serve it
+            # from a guard-specialized compiled program when one matches,
+            # else eagerly (probing for a new specialization)
+            return self._call_broken(state, cache_key, args, kwargs,
+                                     static_kwargs, training, state_names,
+                                     state_tensors)
         entry = self._cache.get(cache_key)
         if entry is None:
             cell: dict = {}
@@ -203,14 +234,15 @@ class StaticFunction:
             # lax.cond (jit/cond_capture.py, round 4) — this fallback now
             # only fires for int/array concretization, branches whose
             # outputs mismatch across paths, or a blown path budget.
-            # The reference's SOT (jit/sot/opcode_translator) splits the
-            # bytecode into subgraphs around the break; the contract here
-            # is fall-back-to-eager per call (correct results, no
-            # compile) with a one-time warning + a STAT counter
-            # (to_static_graph_breaks) so the break is observable.
-            from paddle_tpu.framework.monitor import stat_add
-            stat_add("to_static_graph_breaks")
-            self._broken.add(cache_key)
+            # Round 5 (SOT parity, jit/sot subgraph execution analog): the
+            # eager fallback call doubles as a PROBE that records the
+            # concretized values; later calls run a compiled program with
+            # those values baked in and runtime guards verifying them
+            # (jit/conc_capture.py). STAT counters: to_static_graph_breaks
+            # (eager-served calls), to_static_partial_compiled_calls
+            # (guard-specialized compiled calls), to_static_guard_misses.
+            state = self._broken.setdefault(
+                cache_key, {"specs": [], "permanent": False})
             if not self._warned_break:
                 self._warned_break = True
                 import warnings
@@ -218,23 +250,128 @@ class StaticFunction:
                     f"to_static<{getattr(self._fn, '__name__', 'fn')}>: "
                     "data-dependent control flow could not be captured "
                     "into lax.cond (int/array concretization, mismatched "
-                    "branch outputs, or path budget exceeded); falling "
-                    "back to EAGER for these calls (use paddle.where or "
-                    "paddle.static.nn.cond/while_loop to stay compiled)",
+                    "branch outputs, or path budget exceeded); serving "
+                    "these calls EAGERLY while guard-specializing "
+                    "(use paddle.where or paddle.static.nn.cond/"
+                    "while_loop to stay compiled)",
                     stacklevel=2)
-            if self._layer is not None:
-                return self._layer(*args, **kwargs)
-            return self._fn(*args, **kwargs)
+            return self._call_broken(state, cache_key, args, kwargs,
+                                     static_kwargs, training, state_names,
+                                     state_tensors)
+        return self._finish_outputs(outs, cell)
+
+    def _finish_outputs(self, outs, cell: dict, n_guards: int = 0):
+        """Shared compiled-call epilogue: slice leaves/buffers(/guards),
+        write mutated buffers back, unflatten the user pytree."""
         if not isinstance(outs, tuple):
             outs = (outs,)
         n_out = cell["n_out"]
-        out_leaves = list(outs[:n_out])
-        buf_outs = outs[n_out:]
+        end = len(outs) - n_guards
+        buf_outs = outs[n_out:end]
         if self._layer is not None and buf_outs:
             buffers = dict(self._layer.named_buffers())
             for name, v in zip(cell["buf_names"], buf_outs):
                 buffers[name]._set_value(v._value)
-        return jax.tree_util.tree_unflatten(cell["treedef"], out_leaves)
+        return jax.tree_util.tree_unflatten(cell["treedef"],
+                                            list(outs[:n_out]))
+
+    def _call_broken(self, state: dict, cache_key, args, kwargs,
+                     static_kwargs, training, state_names, state_tensors):
+        """Serve a graph-broken specialization: compiled when a
+        guard-specialized program's baked concretizations verify at
+        runtime, eager (recording a new specialization) otherwise."""
+        import numpy as np
+
+        from paddle_tpu.flags import flags
+        from paddle_tpu.framework.monitor import stat_add
+        from paddle_tpu.jit import conc_capture
+
+        # 1. most-recent specialization first (each trial costs one
+        #    execution, so only one is tried per call); a run of
+        #    consecutive misses marks the key permanent-eager so a
+        #    never-matching function stops paying a wasted compiled run
+        if state["specs"] and not state["permanent"]:
+            spec = state["specs"][-1]
+            key = rnd.split_key()
+            tensor_args = [a if isinstance(a, Tensor)
+                           else Tensor(jnp.asarray(a)) for a in args]
+            try:
+                outs = apply_op(spec["opdef"],
+                                tuple(state_tensors + tensor_args),
+                                {"key": key})
+            except (conc_capture.ConcMismatch,
+                    jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError,
+                    CaptureOverflow, CaptureMismatch):
+                # replay trace failed (non-deterministic concretization
+                # sequence, nested break, ...): drop the spec for good.
+                # Anything else (user error, OOM) propagates untouched.
+                state["specs"].pop()
+                state["permanent"] = True
+            else:
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                cell = spec["cell"]
+                n_guards = len(cell["guard_idx"])
+                guard_outs = outs[len(outs) - n_guards:] if n_guards else ()
+                baked = [spec["values"][i] for i in cell["guard_idx"]]
+                if all(np.array_equal(np.asarray(g._value), b)
+                       for g, b in zip(guard_outs, baked)):
+                    stat_add("to_static_partial_compiled_calls")
+                    state["misses"] = 0
+                    return self._finish_outputs(outs, cell, n_guards)
+                stat_add("to_static_guard_misses")
+                state["misses"] = state.get("misses", 0) + 1
+                if state["misses"] >= flags.to_static_guard_miss_limit:
+                    state["permanent"] = True
+
+        # 2. eager probe: correct results now, a new specialization for
+        #    later calls (unless the budget or guard limits say otherwise)
+        stat_add("to_static_graph_breaks")
+        build = not state["permanent"]
+        ctx = conc_capture.ConcContext(
+            "record", max_elems=flags.to_static_max_guard_elems)
+        if build:
+            with conc_capture.capture(ctx):
+                out = (self._layer(*args, **kwargs)
+                       if self._layer is not None
+                       else self._fn(*args, **kwargs))
+        else:
+            out = (self._layer(*args, **kwargs) if self._layer is not None
+                   else self._fn(*args, **kwargs))
+            return out
+        if ctx.failed or not ctx.values:
+            # nothing to specialize on (break came from elsewhere) or a
+            # concretization too large to guard: eager is the end state
+            state["permanent"] = True
+            return out
+        # reuse before build: a spec already baked for these exact values
+        # just wasn't the most-recent one — move it to MRU instead of
+        # compiling a duplicate (and burning the budget)
+        for i, spec in enumerate(state["specs"]):
+            if (len(spec["values"]) == len(ctx.values)
+                    and all(np.array_equal(a, b) for a, b in
+                            zip(spec["values"], ctx.values))):
+                state["specs"].append(state["specs"].pop(i))
+                return out
+        if len(state["specs"]) >= flags.to_static_max_specializations:
+            return out
+        cell2: dict = {}
+        impl2 = self._make_replay_impl(static_kwargs, training,
+                                       len(state_tensors), state_names,
+                                       cell2, list(ctx.values))
+        rules = self._resolve_pass_rules()
+        if rules:
+            from paddle_tpu.passes.rewrite import rewrite as _rewrite
+            impl2 = _rewrite(impl2, rules)
+        opdef2 = OpDef(
+            f"to_static_spec<{getattr(self._fn, '__name__', 'fn')}>",
+            jax.jit(impl2), n_outputs=-1)
+        state["specs"].append(
+            {"values": list(ctx.values), "opdef": opdef2, "cell": cell2})
+        return out
 
     @property
     def code(self) -> str:

@@ -338,6 +338,107 @@ def test_to_static_sequential_whiles_fresh_budget():
     np.testing.assert_allclose(out.numpy(), [0.0])
 
 
+def test_to_static_guard_specialization_compiles_after_break():
+    """Round 5 (VERDICT item 4, SOT parity): a non-bool graph break no
+    longer means permanent eager. The eager fallback probes the
+    concretized values; later calls run a compiled program whose guards
+    verify those values at runtime — matmuls run compiled THROUGH the
+    break site. STAT counters distinguish eager-served vs compiled."""
+    b0 = stat_get("to_static_graph_breaks")
+    c0 = stat_get("to_static_partial_compiled_calls")
+    m0 = stat_get("to_static_guard_misses")
+
+    @paddle.jit.to_static
+    def f(x, w):
+        h = paddle.matmul(x, w)
+        n = int(paddle.sum((x > 0).astype("float32")))   # the break
+        return h * float(n)
+
+    x1 = paddle.to_tensor(np.ones((4, 8), np.float32))
+    w = paddle.to_tensor(np.full((8, 8), 0.1, np.float32))
+    with pytest.warns(UserWarning):
+        o1 = f(x1, w)                    # break -> eager probe + spec
+    o2 = f(x1, w)                        # compiled, guards verify
+    np.testing.assert_allclose(o2.numpy(), o1.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(o2.numpy(), np.full((4, 8), 25.6), rtol=1e-5)
+    assert stat_get("to_static_graph_breaks") - b0 == 1
+    assert stat_get("to_static_partial_compiled_calls") - c0 == 1
+
+    # a different concretized value: guard miss -> eager + new spec,
+    # then compiled again with the new baked value
+    x2 = paddle.to_tensor(
+        np.concatenate([np.ones((2, 8)), -np.ones((2, 8))]).astype(np.float32))
+    o3 = f(x2, w)                        # miss + probe (n: 32 -> 16)
+    o4 = f(x2, w)                        # compiled with n=16
+    np.testing.assert_allclose(o4.numpy(), o3.numpy(), rtol=1e-6)
+    assert float(o4.numpy()[0, 0]) == pytest.approx(12.8, rel=1e-5)
+    assert float(o4.numpy()[2, 0]) == pytest.approx(-12.8, rel=1e-5)
+    assert stat_get("to_static_guard_misses") - m0 == 1
+    assert stat_get("to_static_partial_compiled_calls") - c0 == 2
+    assert stat_get("to_static_graph_breaks") - b0 == 2
+
+
+def test_to_static_guard_specialization_trains_with_grad():
+    """Backward through a guard-specialized program: grads must match the
+    eager loop (guards are extra outputs with zero cotangent)."""
+    from paddle_tpu import nn
+
+    class Scaled(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            n = int(paddle.sum((x > 0).astype("float32")))  # break
+            return paddle.sum(h) * float(n)
+
+    x = paddle.to_tensor(np.arange(-4, 4, dtype=np.float32).reshape(2, 4))
+    eager, spec = Scaled(), Scaled()
+    spec.set_state_dict(eager.state_dict())
+    sf = paddle.jit.to_static(spec)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sf(x)                            # probe call builds the spec
+    c0 = stat_get("to_static_partial_compiled_calls")
+    loss_s = sf(x)                       # compiled
+    assert stat_get("to_static_partial_compiled_calls") == c0 + 1
+    loss_s.backward()
+    loss_e = eager(x)
+    loss_e.backward()
+    np.testing.assert_allclose(loss_s.numpy(), loss_e.numpy(), rtol=1e-6)
+    for (n1, p1), (n2, p2) in zip(sorted(eager.named_parameters()),
+                                  sorted(spec.named_parameters())):
+        np.testing.assert_allclose(p2.grad.numpy(), p1.grad.numpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n1)
+
+
+def test_to_static_guard_miss_storm_goes_permanent_eager():
+    """A function whose concretized value changes every call must stop
+    burning a wasted compiled run per call: after the specialization
+    budget + consecutive-miss window it settles on permanent eager."""
+    from paddle_tpu.flags import flags
+
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def g(x):
+        calls["n"] += 1
+        v = float(paddle.sum(x))         # different every call
+        return x * v
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(1, 16):
+            out = g(paddle.to_tensor(np.full((2,), float(i), np.float32)))
+            np.testing.assert_allclose(out.numpy(), np.full((2,), i * 2.0 * i),
+                                       rtol=1e-6)
+    key = list(g._broken)[0]
+    assert g._broken[key]["permanent"] is True
+    assert len(g._broken[key]["specs"]) <= flags.to_static_max_specializations
+
+
 def test_while_loop_max_iters_zero_parity():
     """Review finding: max_iters=0 must run the body ZERO times in both
     the eager and traced paths."""
