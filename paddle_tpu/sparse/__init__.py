@@ -32,7 +32,10 @@ class SparseCooTensor(Tensor):
         return self.indices_t
 
     def values(self):
-        return Tensor(self._value.data)
+        # conv-layout tensors carry a tape-linked values Tensor (see
+        # sparse/conv.py): return it so backward() reaches the producers
+        vt = getattr(self, "_values_tensor", None)
+        return vt if vt is not None else Tensor(self._value.data)
 
     def to_dense(self):
         return Tensor(self._value.todense())
@@ -89,6 +92,24 @@ def _wrap_sparse(mat) -> SparseCooTensor:
 
 
 def add(x, y):
+    # residual connections between conv-layout tensors with IDENTICAL
+    # patterns keep the tape chain; other pattern combinations go through
+    # BCOO addition (correct values, no values-tape linkage)
+    xt = getattr(x, "_values_tensor", None)
+    yt = getattr(y, "_values_tensor", None)
+    if (xt is not None and yt is not None
+            and not (xt.stop_gradient and yt.stop_gradient)):
+        import numpy as _np
+        xm, ym = _sp(x), _sp(y)
+        if (xm.indices.shape == ym.indices.shape
+                and bool(jnp.all(xm.indices == ym.indices))):
+            out_t = apply_op(OpDef("sparse_add", lambda a, b: a + b),
+                             (xt, yt), {})
+            t = _wrap_sparse(jsparse.BCOO((out_t._value, xm.indices),
+                                          shape=xm.shape))
+            t._values_tensor = out_t
+            t.stop_gradient = out_t.stop_gradient
+            return t
     r = _sp(x) + _sp(y)
     return _wrap_sparse(r) if isinstance(r, jsparse.BCOO) else Tensor(r)
 
@@ -134,10 +155,26 @@ def masked_matmul(x, y, mask):
     return _wrap_sparse(jsparse.BCOO((vals, idx), shape=mm.shape))
 
 
-def relu(x):
+def _apply_valuewise(x, name, fn, *args):
+    """Sparsity-preserving value-wise op. Conv-layout tensors carry a
+    tape-linked values Tensor (sparse/conv.py): route through the op
+    registry so stacked sparse nets backprop through EVERY value-wise op,
+    not just relu."""
     m = _sp(x)
-    return _wrap_sparse(jsparse.BCOO((jnp.maximum(m.data, 0), m.indices),
+    vt = getattr(x, "_values_tensor", None)
+    if vt is not None and not vt.stop_gradient:
+        out_t = apply_op(OpDef(name, lambda v: fn(v, *args)), (vt,), {})
+        t = _wrap_sparse(jsparse.BCOO((out_t._value, m.indices),
+                                      shape=m.shape))
+        t._values_tensor = out_t
+        t.stop_gradient = out_t.stop_gradient
+        return t
+    return _wrap_sparse(jsparse.BCOO((fn(m.data, *args), m.indices),
                                      shape=m.shape))
+
+
+def relu(x):
+    return _apply_valuewise(x, "sparse_relu", lambda v: jnp.maximum(v, 0))
 
 
 def to_dense(x):
@@ -166,9 +203,7 @@ def transpose(x, perm):
 
 def _valuewise(name, fn):
     def op(x, *args):
-        m = _sp(x)
-        return _wrap_sparse(jsparse.BCOO((fn(m.data, *args), m.indices),
-                                         shape=m.shape))
+        return _apply_valuewise(x, f"sparse_{name}", fn, *args)
 
     op.__name__ = name
     op.__doc__ = (f"sparse.{name}: apply {name} to the stored values; "

@@ -17,8 +17,11 @@ import paddle_tpu.sparse as sparse
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.nn.layer_base import Layer
 
+from paddle_tpu.sparse import conv as functional  # noqa: E402
+
 __all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
-           "SparseLinear"]
+           "SparseLinear", "Conv3D", "SubmConv3D", "Conv2D", "SubmConv2D",
+           "MaxPool3D", "AvgPool3D", "functional"]
 
 
 class ReLU(Layer):
@@ -55,10 +58,13 @@ class BatchNorm(Layer):
 
     def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
         super().__init__()
+        from paddle_tpu.nn import initializer as init
         self.num_features = num_features
         self.momentum = momentum
         self.epsilon = epsilon
-        self.weight = self.create_parameter([num_features])
+        # gamma=1 / beta=0, the reference BatchNorm initialization
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=init.Constant(1.0))
         self.bias = self.create_parameter([num_features], is_bias=True)
         self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
         self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
@@ -67,6 +73,8 @@ class BatchNorm(Layer):
         from jax.experimental import sparse as jsparse
 
         m = x._value
+        if m.data.ndim == 2:
+            return self._forward_dense_channels(x, m)
         ch = m.indices[:, -1]
         vals = m.data
         if self.training:
@@ -87,6 +95,165 @@ class BatchNorm(Layer):
         Tensor.__init__(out, 0.0)
         out._value = jsparse.BCOO((out_vals, m.indices), shape=m.shape)
         return out
+
+    def _forward_dense_channels(self, x, m):
+        """Conv layout (values (nnz, C), channels dense): per-channel
+        statistics over the stored points, tape-recorded so gradients
+        flow through stacked sparse conv nets (sparse/conv.py)."""
+        from jax.experimental import sparse as jsparse
+
+        from paddle_tpu.ops.registry import OpDef, apply_op
+
+        vt = getattr(x, "_values_tensor", None)
+        if vt is None:
+            vt = Tensor(m.data, stop_gradient=x.stop_gradient)
+        eps = self.epsilon
+        if int(m.data.shape[0]) == 0:
+            # empty batch: no stats to take (unguarded mean/var would
+            # poison the running buffers with NaN); identity transform
+            out = Tensor.__new__(type(x))
+            Tensor.__init__(out, 0.0)
+            out._value = m
+            out._values_tensor = vt
+            out.stop_gradient = vt.stop_gradient
+            return out
+        if self.training:
+            mean = jnp.mean(m.data, axis=0)
+            var = jnp.var(m.data, axis=0)
+            self._mean._set_value(self.momentum * self._mean.value
+                                  + (1 - self.momentum) * mean)
+            self._variance._set_value(self.momentum * self._variance.value
+                                      + (1 - self.momentum) * var)
+
+            def impl(v, w, b):
+                mu = jnp.mean(v, axis=0)
+                s2 = jnp.var(v, axis=0)
+                return (v - mu) / jnp.sqrt(s2 + eps) * w + b
+        else:
+            mean, var = self._mean.value, self._variance.value
+
+            def impl(v, w, b):
+                return (v - mean) / jnp.sqrt(var + eps) * w + b
+
+        out_t = apply_op(OpDef("sparse_batch_norm", impl),
+                         (vt, self.weight, self.bias), {})
+        out = Tensor.__new__(type(x))
+        Tensor.__init__(out, 0.0)
+        out._value = jsparse.BCOO((out_t._value, m.indices), shape=m.shape)
+        out._values_tensor = out_t
+        out.stop_gradient = out_t.stop_gradient
+        return out
+
+
+class _SparseConvNd(Layer):
+    """Shared base of the sparse conv layers (round-5 VERDICT item 5).
+    Parity: python/paddle/sparse/nn/layer/conv.py::_Conv3D/_Conv2D —
+    weight layout (*kernel, in_channels/groups, out_channels), channels
+    last. Compute lives in sparse/conv.py (host rulebook + MXU matmuls)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, nd, stride,
+                 padding, dilation, groups, subm, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as init
+        if groups != 1:
+            raise NotImplementedError("sparse conv: groups=1 only")
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self._subm, self._nd = groups, subm, nd
+        fan_in = in_channels * int(np.prod(ks))
+        bound = float(np.sqrt(1.0 / max(1, fan_in)))
+        self.weight = self.create_parameter(
+            ks + (in_channels, out_channels), attr=weight_attr,
+            default_initializer=init.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((out_channels,),
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fns = {(3, False): functional.conv3d,
+               (3, True): functional.subm_conv3d,
+               (2, False): functional.conv2d,
+               (2, True): functional.subm_conv2d}
+        return fns[(self._nd, self._subm)](
+            x, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, dilation=self.dilation)
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class MaxPool3D(Layer):
+    """Sparse max pooling (python/paddle/sparse/nn/layer/pooling.py)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D: return_mask is not implemented")
+        if ceil_mode:
+            raise NotImplementedError(
+                "sparse MaxPool3D: ceil_mode is not implemented "
+                "(floor output sizes only)")
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+
+    def forward(self, x):
+        return functional.avg_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
 
 
 class SparseLinear(Layer):
