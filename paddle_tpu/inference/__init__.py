@@ -30,6 +30,22 @@ class Config:
         self._memory_optim = True
         self._layer = None
         self._aot_dir = None
+        self._warmup = False
+        self._cast_inputs = True
+
+    def enable_warmup(self, flag: bool = True):
+        """Execute every AOT entry once at load (first request pays no
+        deserialization/compile-transfer latency)."""
+        self._warmup = flag
+
+    def set_cast_inputs(self, flag: bool):
+        """Coerce feeds to each bucket's exported dtype (default on)."""
+        self._cast_inputs = flag
+
+    def set_bucket_padding(self, flag: bool):
+        """Serve smaller batches by padding to the nearest bucket (default
+        on; disable for models with cross-batch-coupled outputs)."""
+        self._bucket_padding = flag
 
     def set_aot_bundle(self, bundle_dir: str):
         """Serve from an AOT bundle (inference/bundle.py): StableHLO
@@ -76,7 +92,12 @@ class Predictor:
         self.config = config
         if getattr(config, "_aot_dir", None) is not None:
             from paddle_tpu.inference.bundle import AotPredictor
-            aot = AotPredictor(config._aot_dir, device=config._device)
+            aot = AotPredictor(config._aot_dir, device=config._device,
+                               warmup=getattr(config, "_warmup", False),
+                               cast_inputs=getattr(config, "_cast_inputs",
+                                                   True),
+                               allow_bucket_padding=getattr(
+                                   config, "_bucket_padding", True))
             self._aot = aot
             self._layer = None
             self._input_names = aot.get_input_names()
@@ -138,6 +159,13 @@ class Predictor:
             return [self._results[n] for n in self._output_names]
         return True
 
+
+    def memory_report(self):
+        """AOT bundles: artifact + serving-buffer sizes (see
+        AotPredictor.memory_report)."""
+        if self._aot is not None:
+            return self._aot.memory_report()
+        raise ValueError("memory_report requires an AOT bundle predictor")
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  max_len: int = 512, eos_token_id=None) -> np.ndarray:

@@ -159,6 +159,9 @@ def export_decoder_bundle(decoder, out_dir: str,
         "kind": "llama_decoder",
         "inputs": ["input_ids"],
         "outputs": ["tokens"],
+        # int8 weight-only decoders export with the quantized params baked
+        # into the modules (the PTQ -> serving chain, VERDICT r5 item 6)
+        "weight_dtype": decoder.weight_dtype or "none",
         "max_len": decoder.max_len,
         "vocab_size": cfg.vocab_size,
         "logits_dtype": str(logits_sds.dtype),
@@ -176,14 +179,37 @@ class AotPredictor:
     ``run`` serves plain-forward bundles by named inputs/outputs;
     ``generate`` serves llama_decoder bundles (prefill at the (B, S)
     bucket, greedy decode at the smallest (B, N>=max_new_tokens) bucket,
-    trimmed to the requested length)."""
+    trimmed to the requested length).
 
-    def __init__(self, bundle_dir: str, device: Optional[str] = None):
+    Ergonomics (round-5 VERDICT item 8, AnalysisConfig capability):
+    - a smaller batch than any exported bucket pads up to the NEAREST
+      bucket and trims the outputs (TensorRT-profile style), instead of
+      exact-shape-or-error;
+    - ``warmup=True`` executes every entry once with zeros at load time,
+      so the first request pays no deserialization/transfer latency;
+    - ``cast_inputs=True`` coerces feeds to the bucket dtype;
+    - ``memory_report()`` sizes the artifact and the serving buffers."""
+
+    def __init__(self, bundle_dir: str, device: Optional[str] = None,
+                 warmup: bool = False, cast_inputs: bool = True,
+                 allow_bucket_padding: bool = True):
+        """``allow_bucket_padding``: serve smaller batches by zero-padding
+        to the nearest bucket. CAVEAT: only sound when the model treats
+        batch rows independently (the overwhelmingly common case); a graph
+        with cross-batch-coupled outputs (e.g. a batch-mean output) would
+        silently fold the pad rows in — disable padding for such models
+        (Config.set_bucket_padding(False)) to get the strict
+        exact-shape-or-error behavior back."""
         with open(os.path.join(bundle_dir, _META)) as f:
             self.meta = json.load(f)
         self._dir = bundle_dir
         self._entries: Dict[str, object] = {}
         self.device = device
+        self.cast_inputs = cast_inputs
+        self.allow_bucket_padding = allow_bucket_padding
+        self.padded_calls = 0      # observability: nearest-bucket serves
+        if warmup:
+            self.warmup()
 
     # -- common ------------------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -199,6 +225,68 @@ class AotPredictor:
             self._entries[fname] = fn
         return fn
 
+    # -- config/ops surface ------------------------------------------------
+    def warmup(self) -> None:
+        """Execute every exported entry once with zeros: pays module
+        deserialization + first-dispatch cost at LOAD time instead of on
+        the first real request (AnalysisConfig warmup analog)."""
+        import jax.numpy as jnp
+        if self.meta["kind"] == "predict":
+            for b in self.meta["buckets"]:
+                args = [jnp.zeros(tuple(s), jnp.dtype(d))
+                        for s, d in zip(b["shapes"], b["dtypes"])]
+                self._entry(b["file"])(*args)
+            return
+        # EVERY decode bucket warms once (each is its own module); the
+        # prefill feeding it re-runs per decode bucket because its cache
+        # outputs are donated into the decode call. Prefill buckets with
+        # no same-batch decode still warm on their own.
+        decode_by_batch: Dict[int, list] = {}
+        for dc in self.meta["decode_buckets"]:
+            decode_by_batch.setdefault(dc["batch"], []).append(dc)
+        for pf in self.meta["prefill_buckets"]:
+            B = pf["batch"]
+            decs = decode_by_batch.get(B, [None]) \
+                if pf is self._first_prefill(B) else [None]
+            for dc in decs:
+                ids = jnp.zeros((B, pf["seq"]), jnp.int32)
+                kc, vc = self._make_cache(B)
+                logits, kc, vc = self._entry(pf["file"])(ids, kc, vc)
+                if dc is not None:
+                    self._entry(dc["file"])(
+                        logits, kc, vc, jnp.asarray(pf["seq"], jnp.int32))
+
+    def _first_prefill(self, B: int):
+        return next((b for b in self.meta["prefill_buckets"]
+                     if b["batch"] == B), None)
+
+    def memory_report(self) -> Dict[str, object]:
+        """Artifact + serving-buffer sizes: per-entry bytes on disk (the
+        baked-weight modules ARE the deployment payload) and the KV-cache
+        bytes a generate() call allocates per batch bucket."""
+        entries = {}
+        total = 0
+        for f in os.listdir(self._dir):
+            if f.endswith(".aot"):
+                sz = os.path.getsize(os.path.join(self._dir, f))
+                entries[f] = sz
+                total += sz
+        report = {"entries_bytes": entries, "artifact_bytes": total}
+        if self.meta["kind"] == "llama_decoder":
+            caches = {}
+            for b, cm in self.meta["caches"].items():
+                per = int(np.prod(cm["shape"])) * cm["n_buffers"] \
+                    * np.dtype(cm["dtype"]).itemsize
+                caches[b] = 2 * per                      # K and V
+            report["kv_cache_bytes_per_batch"] = caches
+        return report
+
+    def _cast(self, arr, dtype):
+        a = np.asarray(arr)
+        if self.cast_inputs and str(a.dtype) != dtype:
+            a = a.astype(np.dtype(dtype))
+        return a
+
     # -- plain forward -----------------------------------------------------
     def run(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         if self.meta["kind"] != "predict":
@@ -209,15 +297,58 @@ class AotPredictor:
         shapes = tuple(tuple(a.shape) for a in args)
         for b in self.meta["buckets"]:
             if tuple(tuple(s) for s in b["shapes"]) == shapes:
+                args = [self._cast(a, d) for a, d in zip(args, b["dtypes"])]
                 outs = self._entry(b["file"])(*args)
                 outs = outs if isinstance(outs, (list, tuple)) else [outs]
                 return {n: np.asarray(o)
                         for n, o in zip(self.meta["outputs"], outs)}
+        # nearest-bucket batch padding: every input must share ONE leading
+        # batch dim; same trailing dims as the bucket; smallest bucket
+        # batch that fits; outputs trimmed back to the fed batch
+        B = shapes[0][0] if shapes and shapes[0] else None
+        same_batch = (self.allow_bucket_padding and B is not None
+                      and all(s and s[0] == B for s in shapes))
+        cands = []
+        for b in self.meta["buckets"]:
+            bs = [tuple(s) for s in b["shapes"]]
+            if (same_batch
+                    and all(len(s) == len(g) and s[1:] == g[1:]
+                            for s, g in zip(bs, shapes))
+                    and all(s[0] == bs[0][0] for s in bs)
+                    and bs[0][0] > B):
+                cands.append((bs[0][0], b))
+        if cands:
+            nb, b = min(cands, key=lambda t: t[0])
+            self.padded_calls += 1
+            padded = []
+            for a, d in zip(args, b["dtypes"]):
+                a = self._cast(a, d)
+                pad = np.zeros((nb - a.shape[0],) + a.shape[1:], a.dtype)
+                padded.append(np.concatenate([a, pad], axis=0))
+            outs = self._entry(b["file"])(*padded)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            # trim ONLY outputs whose leading dim is the padded batch; a
+            # leading dim that isn't nb is not a batch axis
+            return {n: (np.asarray(o)[:B]
+                        if np.ndim(o) and np.shape(o)[0] == nb
+                        else np.asarray(o))
+                    for n, o in zip(self.meta["outputs"], outs)}
         raise ValueError(
             f"no shape bucket for inputs {shapes}; exported buckets: "
             f"{[b['shapes'] for b in self.meta['buckets']]}")
 
     # -- LM decode ---------------------------------------------------------
+    def _make_cache(self, B: int):
+        import jax.numpy as jnp
+        cm = self.meta["caches"][str(B)]
+        dt = jnp.dtype(cm["dtype"])
+        shape = tuple(cm["shape"])
+        if cm["n_buffers"] == 1:
+            return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+        kc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
+        vc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
+        return kc, vc
+
     def generate(self, input_ids, max_new_tokens: int) -> np.ndarray:
         if self.meta["kind"] != "llama_decoder":
             raise ValueError(f"bundle kind {self.meta['kind']!r} cannot "
@@ -230,34 +361,42 @@ class AotPredictor:
             raise ValueError(
                 f"prompt {S} + {max_new_tokens} new tokens exceeds the "
                 f"bundle's max_len {self.meta['max_len']}")
-        pf = next((b for b in self.meta["prefill_buckets"]
-                   if b["batch"] == B and b["seq"] == S), None)
-        if pf is None:
+        # exact batch bucket, else the smallest exported batch that fits
+        # (prompt rows padded with zeros, outputs trimmed back; decode
+        # rows are independent, so padding is always sound here)
+        min_b = B if self.allow_bucket_padding else None
+        batches = sorted({b["batch"] for b in self.meta["prefill_buckets"]
+                          if b["seq"] == S
+                          and (b["batch"] == B
+                               or (min_b is not None
+                                   and b["batch"] >= min_b))})
+        if not batches:
             have = [(b["batch"], b["seq"])
                     for b in self.meta["prefill_buckets"]]
             raise ValueError(
                 f"no prefill bucket for (B={B}, S={S}); exported: {have}")
+        nb = batches[0]
+        pf = next(b for b in self.meta["prefill_buckets"]
+                  if b["batch"] == nb and b["seq"] == S)
         cands = [b for b in self.meta["decode_buckets"]
-                 if b["batch"] == B and b["steps"] >= max_new_tokens - 1]
+                 if b["batch"] == nb and b["steps"] >= max_new_tokens - 1]
         if not cands:
             have = [(b["batch"], b["steps"])
                     for b in self.meta["decode_buckets"]]
             raise ValueError(
-                f"no decode bucket with B={B}, "
+                f"no decode bucket with B={nb}, "
                 f"steps>={max_new_tokens - 1}; exported: {have}")
         dc = min(cands, key=lambda b: b["steps"])
 
-        cm = self.meta["caches"][str(B)]
-        dt = jnp.dtype(cm["dtype"])
-        shape = tuple(cm["shape"])
-        if cm["n_buffers"] == 1:
-            kc, vc = jnp.zeros(shape, dt), jnp.zeros(shape, dt)
-        else:
-            kc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
-            vc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
+        fed = ids
+        if nb != B:
+            self.padded_calls += 1
+            fed = np.concatenate(
+                [ids, np.zeros((nb - B, S), ids.dtype)], axis=0)
+        kc, vc = self._make_cache(nb)
         logits, kc, vc = self._entry(pf["file"])(
-            jnp.asarray(ids, jnp.int32), kc, vc)
+            jnp.asarray(fed, jnp.int32), kc, vc)
         toks = self._entry(dc["file"])(logits, kc, vc,
                                        jnp.asarray(S, jnp.int32))
-        toks = np.asarray(toks)[:, :max_new_tokens]
+        toks = np.asarray(toks)[:B, :max_new_tokens]
         return np.concatenate([ids, toks.astype(ids.dtype)], axis=1)

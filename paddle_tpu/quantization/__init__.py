@@ -21,7 +21,8 @@ from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.ops.registry import register_op
 
 __all__ = ["weight_quantize", "weight_only_linear", "llm_int8_linear",
-           "QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "quantize",
+           "QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "EMAObserver",
+           "HistogramObserver", "KLObserver", "quantize",
            "dequantize", "fake_quantize", "QuantedLinear", "QuantedConv2D"]
 
 
@@ -71,6 +72,121 @@ class AbsmaxObserver:
     def scale(self) -> float:
         qmax = 2 ** (self.quant_bits - 1) - 1
         return max(self._absmax, 1e-8) / qmax
+
+
+class EMAObserver:
+    """Exponential-moving-average abs-max observer (round-5 VERDICT 6).
+
+    Smooths per-batch range spikes during calibration: scale follows
+    ``ema = m * ema + (1 - m) * batch_absmax`` instead of the running
+    max, so one outlier batch doesn't pin the range forever (the
+    reference's EMA/moving-average observer capability)."""
+
+    def __init__(self, quant_bits: int = 8, momentum: float = 0.9):
+        self.quant_bits = quant_bits
+        self.momentum = momentum
+        self._ema: Optional[float] = None
+
+    def observe(self, x):
+        import numpy as np
+        v = float(np.max(np.abs(np.asarray(
+            x.value if isinstance(x, Tensor) else x))))
+        self._ema = v if self._ema is None else (
+            self.momentum * self._ema + (1.0 - self.momentum) * v)
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return max(self._ema or 0.0, 1e-8) / qmax
+
+
+class HistogramObserver:
+    """Histogram-of-|x| observer; scale from a coverage percentile.
+
+    Accumulates a fixed-bin histogram of absolute values, widening (and
+    re-binning) when a batch exceeds the current range; ``scale()`` clips
+    at the smallest threshold covering ``percent`` of the observed mass —
+    robust to the long activation tails that break abs-max calibration."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048,
+                 percent: float = 0.9999):
+        self.quant_bits = quant_bits
+        self.bins = bins
+        self.percent = percent
+        self._hist = None
+        self._limit = 0.0
+
+    def observe(self, x):
+        import numpy as np
+        v = np.abs(np.asarray(
+            x.value if isinstance(x, Tensor) else x, np.float32)).ravel()
+        vmax = float(v.max()) if v.size else 0.0
+        if self._hist is None:
+            self._limit = max(vmax, 1e-8)
+            self._hist = np.zeros(self.bins, np.float64)
+        elif vmax > self._limit:
+            # widen: fold the existing histogram into the new binning
+            new_limit = vmax
+            ratio = self._limit / new_limit
+            old_edges = np.linspace(0, ratio * self.bins, self.bins + 1)
+            idx = np.clip(((old_edges[:-1] + old_edges[1:]) / 2).astype(int),
+                          0, self.bins - 1)
+            folded = np.zeros(self.bins, np.float64)
+            np.add.at(folded, idx, self._hist)
+            self._hist = folded
+            self._limit = new_limit
+        h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._limit))
+        self._hist += h
+
+    def _threshold(self) -> float:
+        import numpy as np
+        if self._hist is None or self._hist.sum() == 0:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / self._hist.sum()
+        bin_i = int(np.searchsorted(cdf, self.percent))
+        return (bin_i + 1) / self.bins * self._limit
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return max(self._threshold(), 1e-8) / qmax
+
+
+class KLObserver(HistogramObserver):
+    """KL-divergence calibration (the TensorRT / reference 'mse/kl'
+    observer family): picks the clip threshold whose quantized
+    distribution is closest (min KL) to the clipped reference
+    distribution, trading outlier clipping against resolution."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048):
+        super().__init__(quant_bits=quant_bits, bins=bins)
+
+    def _threshold(self) -> float:
+        import numpy as np
+        if self._hist is None or self._hist.sum() == 0:
+            return 1e-8
+        nlevels = 2 ** (self.quant_bits - 1)        # 128 for int8
+        hist = self._hist.astype(np.float64)
+        best_i, best_kl = self.bins, np.inf
+        for i in range(nlevels, self.bins + 1, max(1, self.bins // 128)):
+            p = hist[:i].copy()
+            p[-1] += hist[i:].sum()                 # clip tail into last bin
+            if p.sum() == 0:
+                continue
+            # quantize the i bins down to nlevels, then expand back
+            chunks = np.array_split(p, nlevels)
+            q = np.concatenate([
+                np.full(len(c), c.sum() / max((c > 0).sum(), 1))
+                * (c > 0) for c in chunks])
+            pn = p / p.sum()
+            qs = q.sum()
+            if qs == 0:
+                continue
+            qn = q / qs
+            mask = pn > 0
+            kl = float(np.sum(pn[mask] * np.log(
+                pn[mask] / np.maximum(qn[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        return best_i / self.bins * self._limit
 
 
 class QuantConfig:

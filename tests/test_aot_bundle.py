@@ -72,9 +72,15 @@ def test_predict_bundle_subprocess_parity(tmp_path):
         np.testing.assert_allclose(
             out2, np.load({str(tmp_path / 'expect.npy')!r})[:2],
             rtol=1e-5, atol=1e-5)
-        # unknown shape -> clear bucket error
+        # B=3 has no exact bucket: round 5 pads to the nearest (B=4)
+        # bucket and trims, instead of erroring
+        out3 = pred.run([x[:3]])[0]
+        np.testing.assert_allclose(
+            out3, np.load({str(tmp_path / 'expect.npy')!r})[:3],
+            rtol=1e-5, atol=1e-5)
+        # a genuinely unservable shape still errors clearly
         try:
-            pred.run([x[:3]])
+            pred.run([np.zeros((3, 9), np.float32)])
             raise SystemExit("bucket miss should raise")
         except ValueError as e:
             assert "bucket" in str(e)
@@ -125,6 +131,122 @@ def test_decoder_bundle_subprocess_generate_parity(tmp_path):
     assert "GENERATE_OK" in _run_fresh(code)
 
 
+def test_int8_decoder_bundle_subprocess_parity(tmp_path):
+    """Round-5 VERDICT item 6: the int8 weight-only decode path exports
+    into an AOT bundle (quantized params baked into the modules) and a
+    fresh process with zero model Python serves it bit-exactly."""
+    from paddle_tpu.inference import export_decoder_bundle
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64)
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    dec = LlamaDecoder(model, max_len=64, weight_dtype="int8")
+    assert any(k.endswith(":int8") for k in dec.params)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int64)
+    expect = dec.generate(ids, max_new_tokens=6)
+
+    bdir = str(tmp_path / "int8_bundle")
+    export_decoder_bundle(dec, bdir, prompt_lens=[8], decode_steps=[5],
+                          batch_sizes=[2])
+    import json
+    with open(bdir + "/bundle.json") as f:
+        assert json.load(f)["weight_dtype"] == "int8"
+    np.save(tmp_path / "ids.npy", ids)
+    np.save(tmp_path / "expect.npy", expect)
+
+    code = textwrap.dedent(f"""
+        import numpy as np
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.inference import Config, create_predictor
+        cfg = Config()
+        cfg.set_aot_bundle({bdir!r})
+        pred = create_predictor(cfg)
+        ids = np.load({str(tmp_path / 'ids.npy')!r})
+        out = pred.generate(ids, max_new_tokens=6)
+        np.testing.assert_array_equal(
+            out, np.load({str(tmp_path / 'expect.npy')!r}))
+        print("INT8_GENERATE_OK")
+    """)
+    assert "INT8_GENERATE_OK" in _run_fresh(code)
+
+
+def test_predictor_ergonomics_padding_warmup_memory(tmp_path):
+    """Round-5 VERDICT item 8: nearest-bucket batch padding (a batch of 3
+    served against a B=8 bucket, outputs trimmed), warmup-on-load, input
+    dtype coercion, and memory reporting."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference import (AotPredictor, Config,
+                                      create_predictor,
+                                      export_predict_bundle)
+
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x8 = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    bdir = str(tmp_path / "ergo_bundle")
+    export_predict_bundle(net, [x8], bdir, input_names=["x"],
+                          output_names=["y"])
+
+    cfg = Config()
+    cfg.set_aot_bundle(bdir)
+    cfg.enable_warmup()
+    pred = create_predictor(cfg)
+
+    # batch 3 against the B=8 bucket: padded up, trimmed back, correct
+    x3 = x8[:3]
+    out = pred._aot.run({"x": x3})
+    ref = net(paddle.to_tensor(x3)).numpy()
+    np.testing.assert_allclose(out["y"], ref, rtol=1e-5, atol=1e-6)
+    assert out["y"].shape == (3, 2)
+    assert pred._aot.padded_calls == 1
+
+    # dtype coercion: float64 feed serves against the float32 bucket
+    out64 = pred._aot.run({"x": x8.astype(np.float64)})
+    np.testing.assert_allclose(out64["y"],
+                               net(paddle.to_tensor(x8)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    # memory report sizes the artifact
+    rep = pred.memory_report()
+    assert rep["artifact_bytes"] > 0
+    assert all(v > 0 for v in rep["entries_bytes"].values())
+
+    # a shape that can't pad (different feature dim) still errors clearly
+    with pytest.raises(ValueError, match="no shape bucket"):
+        pred._aot.run({"x": np.zeros((3, 5), np.float32)})
+
+
+def test_decoder_generate_batch_padding(tmp_path):
+    """generate() with a smaller batch than any bucket pads the prompt
+    rows and trims the result — per-row outputs must equal the full-batch
+    serve of the same rows (greedy decode rows are independent)."""
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=32)
+    paddle.seed(5)
+    model = LlamaForCausalLM(cfg)
+    dec = LlamaDecoder(model, max_len=32)
+    bdir = str(tmp_path / "pad_bundle")
+    export_decoder_bundle(dec, bdir, prompt_lens=[4], decode_steps=[4],
+                          batch_sizes=[8])
+    pred = AotPredictor(bdir)
+    rng = np.random.default_rng(3)
+    ids8 = rng.integers(0, cfg.vocab_size, (8, 4)).astype(np.int64)
+    full = pred.generate(ids8, max_new_tokens=4)
+    out3 = pred.generate(ids8[:3], max_new_tokens=4)
+    assert out3.shape == (3, 8)
+    np.testing.assert_array_equal(out3, full[:3])
+    assert pred.padded_calls == 1
+
+
 def test_decoder_bundle_multi_batch_and_limits(tmp_path):
     """Review fixes: every exported batch size is servable (per-B cache
     metadata), max_len overflow raises, and eos via the predictor raises
@@ -152,8 +274,14 @@ def test_decoder_bundle_multi_batch_and_limits(tmp_path):
             out, dec.generate(ids, max_new_tokens=5))
     with pytest.raises(ValueError, match="max_len"):
         pred.generate(np.zeros((1, 4), np.int64), max_new_tokens=40)
+    # B=2 between the exported 1 and 3: round 5 pads to the B=3 bucket
+    ids2 = rng.integers(0, 64, (2, 4)).astype(np.int64)
+    np.testing.assert_array_equal(
+        pred.generate(ids2, max_new_tokens=5),
+        dec.generate(ids2, max_new_tokens=5))
+    # a prompt length with no bucket still errors clearly
     with pytest.raises(ValueError, match="prefill bucket"):
-        pred.generate(np.zeros((2, 4), np.int64), max_new_tokens=5)
+        pred.generate(np.zeros((1, 6), np.int64), max_new_tokens=5)
     c = Config()
     c.set_aot_bundle(bdir)
     p = create_predictor(c)

@@ -101,6 +101,57 @@ def test_quantization_ptq_flow():
     assert np.abs(qout - ref).max() < 0.2 * np.abs(ref).max() + 0.1
 
 
+def test_quantization_observers():
+    """Round-5 VERDICT item 6: EMA / Histogram / KL observers beyond
+    abs-max (reference: python/paddle/quantization/observers/ + the
+    PTQ calibration algorithm family)."""
+    import paddle_tpu.quantization as Q
+
+    rng = np.random.default_rng(0)
+    qmax = 127.0
+
+    # EMA: smooths a one-batch outlier that pins AbsmaxObserver forever
+    ema, amax = Q.EMAObserver(momentum=0.5), Q.AbsmaxObserver()
+    for v in [1.0, 1.0, 100.0, 1.0, 1.0, 1.0]:
+        arr = paddle.to_tensor(np.array([v], np.float32))
+        ema.observe(arr)
+        amax.observe(arr)
+    assert amax.scale() == pytest.approx(100.0 / qmax)
+    assert ema.scale() < 0.2 * amax.scale()
+
+    # Histogram percentile: long-tailed data clips the tail
+    h = Q.HistogramObserver(percent=0.99)
+    data = rng.normal(0, 1, 100_000).astype(np.float32)
+    data[:10] *= 100.0                       # 10 extreme outliers
+    h.observe(paddle.to_tensor(data))
+    assert h.scale() < 0.1 * (float(np.abs(data).max()) / qmax)
+    # range widening across batches keeps earlier mass
+    h2 = Q.HistogramObserver(percent=1.0)
+    h2.observe(paddle.to_tensor(np.ones(100, np.float32)))
+    h2.observe(paddle.to_tensor(np.full(100, 2.0, np.float32)))
+    assert h2._hist.sum() == pytest.approx(200.0)
+    assert h2.scale() == pytest.approx(2.0 / qmax, rel=1e-2)
+
+    # KL: threshold lands between the gaussian bulk and the outlier tail
+    kl = Q.KLObserver()
+    kl.observe(paddle.to_tensor(data))
+    t = kl._threshold()
+    assert 1.0 < t < 50.0
+
+    # observers drop into the PTQ config (activation quantizer slot)
+    from paddle_tpu.quantization import PTQ, QuantConfig
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = PTQ(QuantConfig(activation=lambda: Q.EMAObserver()))
+    qnet = q.quantize(net)
+    x = paddle.to_tensor(rng.random((4, 8)).astype(np.float32))
+    ref = net(x).numpy()
+    for _ in range(3):
+        qnet(x)
+    q.convert(qnet)
+    out = qnet(x).numpy()
+    assert np.abs(out - ref).max() < 0.2 * np.abs(ref).max() + 0.1
+
+
 def test_asp_24_sparsity():
     from paddle_tpu.incubate import asp
     net = nn.Linear(8, 6)
