@@ -32,6 +32,7 @@ class Config:
         self._aot_dir = None
         self._warmup = False
         self._cast_inputs = True
+        self._bucket_padding = True
 
     def enable_warmup(self, flag: bool = True):
         """Execute every AOT entry once at load (first request pays no

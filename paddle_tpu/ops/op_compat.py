@@ -194,19 +194,16 @@ OP_COMPAT: Dict[str, str] = {
     "data": "static.data",
     "embedding_grad_dense": "=jax AD produces the dense embedding "
                             "gradient (vjp of gather); no separate op",
-    # ---- vision tail ----
-    "generate_proposals": "~RPN proposal generation not built; the "
-                          "detection zoo beyond nms/roi_align/yolo_box "
-                          "lives in PaddleDetection externally too",
+    # ---- vision tail (detection training landed round 5) ----
+    "generate_proposals": "vision.ops.generate_proposals",
     "matrix_nms": "vision.ops.matrix_nms",
-    "multiclass_nms3": "~see generate_proposals (single-class nms IS "
-                       "built: vision.ops.nms)",
-
+    "multiclass_nms3": "vision.ops.multiclass_nms3",
     "detection_map": "~mAP evaluation is host-side metric code in every "
                      "ecosystem (pycocotools); not an op",
-    "yolo_box_head": "~yolo_box IS built (vision.ops.yolo_box); the "
-                     "fused head/loss training kernels are not",
-    "yolo_loss": "~see yolo_box_head",
+    "yolo_box_head": "=yolo_box (inference decode) + yolo_loss (training) "
+                     "cover the capability; the reference's fused "
+                     "head-op variant is a kernel-fusion detail",
+    "yolo_loss": "vision.ops.yolo_loss",
     "crf_decoding": "text.viterbi_decode",
     # ---- graph sampling ----
     "graph_khop_sampler": "geometric.khop_sampler",

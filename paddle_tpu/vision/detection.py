@@ -1,0 +1,321 @@
+"""Detection training tail (round-5 VERDICT item 7).
+
+Capability analogs of the reference's RPN / YOLO training ops:
+- generate_proposals: paddle/phi/kernels/gpu/generate_proposals_kernel.cu
+- multiclass_nms3:    paddle/phi/kernels/gpu/multiclass_nms3_kernel.cu
+- yolo_loss:          paddle/phi/kernels/impl/yolo_loss_kernel_impl.h
+
+TPU-native split: the *differentiable* training math (yolo_loss) is pure
+jnp — target assignment is a static-shape scatter, every loss term an
+XLA fusion, gradients flow to the prediction map. The *selection* ops
+(proposal generation, multiclass NMS) are data-dependent-size by nature;
+like the host-side metric code of every ecosystem they run eagerly over
+concrete arrays (the rulebook pattern: host selects, device computes) —
+their consumers (roi_align, heads) are device ops again.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops.registry import register_op
+
+__all__ = ["generate_proposals", "multiclass_nms3", "yolo_loss"]
+
+
+def _np(x):
+    return np.asarray(x.value if isinstance(x, Tensor) else x)
+
+
+def _nms_np(boxes: np.ndarray, scores: np.ndarray, thresh: float,
+            top_k: Optional[int] = None, offset: float = 0.0,
+            eta: float = 1.0) -> np.ndarray:
+    """Greedy NMS over concrete arrays; returns kept indices (desc score).
+    ``eta < 1`` is the reference's adaptive NMS: after each kept box the
+    threshold decays (``thresh *= eta`` while thresh > 0.5)."""
+    order = np.argsort(-scores, kind="stable")
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = np.maximum(x2 - x1 + offset, 0) * np.maximum(y2 - y1 + offset, 0)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if top_k is not None and len(keep) >= top_k:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(x1[i], x1[rest])
+        yy1 = np.maximum(y1[i], y1[rest])
+        xx2 = np.minimum(x2[i], x2[rest])
+        yy2 = np.minimum(y2[i], y2[rest])
+        inter = np.maximum(xx2 - xx1 + offset, 0) * \
+            np.maximum(yy2 - yy1 + offset, 0)
+        iou = inter / np.maximum(areas[i] + areas[rest] - inter, 1e-10)
+        order = rest[iou <= thresh]
+        if eta < 1.0 and thresh > 0.5:
+            thresh *= eta
+    return np.asarray(keep, np.int64)
+
+
+@register_op("generate_proposals", differentiable=False,
+             ref="paddle/phi/kernels/gpu/generate_proposals_kernel.cu",
+             n_outputs=3)
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n: int = 6000,
+                       post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0, pixel_offset: bool = True,
+                       return_rois_num: bool = True):
+    """RPN proposal generation.
+
+    scores (N, A, H, W); bbox_deltas (N, 4A, H, W); img_size (N, 2) as
+    (h, w); anchors/variances (H, W, A, 4) or (H*W*A, 4). Per image:
+    top-``pre_nms_top_n`` scores -> center-size delta decode (variances
+    folded in, dw/dh clipped at log(1000/16)) -> clip to image -> drop
+    boxes under ``min_size`` -> NMS at ``nms_thresh`` -> top
+    ``post_nms_top_n``. Returns (rois (R,4), roi_probs (R,1),
+    rois_num (N,)).
+    """
+    sc = _np(scores)
+    dl = _np(bbox_deltas)
+    im = _np(img_size)
+    an = _np(anchors).reshape(-1, 4).astype(np.float64)
+    va = _np(variances).reshape(-1, 4).astype(np.float64)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    log_max = np.log(1000.0 / 16.0)
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(N):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)           # (H, W, A)
+        d = dl[i].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4).astype(np.float64)
+        k = min(pre_nms_top_n, s.size)
+        top = np.argsort(-s, kind="stable")[:k]
+        s_t, d_t, an_t, va_t = s[top], d[top], an[top], va[top]
+
+        aw = an_t[:, 2] - an_t[:, 0] + off
+        ah = an_t[:, 3] - an_t[:, 1] + off
+        acx = an_t[:, 0] + 0.5 * aw
+        acy = an_t[:, 1] + 0.5 * ah
+        cx = va_t[:, 0] * d_t[:, 0] * aw + acx
+        cy = va_t[:, 1] * d_t[:, 1] * ah + acy
+        w = np.exp(np.minimum(va_t[:, 2] * d_t[:, 2], log_max)) * aw
+        h = np.exp(np.minimum(va_t[:, 3] * d_t[:, 3], log_max)) * ah
+        boxes = np.stack([cx - 0.5 * w, cy - 0.5 * h,
+                          cx + 0.5 * w - off, cy + 0.5 * h - off], axis=1)
+        ih, iw = float(im[i][0]), float(im[i][1])
+        boxes[:, 0] = np.clip(boxes[:, 0], 0, iw - off)
+        boxes[:, 1] = np.clip(boxes[:, 1], 0, ih - off)
+        boxes[:, 2] = np.clip(boxes[:, 2], 0, iw - off)
+        boxes[:, 3] = np.clip(boxes[:, 3], 0, ih - off)
+        bw = boxes[:, 2] - boxes[:, 0] + off
+        bh = boxes[:, 3] - boxes[:, 1] + off
+        ok = (bw >= max(min_size, 1.0)) & (bh >= max(min_size, 1.0))
+        boxes, s_t = boxes[ok], s_t[ok]
+        if boxes.shape[0]:
+            keep = _nms_np(boxes, s_t, nms_thresh, top_k=post_nms_top_n,
+                           offset=off, eta=eta)
+            boxes, s_t = boxes[keep], s_t[keep]
+        all_rois.append(boxes.astype(np.float32))
+        all_probs.append(s_t.astype(np.float32)[:, None])
+        nums.append(boxes.shape[0])
+    rois = np.concatenate(all_rois, 0) if all_rois else \
+        np.zeros((0, 4), np.float32)
+    probs = np.concatenate(all_probs, 0) if all_probs else \
+        np.zeros((0, 1), np.float32)
+    return (jnp.asarray(rois), jnp.asarray(probs),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+@register_op("multiclass_nms3", differentiable=False,
+             ref="paddle/phi/kernels/gpu/multiclass_nms3_kernel.cu",
+             n_outputs=3)
+def multiclass_nms3(bboxes, scores, rois_num=None,
+                    score_threshold: float = 0.05, nms_top_k: int = 1000,
+                    keep_top_k: int = 100, nms_threshold: float = 0.3,
+                    normalized: bool = True, nms_eta: float = 1.0,
+                    background_label: int = -1, return_index: bool = False):
+    """Per-class NMS + cross-class top-k (the detection-head decoder).
+
+    bboxes (N, M, 4); scores (N, C, M). Per image and per class (skipping
+    ``background_label``): score filter -> top ``nms_top_k`` -> NMS ->
+    merge classes, sort by score, keep ``keep_top_k``. Returns
+    (out (R, 6) as [label, score, x1, y1, x2, y2], index (R, 1) into the
+    flattened (N*M) box list, nms_rois_num (N,)).
+    """
+    bx = _np(bboxes)
+    sc = _np(scores)
+    N, M = bx.shape[0], bx.shape[1]
+    C = sc.shape[1]
+    off = 0.0 if normalized else 1.0
+    outs, idxs, nums = [], [], []
+    for i in range(N):
+        dets = []          # (label, score, box, flat_index)
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[i, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[sel], kind="stable")][:nms_top_k]
+            keep = _nms_np(bx[i][order], s[order], nms_threshold,
+                           offset=off, eta=nms_eta)
+            for j in order[keep]:
+                dets.append((c, s[j], bx[i][j], i * M + j))
+        dets.sort(key=lambda t: -t[1])
+        if keep_top_k >= 0:
+            dets = dets[:keep_top_k]
+        for c, s_, b, fi in dets:
+            outs.append(np.concatenate([[np.float32(c), np.float32(s_)],
+                                        b.astype(np.float32)]))
+            idxs.append(fi)
+        nums.append(len(dets))
+    out = np.stack(outs, 0) if outs else np.zeros((0, 6), np.float32)
+    index = np.asarray(idxs, np.int64)[:, None] if idxs else \
+        np.zeros((0, 1), np.int64)
+    return (jnp.asarray(out), jnp.asarray(index),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+@register_op("yolo_loss",
+             ref="paddle/phi/kernels/impl/yolo_loss_kernel_impl.h")
+def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
+              anchor_mask: Sequence[int], class_num: int,
+              ignore_thresh: float, downsample_ratio: int,
+              gt_score=None, use_label_smooth: bool = True,
+              scale_x_y: float = 1.0):
+    """YOLOv3 training loss — fully differentiable jnp (the genuinely
+    missing capability behind the r4 absences: yolo_box covered inference
+    only).
+
+    x (N, A*(5+C), H, W) raw predictions for the ``anchor_mask`` anchors;
+    gt_box (N, B, 4) as center-x, center-y, w, h in [0, 1] image-relative
+    units (zero rows = padding); gt_label (N, B) ints; ``anchors`` the
+    FULL flat (w0, h0, w1, h1, ...) list, ``anchor_mask`` this head's
+    indices into it. Per YOLOv3: each gt is assigned to the anchor with
+    best shape-IoU over ALL anchors; only gts whose best anchor is in
+    this head's mask produce positives here. Loss terms: sigmoid-CE on
+    the cell offsets, L1 on log-scales (both weighted 2 - gw*gh),
+    sigmoid-CE objectness where negatives whose decoded box overlaps any
+    gt above ``ignore_thresh`` are ignored, sigmoid-CE classification
+    (optional label smoothing with delta = 1/class_num). Returns (N,).
+    """
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = np.asarray(anchor_mask, np.int64)
+    Am = len(mask)
+    xv = x
+    N, _, H, W = xv.shape
+    C = class_num
+    in_w = W * downsample_ratio
+    in_h = H * downsample_ratio
+
+    p = jnp.reshape(xv, (N, Am, 5 + C, H, W))
+    px, py = p[:, :, 0], p[:, :, 1]            # (N, Am, H, W)
+    pw, ph = p[:, :, 2], p[:, :, 3]
+    pobj = p[:, :, 4]
+    pcls = p[:, :, 5:]                         # (N, Am, C, H, W)
+
+    gb = gt_box
+    gl = gt_label.astype(jnp.int32)
+    B = gb.shape[1]
+    gs = (jnp.ones((N, B), jnp.float32) if gt_score is None
+          else gt_score.astype(jnp.float32))
+    valid = gb[:, :, 2] > 0                    # (N, B) padded rows excluded
+
+    # best anchor per gt by shape IoU (both centered at origin)
+    gw = gb[:, :, 2] * in_w                    # gt w in pixels
+    gh = gb[:, :, 3] * in_h
+    aw = jnp.asarray(anchors[:, 0])            # (Atot,)
+    ah = jnp.asarray(anchors[:, 1])
+    inter = jnp.minimum(gw[:, :, None], aw) * jnp.minimum(gh[:, :, None], ah)
+    union = gw[:, :, None] * gh[:, :, None] + aw * ah - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # (N, B)
+    # position of the best anchor inside this head's mask (-1 = not ours)
+    mask_pos = jnp.full((len(anchors),), -1, jnp.int32)
+    mask_pos = mask_pos.at[jnp.asarray(mask)].set(
+        jnp.arange(Am, dtype=jnp.int32))
+    k = mask_pos[best]                         # (N, B)
+    ours = valid & (k >= 0)
+
+    gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+    kk = jnp.maximum(k, 0)
+
+    bidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    sel = (bidx, kk, gj, gi)
+
+    # scatter gt targets onto the prediction grid; weight 0 where not ours
+    wgt = jnp.where(ours, gs * (2.0 - gb[:, :, 2] * gb[:, :, 3]), 0.0)
+    tx = gb[:, :, 0] * W - gi
+    ty = gb[:, :, 1] * H - gj
+    ma = jnp.asarray(anchors[mask])            # (Am, 2) this head's anchors
+    tw = jnp.log(jnp.maximum(gw, 1e-9) / jnp.maximum(ma[kk][:, :, 0], 1e-9))
+    th = jnp.log(jnp.maximum(gh, 1e-9) / jnp.maximum(ma[kk][:, :, 1], 1e-9))
+
+    def sce(logit, target):
+        # sigmoid cross entropy, numerically stable
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    zeros = jnp.zeros((N, Am, H, W), jnp.float32)
+    obj_t = zeros.at[sel].max(jnp.where(ours, 1.0, 0.0))
+    obj_w = zeros.at[sel].max(jnp.where(ours, gs, 0.0))
+
+    # coordinate/size losses gathered at assigned cells (per-gt)
+    lx = sce(px[sel], tx) + sce(py[sel], ty)
+    lwh = jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)
+    loss_box = jnp.sum(wgt * (lx + lwh), axis=1)
+
+    # classification at assigned cells
+    delta = 1.0 / C if (use_label_smooth and C > 1) else 0.0
+    onehot = jax.nn.one_hot(gl, C)             # (N, B, C)
+    tcls = onehot * (1.0 - delta) + delta * (1.0 - onehot) \
+        if delta else onehot
+    pc = jnp.moveaxis(pcls, 2, -1)[sel]        # (N, B, C)
+    loss_cls = jnp.sum(jnp.where(ours, gs, 0.0)[:, :, None]
+                       * sce(pc, tcls), axis=(1, 2))
+
+    # objectness: decode all predictions, ignore negatives overlapping a
+    # gt above ignore_thresh
+    cell_x = jnp.arange(W, dtype=jnp.float32)
+    cell_y = jnp.arange(H, dtype=jnp.float32)
+    bx = (jax.nn.sigmoid(px) + cell_x[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(py) + cell_y[None, None, :, None]) / H
+    bw = jnp.exp(jnp.clip(pw, -20, 20)) * ma[:, 0][None, :, None, None] \
+        / in_w
+    bh = jnp.exp(jnp.clip(ph, -20, 20)) * ma[:, 1][None, :, None, None] \
+        / in_h
+    # IoU of every pred box vs every gt (relative units)
+    px1, px2 = bx - bw / 2, bx + bw / 2
+    py1, py2 = by - bh / 2, by + bh / 2
+    gx1 = gb[:, :, 0] - gb[:, :, 2] / 2
+    gx2 = gb[:, :, 0] + gb[:, :, 2] / 2
+    gy1 = gb[:, :, 1] - gb[:, :, 3] / 2
+    gy2 = gb[:, :, 1] + gb[:, :, 3] / 2
+
+    def iou_vs_gt(b):
+        # b: index into B; broadcast one gt against the full grid
+        ix1 = jnp.maximum(px1, gx1[:, b][:, None, None, None])
+        ix2 = jnp.minimum(px2, gx2[:, b][:, None, None, None])
+        iy1 = jnp.maximum(py1, gy1[:, b][:, None, None, None])
+        iy2 = jnp.minimum(py2, gy2[:, b][:, None, None, None])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        ga = (gx2[:, b] - gx1[:, b]) * (gy2[:, b] - gy1[:, b])
+        pa = bw * bh
+        i = inter / jnp.maximum(pa + ga[:, None, None, None] - inter, 1e-10)
+        return jnp.where(valid[:, b][:, None, None, None], i, 0.0)
+
+    best_iou = zeros
+    for b in range(B):
+        best_iou = jnp.maximum(best_iou, iou_vs_gt(b))
+    noobj_mask = (best_iou <= ignore_thresh).astype(jnp.float32)
+    obj_losses = sce(pobj, obj_t)
+    loss_obj = jnp.sum(jnp.where(obj_t > 0, obj_w * obj_losses,
+                                 noobj_mask * obj_losses), axis=(1, 2, 3))
+    return loss_box + loss_cls + loss_obj

@@ -636,3 +636,11 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     idx_t = Tensor(jnp.asarray(_np.asarray(all_idx, _np.int32))) \
         if return_index else None
     return out_t, rois_t, idx_t
+
+
+# detection training tail (round 5): RPN proposals, multiclass NMS,
+# differentiable YOLOv3 loss — see vision/detection.py
+from paddle_tpu.vision.detection import (  # noqa: E402,F401
+    generate_proposals, multiclass_nms3, yolo_loss,
+)
+__all__ += ["generate_proposals", "multiclass_nms3", "yolo_loss"]

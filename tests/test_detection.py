@@ -1,0 +1,235 @@
+"""Detection training tail (round-5 VERDICT item 7).
+
+Parity targets: paddle/phi/kernels/gpu/generate_proposals_kernel.cu,
+multiclass_nms3_kernel.cu, and the differentiable YOLOv3 loss
+(yolo_loss_kernel_impl.h). The RPN-style toy training test is the
+round-5 done-criterion: a proposal pipeline whose score/delta heads are
+TRAINED through the framework's autograd."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _grid_anchors(H, W, sizes=(8, 16, 24), stride=16):
+    A = len(sizes)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            for a, s in enumerate(sizes):
+                cx, cy = x * stride, y * stride
+                anchors[y, x, a] = [cx - s / 2, cy - s / 2,
+                                    cx + s / 2, cy + s / 2]
+    return anchors
+
+
+def test_generate_proposals_decode_and_counts():
+    """Zero deltas with unit variances decode to the anchors themselves
+    (clipped); top-1 proposal is the highest-scoring anchor box."""
+    H = W = 4
+    anchors = _grid_anchors(H, W)
+    A = anchors.shape[2]
+    scores = np.full((1, A, H, W), -5.0, np.float32)
+    scores[0, 1, 2, 3] = 3.0                 # anchor a=1 at cell (y=2, x=3)
+    deltas = np.zeros((1, 4 * A, H, W), np.float32)
+    rois, probs, num = vops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[64.0, 64.0]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(np.ones_like(anchors)),
+        pre_nms_top_n=10, post_nms_top_n=4, nms_thresh=0.7, min_size=1.0)
+    assert int(num.numpy()[0]) == 4
+    top = rois.numpy()[0]
+    # zero deltas with unit variances decode to exactly the anchor
+    np.testing.assert_allclose(top, anchors[2, 3, 1], atol=1e-4)
+    assert probs.shape == (4, 1)
+    # shifted deltas move the box: dx=+1 with variance 1 moves by anchor w
+    deltas2 = deltas.copy()
+    deltas2[0, 4 * 1 + 0, 2, 3] = 0.5        # a=1, dx channel
+    rois2, _, _ = vops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas2),
+        paddle.to_tensor(np.array([[64.0, 64.0]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(np.ones_like(anchors)),
+        pre_nms_top_n=10, post_nms_top_n=4, nms_thresh=0.7, min_size=1.0)
+    aw = 16 + 1.0                            # anchor w with pixel offset
+    np.testing.assert_allclose(rois2.numpy()[0][0] - top[0], 0.5 * aw,
+                               atol=1e-3)
+
+
+def test_multiclass_nms3():
+    bx = paddle.to_tensor(np.array(
+        [[[0, 0, 10, 10], [0.5, 0.5, 10, 10], [20, 20, 30, 30]]],
+        np.float32))
+    sc = paddle.to_tensor(np.array(
+        [[[0.9, 0.85, 0.1], [0.2, 0.1, 0.8]]], np.float32))
+    out, idx, num = vops.multiclass_nms3(bx, sc, score_threshold=0.3,
+                                         nms_threshold=0.5)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == 2
+    # highest score first; the near-duplicate class-0 box was suppressed
+    assert o[0][0] == 0 and o[0][1] == pytest.approx(0.9)
+    assert o[1][0] == 1 and o[1][1] == pytest.approx(0.8)
+    np.testing.assert_array_equal(idx.numpy()[:, 0], [0, 2])
+    # keep_top_k truncates across classes
+    out2, _, num2 = vops.multiclass_nms3(bx, sc, score_threshold=0.3,
+                                         nms_threshold=0.5, keep_top_k=1)
+    assert int(num2.numpy()[0]) == 1 and out2.numpy()[0][1] == \
+        pytest.approx(0.9)
+
+
+def _yolo_case(rng, N=2, H=4, W=4, C=3, B=2):
+    anchors = [8, 8, 16, 16, 32, 32]
+    mask = [0, 1, 2]
+    A = len(mask)
+    x = rng.normal(size=(N, A * (5 + C), H, W)).astype(np.float32)
+    gt = np.zeros((N, B, 4), np.float32)
+    gl = np.zeros((N, B), np.int64)
+    gt[0, 0] = [0.4, 0.4, 0.25, 0.25]        # 16px box -> anchor 1
+    gl[0, 0] = 1
+    gt[1, 0] = [0.7, 0.2, 0.5, 0.5]          # 32px box -> anchor 2
+    gl[1, 0] = 2
+    return x, gt, gl, anchors, mask, C
+
+
+def test_yolo_loss_prefers_correct_predictions():
+    """Loss at the ideal prediction map is far below a random map, and
+    gradients flow to the predictions (the training capability)."""
+    rng = np.random.default_rng(0)
+    x, gt, gl, anchors, mask, C = _yolo_case(rng)
+    N, _, H, W = x.shape
+    A = len(mask)
+
+    # construct near-ideal predictions for image 0's gt
+    ideal = np.full_like(x, -8.0)            # sigmoid ~ 0 everywhere
+    ideal[:, 2::(5 + C)] = 0.0               # tw
+    ideal[:, 3::(5 + C)] = 0.0               # th
+    p = ideal.reshape(N, A, 5 + C, H, W)
+    gi, gj = int(0.4 * W), int(0.4 * H)
+    # anchor 1 (16px) matches the 0.25*64=16px gt
+    p[0, 1, 0, gj, gi] = 0.0                 # tx: sigmoid 0.5 vs 0.6 off
+    p[0, 1, 1, gj, gi] = 0.0
+    p[0, 1, 2, gj, gi] = 0.0                 # tw: log(16/16)=0
+    p[0, 1, 3, gj, gi] = 0.0
+    p[0, 1, 4, gj, gi] = 8.0                 # objectness ~1
+    p[0, 1, 5 + 1, gj, gi] = 8.0             # class 1
+    gi2, gj2 = int(0.7 * W), int(0.2 * H)
+    p[1, 2, 4, gj2, gi2] = 8.0
+    p[1, 2, 5 + 2, gj2, gi2] = 8.0
+
+    def loss_of(arr):
+        t = paddle.to_tensor(arr)
+        t.stop_gradient = False
+        l = paddle.sum(vops.yolo_loss(
+            t, paddle.to_tensor(gt), paddle.to_tensor(gl), anchors, mask,
+            C, ignore_thresh=0.7, downsample_ratio=16,
+            use_label_smooth=False))
+        return t, l
+
+    _, l_good = loss_of(ideal)
+    _, l_bad = loss_of(x)
+    assert float(l_good) < 0.5 * float(l_bad)
+
+    t, l = loss_of(x)
+    l.backward()
+    g = t.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_yolo_loss_ignore_thresh():
+    """A confident prediction overlapping a gt above ignore_thresh must
+    NOT be punished as a negative: its objectness logit change should
+    not move the loss the way a far-away box's does."""
+    rng = np.random.default_rng(1)
+    x, gt, gl, anchors, mask, C = _yolo_case(rng)
+    x = np.zeros_like(x)
+    N, _, H, W = x.shape
+    A = len(mask)
+    gi, gj = int(0.4 * W), int(0.4 * H)
+
+    def total(arr, thr):
+        return float(paddle.sum(vops.yolo_loss(
+            paddle.to_tensor(arr), paddle.to_tensor(gt),
+            paddle.to_tensor(gl), anchors, mask, C, ignore_thresh=thr,
+            downsample_ratio=16, use_label_smooth=False)))
+
+    # raise objectness of the anchor-0 box at the SAME cell as the gt
+    # (high overlap with the 16px gt: iou(8px, centered) ~ 0.25): with
+    # thr=0.2 it's ignored; with thr=0.9 it's a negative and adds loss
+    bump = x.copy()
+    bump_view = bump.reshape(N, A, 5 + C, H, W)
+    bump_view[0, 0, 4, gj, gi] = 6.0
+    base_ignore = total(x, 0.2)
+    base_strict = total(x, 0.9)
+    d_ignore = total(bump, 0.2) - base_ignore
+    d_strict = total(bump, 0.9) - base_strict
+    assert d_strict > d_ignore + 1.0
+
+
+def test_rpn_toy_trains():
+    """VERDICT done-criterion: an RPN-style toy — conv trunk with score +
+    delta heads trained so generate_proposals recovers a planted box."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(7)                   # layer inits: order-independent runs
+    rng = np.random.default_rng(2)
+    H = W = 4
+    anchors = _grid_anchors(H, W)
+    A = anchors.shape[2]
+    img = rng.normal(size=(1, 3, 64, 64)).astype(np.float32) * 0.1
+    img[0, :, 24:40, 40:56] += 2.0           # object at cell (2, 3), 16px
+
+    trunk = nn.Sequential(nn.Conv2D(3, 8, 16, stride=16),
+                          nn.LeakyReLU(negative_slope=0.1))  # no dead units
+    score_head = nn.Conv2D(8, A, 1)
+    delta_head = nn.Conv2D(8, 4 * A, 1)
+    params = (list(trunk.parameters()) + list(score_head.parameters())
+              + list(delta_head.parameters()))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+
+    # target: anchor a=1 (16px) at cell (y=2, x=3) positive, all else neg
+    tgt = np.full((1, A, H, W), 0.0, np.float32)
+    tgt[0, 1, 2, 3] = 1.0
+    t_tgt = paddle.to_tensor(tgt)
+    xb = paddle.to_tensor(img)
+    first = None
+    for step in range(200):
+        feat = trunk(xb)
+        s = score_head(feat)
+        d = delta_head(feat)
+        # RPN loss: BCE on scores (positive cell weighted up against the
+        # 47 negatives, the standard RPN sampling re-balance) + L1
+        # pulling deltas to zero at the pos
+        bce = paddle.nn.functional.binary_cross_entropy_with_logits(
+            s, t_tgt, reduction="none")
+        bce = paddle.mean(bce * (1.0 + 47.0 * t_tgt))
+        l1 = paddle.mean(paddle.abs(d))
+        loss = bce + 5.0 * l1
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+    assert float(loss) < 0.5 * first
+
+    rois, probs, num = vops.generate_proposals(
+        score_head(trunk(xb)), delta_head(trunk(xb)),
+        paddle.to_tensor(np.array([[64.0, 64.0]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(np.ones_like(anchors)),
+        pre_nms_top_n=20, post_nms_top_n=3, nms_thresh=0.7, min_size=1.0)
+    top = rois.numpy()[0]
+    # the top proposal recovers the planted 16px anchor at cell (2, 3)
+    np.testing.assert_allclose(top, anchors[2, 3, 1], atol=4.0)
+
+
+def test_opcompat_absences_shrunk():
+    """The audit's absence count is <= 4 and the three detection ops now
+    resolve (OP_COMPAT_AUDIT regeneration target)."""
+    from paddle_tpu.ops.op_compat import audit
+    a = audit()
+    if not a:
+        pytest.skip("reference yaml not available")
+    absences = [n for n, (t, _) in a.items() if t == "absent"]
+    assert len(absences) <= 4, absences
+    for op in ("generate_proposals", "multiclass_nms3", "yolo_loss"):
+        assert a[op][0] in ("same-name", "alias"), (op, a[op])
