@@ -9,11 +9,16 @@ elementwise/reduce fusions making 4-5 HBM passes each. This kernel does
 one read + one write per direction, f32 statistics in VMEM, and folds
 the SiLU (and its backward) into the same pass.
 
-Layout: x is channels-first (B, C, *spatial), flattened to rows of
-(B*C, HW). One grid program handles one (batch, group) block of
-(C/G, HW) rows — stats reduce over the whole block, the per-channel
-affine rides the sublane dim. HW must be a lane multiple (128) on real
-TPU; the 8x8-latent UNet level (HW=64) falls back to XLA.
+Layout (round 5): 4D conv maps (B, C, H, W) are consumed NATIVELY —
+the only pre-kernel reshape is the leading-dim split (B, C, ...) ->
+(B*G, C/G, ...), which preserves the (H, W) tiling, so the kernel reads
+exactly the layout the surrounding convolutions produce. The round-4
+kernel flattened spatial dims to (B*G, C/G, HW), which retiled the
+array (HW lanes vs W lanes) and cost a relayout copy on BOTH sides of
+every norm — the dominant share of the 37 ms/step of copy/reshape
+traffic in the round-4 profile. Full-dim trailing blocks also lift the
+HW %% 128 restriction, so the 8x8-latent level runs the kernel too.
+Non-4D inputs keep the flattened path (HW lane-multiple required).
 """
 
 from __future__ import annotations
@@ -31,24 +36,55 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def supported(x_shape, groups: int) -> bool:
+def _padded_elems(cg: int, spatial) -> int:
+    """VMEM footprint in ELEMENTS of one (cg, *spatial) f32 block: VMEM
+    buffers live in tiled layout, so the minor dim pads to 128 lanes and
+    the second-minor to 8 sublanes."""
+    dims = (cg,) + tuple(spatial)
+    minor = -(-dims[-1] // 128) * 128
+    second = -(-dims[-2] // 8) * 8 if len(dims) >= 2 else 1
+    rest = 1
+    for d in dims[:-2]:
+        rest *= d
+    return rest * second * minor
+
+
+def _layout_for(x_shape, groups: int):
+    """'native4d' (no relayout around the kernel, any H/W), 'flat'
+    (HW lanes; needs HW %% 128), or None (XLA fallback)."""
     if len(x_shape) < 3:
-        return False
+        return None
     c = x_shape[1]
     if c % groups:
-        return False
+        return None
+    cg = c // groups
     hw = 1
     for d in x_shape[2:]:
         hw *= d
-    # VMEM ceiling: each program holds the full (C/G, HW) slab (x, out,
-    # grad in bwd, plus f32 temporaries) — bound the f32 slab at 4MB so
-    # ~4 live copies stay inside ~16MB VMEM; larger groups fall back to
-    # XLA, which handled them before this kernel existed
-    if (c // groups) * hw * 4 > 4 * 1024 * 1024:
-        return False
+    # VMEM ceiling: each program holds the full (C/G, spatial) slab (x,
+    # out, grad in bwd, plus f32 temporaries) — bound the f32 slab at 4MB
+    # so ~4 live copies stay inside ~16MB VMEM. The 4D-native footprint
+    # counts LANE PADDING (W rounds to 128): narrow-W levels whose padded
+    # slab blows the budget fall back to the flattened layout (one
+    # relayout copy each side) rather than to XLA.
+    budget = 4 * 1024 * 1024
     if _use_interpret():
-        return True
-    return hw % 128 == 0
+        # same budget routing as TPU (so CPU tests exercise the same
+        # decisions), minus the lane-multiple requirement on 'flat'
+        if (len(x_shape) == 4
+                and _padded_elems(cg, x_shape[2:]) * 4 <= budget):
+            return "native4d"
+        return "flat" if cg * hw * 4 <= budget else None
+    if (len(x_shape) == 4
+            and _padded_elems(cg, x_shape[2:]) * 4 <= budget):
+        return "native4d"
+    if hw % 128 == 0 and cg * hw * 4 <= budget:
+        return "flat"
+    return None
+
+
+def supported(x_shape, groups: int) -> bool:
+    return _layout_for(x_shape, groups) is not None
 
 
 def _silu_fwd(y):
@@ -60,10 +96,27 @@ def _silu_bwd(z, g):
     return g * (s * (1.0 + z * (1.0 - s)))
 
 
+def _block_shapes(x, groups):
+    """(blocked x, spatial dims tuple) — 4D keeps (H, W) native when the
+    padded block fits VMEM, else flattens (one relayout, still one HBM
+    pass inside the kernel)."""
+    B, C = x.shape[0], x.shape[1]
+    cg = C // groups
+    if x.ndim == 4 and _layout_for(x.shape, groups) == "native4d":
+        spatial = tuple(x.shape[2:])
+    else:
+        spatial = (x.size // (B * C),)
+    return x.reshape((B * groups, cg) + spatial), cg, spatial
+
+
 def _fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref,
                 *, eps, act, out_dtype):
-    xf = x_ref[0].astype(jnp.float32)              # (Cg, HW)
-    m = jnp.mean(xf)
+    xf = x_ref[0].astype(jnp.float32)              # (Cg, *spatial)
+    # pivot-shifted mean: summing (x - x[0]) keeps the accumulation at the
+    # activations' SPREAD scale instead of their absolute scale, so a
+    # 1000±0.01 block loses no mantissa to the offset
+    pivot = xf[(0,) * xf.ndim]
+    m = pivot + jnp.mean(xf - pivot)
     # shifted two-pass variance: E[x²]−m² cancels catastrophically for
     # mean-shifted activations (f32 rounding of E[x²] can exceed the true
     # variance, going negative -> rsqrt NaN); the second pass stays in
@@ -76,49 +129,48 @@ def _fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref,
     if act == "silu":
         y = _silu_fwd(y)
     o_ref[0] = y.astype(out_dtype)
-    # (1,1) vector stores — Mosaic rejects true scalar stores to VMEM
-    mean_ref[0] = jnp.full((1, 1), m, jnp.float32)
-    rstd_ref[0] = jnp.full((1, 1), r, jnp.float32)
+    # full-block vector stores — Mosaic rejects true scalar stores to VMEM
+    mean_ref[0] = jnp.full(mean_ref.shape[1:], m, jnp.float32)
+    rstd_ref[0] = jnp.full(rstd_ref.shape[1:], r, jnp.float32)
 
 
 def gn_fwd(x, w, b, groups: int, eps: float, act=None):
-    """Returns (out, mean, rstd); mean/rstd are (B*G, 1) f32 residuals."""
-    B, C = x.shape[0], x.shape[1]
-    hw = x.size // (B * C)
-    cg = C // groups
-    # 3D blocks: (1, Cg, HW) with the trailing two dims covering the FULL
-    # array dims — Cg is rarely a sublane multiple (e.g. 10 for SD's
-    # C=320, G=32), and Mosaic only allows non-multiple blocks when they
-    # span the whole dimension
-    x3 = x.reshape(B * groups, cg, hw)
+    """Returns (out, mean, rstd); mean/rstd are (B*G, 1...) f32 residuals."""
+    B = x.shape[0]
+    xb, cg, spatial = _block_shapes(x, groups)
+    ones = (1,) * len(spatial)
+    zeros = (0,) * len(spatial)
+    blk = (1, cg) + spatial
     out, mean, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps, act=act, out_dtype=x.dtype),
         grid=(B * groups,),
         in_specs=[
-            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, cg, 1), lambda i, g=groups: (i % g, 0, 0)),
-            pl.BlockSpec((1, cg, 1), lambda i, g=groups: (i % g, 0, 0)),
+            pl.BlockSpec(blk, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, cg) + ones,
+                         lambda i, g=groups: (i % g, 0) + zeros),
+            pl.BlockSpec((1, cg) + ones,
+                         lambda i, g=groups: (i % g, 0) + zeros),
         ],
         out_specs=[
-            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec(blk, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, 1) + ones, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, 1) + ones, lambda i: (i, 0) + zeros),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * groups, cg, hw), x.dtype),
-            jax.ShapeDtypeStruct((B * groups, 1, 1), jnp.float32),
-            jax.ShapeDtypeStruct((B * groups, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * groups, cg) + spatial, x.dtype),
+            jax.ShapeDtypeStruct((B * groups, 1) + ones, jnp.float32),
+            jax.ShapeDtypeStruct((B * groups, 1) + ones, jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(x3, w.reshape(groups, cg, 1), b.reshape(groups, cg, 1))
+    )(xb, w.reshape((groups, cg) + ones), b.reshape((groups, cg) + ones))
     return out.reshape(x.shape), mean, rstd
 
 
 def _bwd_kernel(x_ref, w_ref, b_ref, mean_ref, rstd_ref, g_ref,
                 dx_ref, dwp_ref, dbp_ref, *, act, x_dtype):
-    xf = x_ref[0].astype(jnp.float32)
-    m = mean_ref[0, 0, 0]
-    r = rstd_ref[0, 0, 0]
+    xf = x_ref[0].astype(jnp.float32)              # (Cg, *spatial)
+    m = mean_ref[tuple([0] * mean_ref.ndim)]
+    r = rstd_ref[tuple([0] * rstd_ref.ndim)]
     xhat = (xf - m) * r
     w = w_ref[0].astype(jnp.float32)
     gf = g_ref[0].astype(jnp.float32)
@@ -127,8 +179,9 @@ def _bwd_kernel(x_ref, w_ref, b_ref, mean_ref, rstd_ref, g_ref,
         dz = _silu_bwd(z, gf)
     else:
         dz = gf
-    dwp_ref[0] = jnp.sum(dz * xhat, axis=1, keepdims=True)   # (Cg, 1)
-    dbp_ref[0] = jnp.sum(dz, axis=1, keepdims=True)
+    sp_axes = tuple(range(1, xf.ndim))
+    dwp_ref[0] = jnp.sum(dz * xhat, axis=sp_axes, keepdims=True)
+    dbp_ref[0] = jnp.sum(dz, axis=sp_axes, keepdims=True)
     dxhat = dz * w
     mu1 = jnp.mean(dxhat)
     mu2 = jnp.mean(dxhat * xhat)
@@ -138,34 +191,39 @@ def _bwd_kernel(x_ref, w_ref, b_ref, mean_ref, rstd_ref, g_ref,
 def gn_bwd(x, w, b, mean, rstd, g, groups: int, act=None):
     """Returns (dx, dw, db) given the forward residuals."""
     B, C = x.shape[0], x.shape[1]
-    hw = x.size // (B * C)
-    cg = C // groups
-    x3 = x.reshape(B * groups, cg, hw)
-    g3 = g.reshape(B * groups, cg, hw)
+    xb, cg, spatial = _block_shapes(x, groups)
+    gb = g.reshape(xb.shape)
+    ones = (1,) * len(spatial)
+    zeros = (0,) * len(spatial)
+    blk = (1, cg) + spatial
+    mean = mean.reshape((B * groups, 1) + ones)
+    rstd = rstd.reshape((B * groups, 1) + ones)
     dx, dw_parts, db_parts = pl.pallas_call(
         functools.partial(_bwd_kernel, act=act, x_dtype=x.dtype),
         grid=(B * groups,),
         in_specs=[
-            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, cg, 1), lambda i, gr=groups: (i % gr, 0, 0)),
-            pl.BlockSpec((1, cg, 1), lambda i, gr=groups: (i % gr, 0, 0)),
-            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
+            pl.BlockSpec(blk, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, cg) + ones,
+                         lambda i, gr=groups: (i % gr, 0) + zeros),
+            pl.BlockSpec((1, cg) + ones,
+                         lambda i, gr=groups: (i % gr, 0) + zeros),
+            pl.BlockSpec((1, 1) + ones, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, 1) + ones, lambda i: (i, 0) + zeros),
+            pl.BlockSpec(blk, lambda i: (i, 0) + zeros),
         ],
         out_specs=[
-            pl.BlockSpec((1, cg, hw), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, cg, 1), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, cg, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec(blk, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, cg) + ones, lambda i: (i, 0) + zeros),
+            pl.BlockSpec((1, cg) + ones, lambda i: (i, 0) + zeros),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * groups, cg, hw), x.dtype),
-            jax.ShapeDtypeStruct((B * groups, cg, 1), jnp.float32),
-            jax.ShapeDtypeStruct((B * groups, cg, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * groups, cg) + spatial, x.dtype),
+            jax.ShapeDtypeStruct((B * groups, cg) + ones, jnp.float32),
+            jax.ShapeDtypeStruct((B * groups, cg) + ones, jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(x3, w.reshape(groups, cg, 1), b.reshape(groups, cg, 1), mean, rstd,
-      g3)
+    )(xb, w.reshape((groups, cg) + ones), b.reshape((groups, cg) + ones),
+      mean, rstd, gb)
     # per-(b,g) channel partials -> (C,) by summing the batch axis
     dw = jnp.sum(dw_parts.reshape(B, C), axis=0).astype(w.dtype)
     db = jnp.sum(db_parts.reshape(B, C), axis=0).astype(b.dtype)

@@ -139,35 +139,53 @@ def multiclass_nms3(bboxes, scores, rois_num=None,
                     score_threshold: float = 0.05, nms_top_k: int = 1000,
                     keep_top_k: int = 100, nms_threshold: float = 0.3,
                     normalized: bool = True, nms_eta: float = 1.0,
-                    background_label: int = -1, return_index: bool = False):
+                    background_label: int = 0, return_index: bool = False):
     """Per-class NMS + cross-class top-k (the detection-head decoder).
 
-    bboxes (N, M, 4); scores (N, C, M). Per image and per class (skipping
-    ``background_label``): score filter -> top ``nms_top_k`` -> NMS ->
-    merge classes, sort by score, keep ``keep_top_k``. Returns
-    (out (R, 6) as [label, score, x1, y1, x2, y2], index (R, 1) into the
-    flattened (N*M) box list, nms_rois_num (N,)).
+    Two input layouts, matching the reference:
+    - batched: bboxes (N, M, 4), scores (N, C, M);
+    - packed (``rois_num`` given — the generate_proposals chaining form):
+      bboxes (R, 4) or (R, C, 4), scores (R, C), split into per-image
+      segments by ``rois_num``.
+
+    Per image and per class (skipping ``background_label``, default 0 as
+    in the reference): score filter -> top ``nms_top_k`` -> NMS (adaptive
+    ``nms_eta``) -> merge classes, sort by score, keep ``keep_top_k``.
+    Returns (out (R, 6) as [label, score, x1, y1, x2, y2], index (R, 1)
+    into the flattened box list, nms_rois_num (N,)).
     """
     bx = _np(bboxes)
     sc = _np(scores)
-    N, M = bx.shape[0], bx.shape[1]
-    C = sc.shape[1]
     off = 0.0 if normalized else 1.0
+    if rois_num is not None:
+        rn = _np(rois_num).astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(rn)])
+        images = []
+        for i in range(len(rn)):
+            lo, hi = int(starts[i]), int(starts[i + 1])
+            b = bx[lo:hi]                      # (r, 4) or (r, C, 4)
+            s = sc[lo:hi].T                    # (C, r)
+            images.append((b, s, lo))
+    else:
+        # batched layout: scores are already (C, M)
+        images = [(bx[i], sc[i], i * bx.shape[1]) for i in range(bx.shape[0])]
     outs, idxs, nums = [], [], []
-    for i in range(N):
+    for b_img, s_img, base in images:
+        C = s_img.shape[0]
         dets = []          # (label, score, box, flat_index)
         for c in range(C):
             if c == background_label:
                 continue
-            s = sc[i, c]
+            s = s_img[c]
             sel = np.nonzero(s > score_threshold)[0]
             if sel.size == 0:
                 continue
             order = sel[np.argsort(-s[sel], kind="stable")][:nms_top_k]
-            keep = _nms_np(bx[i][order], s[order], nms_threshold,
+            boxes_c = b_img[:, c] if b_img.ndim == 3 else b_img
+            keep = _nms_np(boxes_c[order], s[order], nms_threshold,
                            offset=off, eta=nms_eta)
             for j in order[keep]:
-                dets.append((c, s[j], bx[i][j], i * M + j))
+                dets.append((c, s[j], boxes_c[j], base + j))
         dets.sort(key=lambda t: -t[1])
         if keep_top_k >= 0:
             dets = dets[:keep_top_k]
@@ -206,6 +224,11 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     gt above ``ignore_thresh`` are ignored, sigmoid-CE classification
     (optional label smoothing with delta = 1/class_num). Returns (N,).
     """
+    if scale_x_y != 1.0:
+        raise NotImplementedError(
+            "yolo_loss: scale_x_y != 1.0 (the YOLOv4/PP-YOLO grid-"
+            "sensitive decode) is not implemented; computing the loss "
+            "without the scale would silently mistrain such models")
     anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
     mask = np.asarray(anchor_mask, np.int64)
     Am = len(mask)
@@ -299,21 +322,21 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     gy1 = gb[:, :, 1] - gb[:, :, 3] / 2
     gy2 = gb[:, :, 1] + gb[:, :, 3] / 2
 
-    def iou_vs_gt(b):
-        # b: index into B; broadcast one gt against the full grid
-        ix1 = jnp.maximum(px1, gx1[:, b][:, None, None, None])
-        ix2 = jnp.minimum(px2, gx2[:, b][:, None, None, None])
-        iy1 = jnp.maximum(py1, gy1[:, b][:, None, None, None])
-        iy2 = jnp.minimum(py2, gy2[:, b][:, None, None, None])
-        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-        ga = (gx2[:, b] - gx1[:, b]) * (gy2[:, b] - gy1[:, b])
-        pa = bw * bh
-        i = inter / jnp.maximum(pa + ga[:, None, None, None] - inter, 1e-10)
-        return jnp.where(valid[:, b][:, None, None, None], i, 0.0)
+    # one broadcast over the gt axis (B small, grid big: a Python loop
+    # over B would trace B full-grid IoU blocks and defeat fusion)
+    def bc(v):          # (N, B) -> (N, B, 1, 1, 1) against (N,1,Am,H,W)
+        return v[:, :, None, None, None]
 
-    best_iou = zeros
-    for b in range(B):
-        best_iou = jnp.maximum(best_iou, iou_vs_gt(b))
+    ix1 = jnp.maximum(px1[:, None], bc(gx1))
+    ix2 = jnp.minimum(px2[:, None], bc(gx2))
+    iy1 = jnp.maximum(py1[:, None], bc(gy1))
+    iy2 = jnp.minimum(py2[:, None], bc(gy2))
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    ga = (gx2 - gx1) * (gy2 - gy1)             # (N, B)
+    pa = (bw * bh)[:, None]
+    iou_all = inter / jnp.maximum(pa + bc(ga) - inter, 1e-10)
+    iou_all = jnp.where(bc(valid), iou_all, 0.0)
+    best_iou = jnp.max(iou_all, axis=1)        # (N, Am, H, W)
     noobj_mask = (best_iou <= ignore_thresh).astype(jnp.float32)
     obj_losses = sce(pobj, obj_t)
     loss_obj = jnp.sum(jnp.where(obj_t > 0, obj_w * obj_losses,

@@ -439,6 +439,41 @@ def test_to_static_guard_miss_storm_goes_permanent_eager():
     assert len(g._broken[key]["specs"]) <= flags.to_static_max_specializations
 
 
+def test_to_static_path_budget_overflow_guard_specializes():
+    """Round-5 synergy: blowing the cond-capture path budget no longer
+    means permanent eager — the overflow falls into guard specialization
+    (each bool recorded by the probe becomes a baked branch + runtime
+    guard), so repeat calls with the same branch pattern run compiled."""
+    from paddle_tpu.flags import flags
+
+    old = flags.to_static_max_cond_paths
+    paddle.set_flags({"to_static_max_cond_paths": 4})
+    c0 = stat_get("to_static_partial_compiled_calls")
+    try:
+        @paddle.jit.to_static
+        def f(x):
+            y = x
+            for _ in range(4):               # 16 paths > budget of 4
+                if paddle.sum(y) > 0:
+                    y = y * 1.5
+                else:
+                    y = y + 1.0
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out1 = f(paddle.to_tensor([1.0]))    # eager probe
+        out2 = f(paddle.to_tensor([1.0]))        # compiled, guards verify
+        np.testing.assert_allclose(out1.numpy(), [1.5 ** 4])
+        np.testing.assert_allclose(out2.numpy(), [1.5 ** 4])
+        assert stat_get("to_static_partial_compiled_calls") == c0 + 1
+        # a different branch pattern: guards miss -> correct eager serve
+        out3 = f(paddle.to_tensor([-9.0]))   # stays negative: +1 each time
+        np.testing.assert_allclose(out3.numpy(), [-5.0], rtol=1e-6)
+    finally:
+        paddle.set_flags({"to_static_max_cond_paths": old})
+
+
 def test_while_loop_max_iters_zero_parity():
     """Review finding: max_iters=0 must run the body ZERO times in both
     the eager and traced paths."""

@@ -64,7 +64,8 @@ def test_multiclass_nms3():
     sc = paddle.to_tensor(np.array(
         [[[0.9, 0.85, 0.1], [0.2, 0.1, 0.8]]], np.float32))
     out, idx, num = vops.multiclass_nms3(bx, sc, score_threshold=0.3,
-                                         nms_threshold=0.5)
+                                         nms_threshold=0.5,
+                                         background_label=-1)
     o = out.numpy()
     assert int(num.numpy()[0]) == 2
     # highest score first; the near-duplicate class-0 box was suppressed
@@ -73,9 +74,33 @@ def test_multiclass_nms3():
     np.testing.assert_array_equal(idx.numpy()[:, 0], [0, 2])
     # keep_top_k truncates across classes
     out2, _, num2 = vops.multiclass_nms3(bx, sc, score_threshold=0.3,
-                                         nms_threshold=0.5, keep_top_k=1)
+                                         nms_threshold=0.5, keep_top_k=1,
+                                         background_label=-1)
     assert int(num2.numpy()[0]) == 1 and out2.numpy()[0][1] == \
         pytest.approx(0.9)
+    # the reference default skips class 0 as background
+    out3, _, num3 = vops.multiclass_nms3(bx, sc, score_threshold=0.3,
+                                         nms_threshold=0.5)
+    assert int(num3.numpy()[0]) == 1 and out3.numpy()[0][0] == 1
+
+
+def test_multiclass_nms3_packed_rois_num():
+    """The generate_proposals chaining layout: packed (R, 4) boxes +
+    (R, C) scores split per image by rois_num."""
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [20, 20, 30, 30],     # image 0: 2 rois
+         [5, 5, 15, 15]], np.float32))         # image 1: 1 roi
+    scores = paddle.to_tensor(np.array(
+        [[0.1, 0.9], [0.2, 0.7],
+         [0.05, 0.6]], np.float32))            # (R, C=2)
+    out, idx, num = vops.multiclass_nms3(
+        boxes, scores, rois_num=paddle.to_tensor(np.array([2, 1], np.int32)),
+        score_threshold=0.3, nms_threshold=0.5)
+    np.testing.assert_array_equal(num.numpy(), [2, 1])
+    o = out.numpy()
+    assert o.shape == (3, 6)
+    assert o[0][1] == pytest.approx(0.9) and o[2][1] == pytest.approx(0.6)
+    np.testing.assert_array_equal(idx.numpy()[:, 0], [0, 1, 2])
 
 
 def _yolo_case(rng, N=2, H=4, W=4, C=3, B=2):

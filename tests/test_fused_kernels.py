@@ -325,7 +325,9 @@ def test_adam_non_multi_precision_moments_follow_param_dtype():
 
 def test_group_norm_fused_mean_shifted_no_nan():
     """Review fix: one-pass E[x^2]-m^2 variance cancels catastrophically
-    on mean-shifted activations; the kernel must match the lax path."""
+    on mean-shifted activations. Judged against the f64 ground truth —
+    the round-5 pivot-shifted kernel mean is ~5x MORE accurate here than
+    the f32 lax composition, so lax is not a valid oracle."""
     import numpy as np
     from paddle_tpu.ops.fused_norm import group_norm_fused, group_norm_lax
 
@@ -335,8 +337,15 @@ def test_group_norm_fused_mean_shifted_no_nan():
     b = np.zeros(8, np.float32)
     out = np.asarray(group_norm_fused(x, w, b, 4, 1e-5, None))
     ref = np.asarray(group_norm_lax(x, w, b, 4, 1e-5, None))
+    x64 = x.astype(np.float64).reshape(2, 4, -1)
+    m = x64.mean(-1, keepdims=True)
+    v = x64.var(-1, keepdims=True)
+    true = ((x64 - m) / np.sqrt(v + 1e-5)).reshape(x.shape)
     assert np.isfinite(out).all()
-    np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
+    kerr = np.abs(out - true).max()
+    lerr = np.abs(ref - true).max()
+    assert kerr < 0.02, kerr
+    assert kerr <= lerr + 1e-3, (kerr, lerr)   # kernel never worse than lax
 
 
 def test_group_norm_supported_bounds_vmem():
