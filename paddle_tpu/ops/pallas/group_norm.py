@@ -17,7 +17,7 @@ kernel flattened spatial dims to (B*G, C/G, HW), which retiled the
 array (HW lanes vs W lanes) and cost a relayout copy on BOTH sides of
 every norm — the dominant share of the 37 ms/step of copy/reshape
 traffic in the round-4 profile. Full-dim trailing blocks also lift the
-HW %% 128 restriction, so the 8x8-latent level runs the kernel too.
+HW % 128 restriction, so the 8x8-latent level runs the kernel too.
 Non-4D inputs keep the flattened path (HW lane-multiple required).
 """
 
@@ -51,7 +51,7 @@ def _padded_elems(cg: int, spatial) -> int:
 
 def _layout_for(x_shape, groups: int):
     """'native4d' (no relayout around the kernel, any H/W), 'flat'
-    (HW lanes; needs HW %% 128), or None (XLA fallback)."""
+    (HW lanes; needs HW % 128), or None (XLA fallback)."""
     if len(x_shape) < 3:
         return None
     c = x_shape[1]
@@ -68,17 +68,12 @@ def _layout_for(x_shape, groups: int):
     # slab blows the budget fall back to the flattened layout (one
     # relayout copy each side) rather than to XLA.
     budget = 4 * 1024 * 1024
-    if _use_interpret():
-        # same budget routing as TPU (so CPU tests exercise the same
-        # decisions), minus the lane-multiple requirement on 'flat'
-        if (len(x_shape) == 4
-                and _padded_elems(cg, x_shape[2:]) * 4 <= budget):
-            return "native4d"
-        return "flat" if cg * hw * 4 <= budget else None
     if (len(x_shape) == 4
             and _padded_elems(cg, x_shape[2:]) * 4 <= budget):
         return "native4d"
-    if hw % 128 == 0 and cg * hw * 4 <= budget:
+    # interpret mode has no lane-tiling constraint on 'flat'; everything
+    # else routes identically so CPU tests exercise the TPU decisions
+    if (hw % 128 == 0 or _use_interpret()) and cg * hw * 4 <= budget:
         return "flat"
     return None
 

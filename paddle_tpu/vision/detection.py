@@ -336,7 +336,8 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     pa = (bw * bh)[:, None]
     iou_all = inter / jnp.maximum(pa + bc(ga) - inter, 1e-10)
     iou_all = jnp.where(bc(valid), iou_all, 0.0)
-    best_iou = jnp.max(iou_all, axis=1)        # (N, Am, H, W)
+    # initial=0 also covers B == 0 (all-background batches)
+    best_iou = jnp.max(iou_all, axis=1, initial=0.0)   # (N, Am, H, W)
     noobj_mask = (best_iou <= ignore_thresh).astype(jnp.float32)
     obj_losses = sce(pobj, obj_t)
     loss_obj = jnp.sum(jnp.where(obj_t > 0, obj_w * obj_losses,

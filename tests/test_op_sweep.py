@@ -572,6 +572,12 @@ SKIP = {
                        "dedicated tests in test_subsystems.py",
     # detection family: structured box/roi/anchor inputs; dedicated
     # reference-parity tests in test_vision_ops.py
+    "generate_proposals": "detection family (structured anchors/deltas); "
+                          "dedicated decode/NMS tests in test_detection.py",
+    "multiclass_nms3": "detection family; test_detection.py",
+    "yolo_loss": "detection family (structured gt boxes/labels); ideal-vs-"
+                 "random loss + grad-flow + ignore-thresh tests in "
+                 "test_detection.py",
     "box_iou": "detection family; test_vision_ops.py",
     "nms_mask": "detection family; test_vision_ops.py",
     "roi_align": "detection family; test_vision_ops.py",
