@@ -1,0 +1,50 @@
+"""Round-tail on-chip sequence: run after the TPU tunnel is back.
+
+Runs, in order, with per-step logs under /tmp/roundtail/:
+  1. unet profile (validates the layout-aware GroupNorm kernel on
+     hardware + writes bench_profile_unet.json for the data-movement
+     attribution)
+  2. llama flagship bench (regression check for the flash masked-row
+     guards + everything else this round touched)
+  3. decode1b_served (the BASELINE served-decode row)
+
+Each step is a subprocess so one failure doesn't kill the rest; the
+summary prints at the end. Usage: python tools/roundtail_bench.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+STEPS = [
+    ("unet_profile", [sys.executable, "bench.py", "--config", "unet",
+                      "--profile"]),
+    ("llama", [sys.executable, "bench.py"]),
+    ("decode1b_served", [sys.executable, "bench.py", "--config",
+                         "decode1b_served"]),
+]
+
+
+def main():
+    os.makedirs("/tmp/roundtail", exist_ok=True)
+    results = {}
+    for name, cmd in STEPS:
+        t0 = time.time()
+        log = f"/tmp/roundtail/{name}.log"
+        with open(log, "w") as f:
+            rc = subprocess.call(cmd, stdout=f, stderr=subprocess.STDOUT)
+        results[name] = (rc, round(time.time() - t0, 1))
+        tail = open(log).read().strip().splitlines()[-3:]
+        print(f"== {name}: rc={rc} {results[name][1]}s")
+        for line in tail:
+            print("   ", line)
+    bad = [n for n, (rc, _) in results.items() if rc]
+    print("SUMMARY:", "ALL OK" if not bad else f"FAILED: {bad}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
