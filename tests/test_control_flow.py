@@ -474,6 +474,34 @@ def test_to_static_path_budget_overflow_guard_specializes():
         paddle.set_flags({"to_static_max_cond_paths": old})
 
 
+def test_conc_capture_thread_isolation():
+    """Review finding (round 5): the record/replay context stack is
+    per-thread — another thread's Tensor.numpy() (watchdog, DataLoader
+    worker) must not leak into a probe's recorded sequence."""
+    import threading
+
+    from paddle_tpu.jit import conc_capture
+
+    t_other = paddle.to_tensor(np.ones(3, np.float32))
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            t_other.numpy()
+
+    th = threading.Thread(target=churn)
+    th.start()
+    try:
+        ctx = conc_capture.ConcContext("record")
+        with conc_capture.capture(ctx):
+            v = float(paddle.to_tensor(5.0))
+        assert v == 5.0
+        assert len(ctx.values) == 1 and float(ctx.values[0]) == 5.0
+    finally:
+        stop.set()
+        th.join()
+
+
 def test_while_loop_max_iters_zero_parity():
     """Review finding: max_iters=0 must run the body ZERO times in both
     the eager and traced paths."""
