@@ -29,7 +29,7 @@ from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.framework.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.framework.tensor import Tensor

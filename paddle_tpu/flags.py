@@ -151,6 +151,12 @@ define_flag("use_decode_attention", True,
             "route single-token GQA cache attention through the Pallas "
             "decode kernel (ops/pallas/decode_attention.py); MHA (no "
             "head sharing) stays on XLA, which is faster there")
+define_flag("decode_fallback", False,
+            "serve LlamaDecoder.generate / nn.generation.generate_tokens "
+            "through the per-token host loop (one dispatch + one host sync "
+            "per token) instead of the one-dispatch fused scan decode — a "
+            "debugging escape hatch; the PADDLE_TPU_DECODE_FALLBACK=1 "
+            "environment variable is an equivalent switch")
 define_flag("decode_cache_layout", "stacked",
             "KV-cache layout for the compiled decoder: 'per_layer' "
             "(one (B, L, KV, D) buffer per layer) or 'stacked' "

@@ -1,0 +1,38 @@
+"""Version shims over the jax surface this framework targets.
+
+The codebase is written against the current jax API; these shims keep it
+importable on the previous LTS line where a few symbols live elsewhere:
+
+- ``jax.shard_map`` (function) was ``jax.experimental.shard_map.shard_map``
+  with ``check_rep`` instead of ``check_vma``;
+- ``jax.experimental.pallas.tpu.CompilerParams`` was ``TPUCompilerParams``;
+- ``jax.core.get_opaque_trace_state`` gained a required (ignored)
+  ``convention`` argument — see ``jit.cond_capture.opaque_trace_state``.
+
+Every shim resolves at import time so call sites pay nothing per call.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map", "pallas_tpu_compiler_params"]
+
+try:
+    from jax import shard_map as shard_map  # noqa: F401  (new home)
+except ImportError:                          # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f=None, /, **kwargs):
+        # translate the new spelling's check_vma= to the old check_rep=
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda g: _shard_map(g, **kwargs)
+        return _shard_map(f, **kwargs)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` on current jax, ``TPUCompilerParams`` before
+    the rename — construct whichever this jax provides."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -169,27 +169,33 @@ class Predictor:
         raise ValueError("memory_report requires an AOT bundle predictor")
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 max_len: int = 512, eos_token_id=None) -> np.ndarray:
-        """Greedy autoregressive decode with a compile-once KV cache
+                 max_len: int = 512, eos_token_id=None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k=None, top_p=None, seed: int = 0) -> np.ndarray:
+        """Autoregressive decode with a compile-once KV cache
         (block_multi_head_attention capability analog; see
-        inference/generate.py). Only causal-LM layers with a Llama-style
-        config are supported; the decoder is cached on the predictor so
-        repeated calls reuse the compiled prefill/step executables."""
+        inference/generate.py). Every mode — greedy/sampled, with or
+        without eos — runs the token loop as ONE fused device dispatch.
+        Only causal-LM layers with a Llama-style config are supported;
+        the decoder is cached on the predictor so repeated calls reuse
+        the compiled executables. AOT bundles take eos id and seed as
+        runtime inputs; their sampling statics were fixed at export
+        (``bundle.json``'s ``decode_mode``), so temperature/top_k/top_p
+        here apply to the in-process decoder only."""
         if self._aot is not None:
-            if eos_token_id is not None:
-                raise NotImplementedError(
-                    "AOT bundles run the greedy scan fully on device; "
-                    "per-row eos stopping is a host-loop feature — "
-                    "generate without eos_token_id and trim on the host")
             return self._aot.generate(input_ids,
-                                      max_new_tokens=max_new_tokens)
+                                      max_new_tokens=max_new_tokens,
+                                      eos_token_id=eos_token_id,
+                                      do_sample=do_sample, seed=seed)
         from paddle_tpu.inference.generate import LlamaDecoder
         dec = getattr(self, "_decoder", None)
         if dec is None or dec.max_len < max_len:
             dec = LlamaDecoder(self._layer, max_len=max_len)
             self._decoder = dec
         return dec.generate(input_ids, max_new_tokens=max_new_tokens,
-                            eos_token_id=eos_token_id)
+                            eos_token_id=eos_token_id, do_sample=do_sample,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, seed=seed)
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -90,13 +90,33 @@ def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
         buckets.append({"file": tag + ".aot",
                         "shapes": [list(s) for s in shapes],
                         "dtypes": [str(a.dtype) for a in examples]})
-    n_out = len(jax.eval_shape(fwd, *examples))
+    outs0 = jax.eval_shape(fwd, *examples)
+    n_out = len(outs0)
     meta = {
         "kind": "predict",
         "inputs": input_names or [f"x{i}" for i in range(len(examples))],
         "outputs": output_names or [f"out_{i}" for i in range(n_out)],
         "buckets": buckets,
     }
+    # Identify which outputs are batch-major BY CONSTRUCTION (abstract
+    # re-trace at a different batch: an output is batch-major iff its
+    # leading dim tracks the input batch), so the padded-bucket run()
+    # path never trims a non-batch output whose leading dim happens to
+    # equal the padded batch (ADVICE r5).
+    try:
+        B0 = examples[0].shape[0]
+        alt = B0 + 1
+        outs1 = jax.eval_shape(fwd, *[
+            jax.ShapeDtypeStruct((alt,) + tuple(a.shape[1:]), a.dtype)
+            for a in examples])
+        meta["output_batch_major"] = [
+            bool(len(s0.shape) and len(s1.shape)
+                 and s0.shape[0] == B0 and s1.shape[0] == alt)
+            for s0, s1 in zip(outs0, outs1)]
+    except Exception:
+        # batch-polymorphic retrace unsupported (e.g. batch-baked model):
+        # leave batch axes unknown -> run() serves exact shapes only
+        pass
     with open(os.path.join(out_dir, _META), "w") as f:
         json.dump(meta, f, indent=2)
 
@@ -104,12 +124,22 @@ def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
 def export_decoder_bundle(decoder, out_dir: str,
                           prompt_lens: Sequence[int],
                           decode_steps: Sequence[int],
-                          batch_sizes: Sequence[int] = (1,)) -> None:
-    """Export a ``LlamaDecoder`` as prefill + greedy scan-decode AOT
+                          batch_sizes: Sequence[int] = (1,),
+                          do_sample: bool = False,
+                          temperature: float = 1.0,
+                          top_k: Optional[int] = None,
+                          top_p: Optional[float] = None) -> None:
+    """Export a ``LlamaDecoder`` as prefill + fused scan-decode AOT
     entries (the compiled-decode serving artifact the reference ships via
     its generation ops + AnalysisPredictor). One prefill module per
     (B, S) bucket, one decode module per (B, N) bucket; KV-cache buffers
-    are donated so serving decodes in place."""
+    are donated so serving decodes in place.
+
+    Decode entries run the SAME one-dispatch fused loop the in-process
+    decoder uses: the eos id and the jax.random key are runtime inputs
+    (one entry serves any eos — pass eos=-1 for "none" — and any seed);
+    the sampling mode (``do_sample``/``temperature``/``top_k``/``top_p``)
+    is static, baked at export and recorded in the bundle metadata."""
     import jax
     import jax.numpy as jnp
 
@@ -145,12 +175,20 @@ def export_decoder_bundle(decoder, out_dir: str,
         for N in decode_steps:
             logits0 = jnp.zeros(logits_sds.shape, logits_sds.dtype)
             pos0 = jnp.asarray(0, jnp.int32)
+            key0 = jax.random.PRNGKey(0)
+            done0 = jnp.zeros((int(B),), jnp.bool_)
+            eos0 = jnp.asarray(-1, jnp.int32)
 
-            def decode(logits, kc, vc, pos, N=int(N)):
-                return decoder._scan_decode(p, logits, kc, vc, pos, steps=N)
+            def decode(logits, kc, vc, pos, key, done, eos, N=int(N)):
+                return decoder._fused_decode(
+                    p, logits, kc, vc, pos, key, done, eos, steps=N,
+                    do_sample=bool(do_sample), use_eos=True,
+                    temperature=float(temperature),
+                    top_k=None if top_k is None else int(top_k),
+                    top_p=None if top_p is None else float(top_p))
 
             tag = f"decode_b{B}_n{N}"
-            _save_exp(decode, (logits0, kc, vc, pos0),
+            _save_exp(decode, (logits0, kc, vc, pos0, key0, done0, eos0),
                       os.path.join(out_dir, tag + ".aot"),
                       donate_argnums=(1, 2))
             decodes.append({"file": tag + ".aot", "batch": int(B),
@@ -168,6 +206,12 @@ def export_decoder_bundle(decoder, out_dir: str,
         "caches": caches,
         "prefill_buckets": prefills,
         "decode_buckets": decodes,
+        # the fused-decode serving contract: key/done/eos are inputs,
+        # sampling statics were baked at export
+        "decode_mode": {"do_sample": bool(do_sample),
+                        "temperature": float(temperature),
+                        "top_k": None if top_k is None else int(top_k),
+                        "top_p": None if top_p is None else float(top_p)},
     }
     with open(os.path.join(out_dir, _META), "w") as f:
         json.dump(meta, f, indent=2)
@@ -253,8 +297,8 @@ class AotPredictor:
                 kc, vc = self._make_cache(B)
                 logits, kc, vc = self._entry(pf["file"])(ids, kc, vc)
                 if dc is not None:
-                    self._entry(dc["file"])(
-                        logits, kc, vc, jnp.asarray(pf["seq"], jnp.int32))
+                    self._entry(dc["file"])(*self._decode_args(
+                        logits, kc, vc, pf["seq"], B, None, 0))
 
     def _first_prefill(self, B: int):
         return next((b for b in self.meta["prefill_buckets"]
@@ -327,12 +371,22 @@ class AotPredictor:
                 padded.append(np.concatenate([a, pad], axis=0))
             outs = self._entry(b["file"])(*padded)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            # trim ONLY outputs whose leading dim is the padded batch; a
-            # leading dim that isn't nb is not a batch axis
-            return {n: (np.asarray(o)[:B]
-                        if np.ndim(o) and np.shape(o)[0] == nb
-                        else np.asarray(o))
-                    for n, o in zip(self.meta["outputs"], outs)}
+            # trim ONLY the outputs the exporter identified as batch-major
+            # (abstract re-trace at a second batch size); a non-batch
+            # output whose leading dim coincidentally equals the padded
+            # batch must pass through untouched (ADVICE r5)
+            bm = self.meta.get("output_batch_major")
+            if bm is None:
+                # legacy bundle without batch-axis metadata: padding could
+                # silently truncate a non-batch output — refuse, per the
+                # strict exact-shape contract
+                raise ValueError(
+                    f"no exact shape bucket for inputs {shapes} and this "
+                    "bundle predates output batch-axis metadata; re-export "
+                    "it to enable padded serving (exported buckets: "
+                    f"{[b['shapes'] for b in self.meta['buckets']]})")
+            return {n: (np.asarray(o)[:B] if is_bm else np.asarray(o))
+                    for n, o, is_bm in zip(self.meta["outputs"], outs, bm)}
         raise ValueError(
             f"no shape bucket for inputs {shapes}; exported buckets: "
             f"{[b['shapes'] for b in self.meta['buckets']]}")
@@ -349,11 +403,48 @@ class AotPredictor:
         vc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
         return kc, vc
 
-    def generate(self, input_ids, max_new_tokens: int) -> np.ndarray:
+    def _decode_args(self, logits, kc, vc, pos, nb, eos_token_id, seed):
+        """Positional inputs for a decode entry. Fused-decode bundles
+        (``decode_mode`` in the metadata) take (logits, caches, pos, key,
+        done, eos) — eos=-1 means "no eos"; legacy greedy bundles take
+        the original 4 inputs."""
+        import jax.numpy as jnp
+
+        pos = jnp.asarray(pos, jnp.int32)
+        if self.meta.get("decode_mode") is None:
+            return (logits, kc, vc, pos)
+        import jax
+        key = jax.random.PRNGKey(seed)
+        done = jnp.zeros((nb,), jnp.bool_)
+        eos = jnp.asarray(-1 if eos_token_id is None else int(eos_token_id),
+                          jnp.int32)
+        return (logits, kc, vc, pos, key, done, eos)
+
+    def generate(self, input_ids, max_new_tokens: int,
+                 eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, seed: int = 0) -> np.ndarray:
+        """Serve a decode: the whole token loop is ONE exported fused
+        module execution (sampling statics were fixed at export — a
+        ``do_sample`` request must match the bundle's ``decode_mode``;
+        eos id and seed are runtime inputs)."""
         if self.meta["kind"] != "llama_decoder":
             raise ValueError(f"bundle kind {self.meta['kind']!r} cannot "
                              "generate; use run()")
         import jax.numpy as jnp
+
+        mode = self.meta.get("decode_mode")
+        if mode is None:
+            if do_sample or eos_token_id is not None:
+                raise ValueError(
+                    "this bundle predates fused-decode entries and serves "
+                    "greedy-without-eos only; re-export it for "
+                    "sampling/eos support")
+        elif bool(do_sample) != bool(mode["do_sample"]):
+            raise ValueError(
+                f"bundle decode entries were exported with do_sample="
+                f"{mode['do_sample']} (temperature={mode['temperature']}, "
+                f"top_k={mode['top_k']}, top_p={mode['top_p']}); "
+                f"requested do_sample={do_sample}")
 
         ids = np.asarray(input_ids)
         B, S = ids.shape
@@ -396,7 +487,10 @@ class AotPredictor:
         kc, vc = self._make_cache(nb)
         logits, kc, vc = self._entry(pf["file"])(
             jnp.asarray(fed, jnp.int32), kc, vc)
-        toks = self._entry(dc["file"])(logits, kc, vc,
-                                       jnp.asarray(S, jnp.int32))
+        toks = self._entry(dc["file"])(*self._decode_args(
+            logits, kc, vc, S, nb, eos_token_id, seed))
         toks = np.asarray(toks)[:B, :max_new_tokens]
+        if eos_token_id is not None:
+            from paddle_tpu.inference.generate import _trim_after_eos
+            toks = _trim_after_eos(toks, int(eos_token_id))
         return np.concatenate([ids, toks.astype(ids.dtype)], axis=1)

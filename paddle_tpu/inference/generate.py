@@ -173,9 +173,14 @@ def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len):
 class LlamaDecoder:
     """Compile-once greedy/sampling decoder with a static KV cache.
 
-    Two executables total: ``prefill`` (fixed prompt length, pad to reuse)
-    and ``step`` (one token). Both are jit-cached by shape, so a
-    ``generate`` of N tokens runs N+1 device programs and zero retraces.
+    Two executables per generate: ``prefill`` (fixed prompt length, pad to
+    reuse) and ``fused_decode`` — the ENTIRE token loop (argmax or
+    temperature/top-k/top-p sampling, per-step key splits, per-row eos
+    freezing) as one ``lax.scan`` program, so a ``generate`` of N tokens
+    is 2 device dispatches regardless of mode, with zero retraces across
+    calls/seeds/eos ids. ``dispatch_count`` counts executions so the
+    one-dispatch property is assertable in tests; the per-token ``step``
+    executable remains for the ``decode_fallback`` debugging flag.
     """
 
     def __init__(self, model: LlamaForCausalLM, max_len: int = 512,
@@ -238,7 +243,8 @@ class LlamaDecoder:
         p["rope.cos"], p["rope.sin"] = cos, sin
         self.params = p
         cfg = self.cfg
-        self.trace_count = 0  # python side effect: bumps only on (re)trace
+        self.trace_count = 0     # python side effect: bumps only on (re)trace
+        self.dispatch_count = 0  # one per device program execution
 
         def prefill(p, ids, kc, vc):
             self.trace_count += 1
@@ -248,28 +254,57 @@ class LlamaDecoder:
             self.trace_count += 1
             return _forward_cached(p, cfg, ids, kc, vc, pos, max_len)
 
-        def scan_decode(p, logits0, kc, vc, pos0, steps: int):
-            """The whole greedy loop as ONE device program (lax.scan): over
-            a network-tunneled chip, per-token host dispatches dominate —
-            this collapses N tokens to a single dispatch."""
+        def fused_decode(p, logits0, kc, vc, pos0, key0, done0, eos_id,
+                         steps: int, do_sample: bool, use_eos: bool,
+                         temperature: float, top_k, top_p):
+            """The whole token loop — sampling and EOS handling included —
+            as ONE device program (lax.scan): over a network-tunneled chip,
+            per-token host dispatches dominate, so this collapses N tokens
+            to a single dispatch for EVERY decode mode. The jax.random key
+            threads through the carry and splits once per step (identical
+            stream to the per-token fallback); ``done0`` rows that hit
+            ``eos_id`` freeze to eos, and the host trims post-eos columns
+            after the fact (``_trim_after_eos``)."""
             self.trace_count += 1
 
-            def body(carry, _):
-                logits, kc, vc, pos = carry
-                tok = jnp.argmax(logits, -1).astype(jnp.int32)[:, None]
-                logits, kc, vc = _forward_cached(p, cfg, tok, kc, vc, pos,
-                                                 max_len)
-                return (logits, kc, vc, pos + 1), tok[:, 0]
+            def pick(logits, key, done):
+                if do_sample:
+                    key, sub = jax.random.split(key)
+                    tok = _sample_from(logits, sub, temperature, top_k,
+                                       top_p).astype(jnp.int32)
+                else:
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                if use_eos:
+                    tok = jnp.where(done, eos_id, tok)
+                    done = jnp.logical_or(done, tok == eos_id)
+                return tok, key, done
 
-            (logits, _, _, _), toks = jax.lax.scan(
-                body, (logits0, kc, vc, pos0), None, length=steps)
-            last = jnp.argmax(logits, -1).astype(jnp.int32)
+            def body(carry, _):
+                logits, kc, vc, pos, key, done = carry
+                tok, key, done = pick(logits, key, done)
+                logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
+                                                 vc, pos, max_len)
+                return (logits, kc, vc, pos + 1, key, done), tok
+
+            (logits, _, _, _, key, done), toks = jax.lax.scan(
+                body, (logits0, kc, vc, pos0, key0, done0), None,
+                length=steps)
+            last, _, _ = pick(logits, key, done)
             return jnp.concatenate([jnp.moveaxis(toks, 0, 1),
                                     last[:, None]], axis=1)
 
-        self._prefill = jax.jit(prefill)
-        self._step = jax.jit(step)
-        self._scan_decode = jax.jit(scan_decode, static_argnames=("steps",))
+        def counted(jitted):
+            def call(*args, **kwargs):
+                self.dispatch_count += 1
+                return jitted(*args, **kwargs)
+            return call
+
+        self._prefill = counted(jax.jit(prefill))
+        self._step = counted(jax.jit(step))
+        self._fused_decode = counted(jax.jit(
+            fused_decode,
+            static_argnames=("steps", "do_sample", "use_eos", "temperature",
+                             "top_k", "top_p")))
 
     def _empty_cache(self, B):
         cfg = self.cfg
@@ -301,52 +336,12 @@ class LlamaDecoder:
 
         Greedy by default; ``do_sample=True`` draws from the
         temperature/top-k/top-p-filtered distribution (the reference
-        generation-op sampling surface). Sampling uses the host loop
-        (per-token randomness), greedy-without-eos uses the one-dispatch
-        scan path.
+        generation-op sampling surface). EVERY mode — greedy, greedy+eos,
+        sampled, sampled+eos — runs the whole token loop as one fused
+        device dispatch (``fused_decode``); set the ``decode_fallback``
+        flag or ``PADDLE_TPU_DECODE_FALLBACK=1`` to debug against the
+        per-token host loop, which emits the same tokens for a fixed seed.
         """
-        if do_sample:
-            return self._generate_sampled(input_ids, max_new_tokens,
-                                          eos_token_id, temperature,
-                                          top_k, top_p, seed)
-        ids = jnp.asarray(np.asarray(input_ids))
-        B, S = ids.shape
-        if S + max_new_tokens > self.max_len:
-            raise ValueError(f"prompt {S} + {max_new_tokens} new tokens "
-                             f"exceeds max_len {self.max_len}")
-        if max_new_tokens <= 0:
-            return np.asarray(ids)
-        kc, vc = self._empty_cache(B)
-        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
-        if eos_token_id is None:
-            # no early-exit condition -> run the whole loop on device
-            toks = self._scan_decode(self.params, logits, kc, vc,
-                                     jnp.asarray(S, jnp.int32),
-                                     steps=max_new_tokens - 1)
-            return np.asarray(jnp.concatenate(
-                [ids, toks.astype(ids.dtype)], axis=1))
-        out = [ids]
-        pos = S
-        done = np.zeros((B,), bool)
-        for i in range(max_new_tokens):
-            nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(
-                np.asarray(ids).dtype)
-            # rows already finished stay pinned to eos (per-row stopping;
-            # the reference pads post-eos positions the same way)
-            nxt = np.where(done, eos_token_id, nxt)
-            done |= nxt == eos_token_id
-            out.append(jnp.asarray(nxt[:, None]))
-            if bool(done.all()) or i == max_new_tokens - 1:
-                break  # no wasted forward for tokens nobody consumes
-            # pos as a device scalar: a Python int would bake into the trace
-            # and recompile every step
-            logits, kc, vc = self._step(self.params, jnp.asarray(nxt[:, None]),
-                                        kc, vc, jnp.asarray(pos, jnp.int32))
-            pos += 1
-        return np.asarray(jnp.concatenate(out, axis=1))
-
-    def _generate_sampled(self, input_ids, max_new_tokens, eos_token_id,
-                          temperature, top_k, top_p, seed):
         import jax.random as jrandom
 
         ids = jnp.asarray(np.asarray(input_ids))
@@ -356,6 +351,40 @@ class LlamaDecoder:
                              f"exceeds max_len {self.max_len}")
         if max_new_tokens <= 0:
             return np.asarray(ids)
+        if decode_fallback_active():
+            return self._generate_per_token(ids, max_new_tokens,
+                                            eos_token_id, do_sample,
+                                            temperature, top_k, top_p, seed)
+        kc, vc = self._empty_cache(B)
+        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        # raw uint32 key: same threefry stream as the fallback's typed key
+        # (and a plain array, so AOT bundles export the identical function)
+        key = jrandom.PRNGKey(seed)
+        done = jnp.zeros((B,), jnp.bool_)
+        eos = jnp.asarray(0 if eos_token_id is None else int(eos_token_id),
+                          jnp.int32)
+        toks = self._fused_decode(
+            self.params, logits, kc, vc, jnp.asarray(S, jnp.int32), key,
+            done, eos, steps=max_new_tokens - 1, do_sample=bool(do_sample),
+            use_eos=eos_token_id is not None,
+            temperature=float(temperature),
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p))
+        toks = np.asarray(toks)
+        if eos_token_id is not None:
+            toks = _trim_after_eos(toks, int(eos_token_id))
+        return np.concatenate(
+            [np.asarray(ids), toks.astype(np.asarray(ids).dtype)], axis=1)
+
+    def _generate_per_token(self, ids, max_new_tokens, eos_token_id,
+                            do_sample, temperature, top_k, top_p, seed):
+        """Per-token host loop (the pre-fused path): one device dispatch
+        per token plus a host sync each step. Kept only as the
+        ``decode_fallback`` debugging escape hatch and as the parity
+        reference the fused path is tested against."""
+        import jax.random as jrandom
+
+        B, S = ids.shape
         kc, vc = self._empty_cache(B)
         logits, kc, vc = self._prefill(self.params, ids, kc, vc)
         key = jrandom.key(seed)
@@ -363,17 +392,24 @@ class LlamaDecoder:
         pos = S
         done = np.zeros((B,), bool)
         for i in range(max_new_tokens):
-            key, sub = jrandom.split(key)
-            nxt = np.asarray(_sample_logits(logits, sub, temperature,
-                                            top_k, top_p))
+            if do_sample:
+                key, sub = jrandom.split(key)
+                nxt = np.asarray(_sample_logits(logits, sub, temperature,
+                                                top_k, top_p))
+            else:
+                nxt = np.asarray(jnp.argmax(logits, axis=-1))
             nxt = nxt.astype(np.asarray(ids).dtype)
             if eos_token_id is not None:
+                # rows already finished stay pinned to eos (per-row
+                # stopping; the reference pads post-eos positions likewise)
                 nxt = np.where(done, eos_token_id, nxt)
                 done |= nxt == eos_token_id
             out.append(jnp.asarray(nxt[:, None]))
             if (eos_token_id is not None and bool(done.all())) \
                     or i == max_new_tokens - 1:
-                break
+                break  # no wasted forward for tokens nobody consumes
+            # pos as a device scalar: a Python int would bake into the trace
+            # and recompile every step
             logits, kc, vc = self._step(self.params, jnp.asarray(nxt[:, None]),
                                         kc, vc, jnp.asarray(pos, jnp.int32))
             pos += 1
@@ -383,11 +419,33 @@ class LlamaDecoder:
 import functools
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("temperature", "top_k", "top_p"))
-def _sample_logits(logits, key, temperature: float = 1.0,
-                   top_k=None, top_p=None):
-    """Temperature / top-k / top-p filtered categorical sample. (B, V) -> (B,)."""
+def decode_fallback_active() -> bool:
+    """True when the per-token debugging path is requested, via the
+    ``decode_fallback`` flag or the ``PADDLE_TPU_DECODE_FALLBACK`` env."""
+    import os
+
+    from paddle_tpu.flags import flags
+    if flags.decode_fallback:
+        return True
+    return os.environ.get("PADDLE_TPU_DECODE_FALLBACK", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def _trim_after_eos(toks: np.ndarray, eos_token_id: int) -> np.ndarray:
+    """Drop columns past the point where every row has emitted eos — the
+    fused path pins finished rows to eos on device, so trimming here
+    reproduces the per-token loop's early-stop output length exactly."""
+    hit = toks == eos_token_id
+    n = toks.shape[1]
+    first = np.where(hit.any(axis=1), hit.argmax(axis=1), n - 1)
+    return toks[:, :int(first.max()) + 1]
+
+
+def _sample_from(logits, key, temperature: float = 1.0,
+                 top_k=None, top_p=None):
+    """Temperature / top-k / top-p filtered categorical sample.
+    (B, V) -> (B,). Pure trace-level function: runs inside the fused
+    decode scan body and under the jitted `_sample_logits` wrapper."""
     lg = logits / jnp.maximum(temperature, 1e-6)
     if top_k is not None:
         kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
@@ -402,3 +460,11 @@ def _sample_logits(logits, key, temperature: float = 1.0,
             sorted_lg, jnp.maximum(keep_n - 1, 0)[:, None], axis=-1)
         lg = jnp.where(lg < cutoff, -jnp.inf, lg)
     return jax.random.categorical(key, lg, axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("temperature", "top_k", "top_p"))
+def _sample_logits(logits, key, temperature: float = 1.0,
+                   top_k=None, top_p=None):
+    """Jitted `_sample_from` (the per-token host loops' sampling op)."""
+    return _sample_from(logits, key, temperature, top_k, top_p)

@@ -61,7 +61,8 @@ class ConcContext:
         # replay: the trace this context rides; a concretization hit in a
         # DEEPER trace (lax.cond branch / loop body) cannot become a guard
         # output — its tracer would escape that inner scope
-        self.trace_state = (jax.core.get_opaque_trace_state()
+        from paddle_tpu.jit.cond_capture import opaque_trace_state
+        self.trace_state = (opaque_trace_state()
                             if mode == "replay" else None)
 
 
@@ -122,7 +123,8 @@ def resolve_numpy(value):
     site = ctx.cursor
     ctx.cursor += 1
     if isinstance(value, jax.core.Tracer):
-        if jax.core.get_opaque_trace_state() != ctx.trace_state:
+        from paddle_tpu.jit.cond_capture import opaque_trace_state
+        if opaque_trace_state() != ctx.trace_state:
             raise ConcMismatch(
                 "concretization inside a nested traced region (lax.cond "
                 "branch / loop body) cannot be guard-specialized")

@@ -45,7 +45,16 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["explore", "resolve_traced_bool", "CaptureOverflow",
-           "CaptureMismatch", "Fork"]
+           "CaptureMismatch", "Fork", "opaque_trace_state"]
+
+
+def opaque_trace_state():
+    """jax.core.get_opaque_trace_state grew a required ``convention``
+    argument (which it ignores) in newer jax; accept both signatures."""
+    try:
+        return jax.core.get_opaque_trace_state()
+    except TypeError:
+        return jax.core.get_opaque_trace_state(convention="flax")
 
 
 class Fork(Exception):
@@ -77,7 +86,7 @@ class CaptureContext:
         # identity of the trace explore() runs under: bool sites hit in a
         # DEEPER trace (a lax.cond branch / loop body) cannot be captured
         # here — their predicate tracer would be dead at our combine level
-        self.trace_state = jax.core.get_opaque_trace_state()
+        self.trace_state = opaque_trace_state()
 
 
 _stack: List[CaptureContext] = []
@@ -94,7 +103,7 @@ def resolve_traced_bool(value) -> bool:
     if aval is None or getattr(aval, "size", None) != 1:
         return None
     ctx = _stack[-1]
-    if jax.core.get_opaque_trace_state() != ctx.trace_state:
+    if opaque_trace_state() != ctx.trace_state:
         # nested traced region: fall through to the ordinary
         # concretization error -> to_static graph-breaks cleanly
         return None
@@ -180,7 +189,10 @@ def explore(thunk: Callable[[], Any], max_paths: int = 16,
                     "during capture and this backend has no host "
                     "callbacks for the runtime bound check")
             stat_add("to_static_while_truncations")
-            return ("trunc", pred, build(prefix + [False], spine))
+            # the forced False is a loop EXIT at this site too: reset its
+            # spine count so a later sequential loop at the same site gets
+            # a fresh iteration budget instead of truncating at iter 0
+            return ("trunc", pred, build(prefix + [False], {**spine, site: 0}))
         stat_add("to_static_cond_captures")
         # True extends this site's spine; False is a loop EXIT at this
         # site — reset its count so a later, sequential loop at the same

@@ -26,6 +26,16 @@ def _is_tensor_leaf(x):
     return isinstance(x, Tensor)
 
 
+def _aval_key(vals) -> tuple:
+    """Shape/dtype signature of the flat argument list. Trace-time
+    metadata (treedef/n_out/buf_names/guard_idx) is stored PER aval key:
+    jax.jit keeps one compiled entry per input avals, but the metadata
+    cell is a plain dict written only on (re)trace — under alternating
+    shapes a cached-shape call would otherwise read the OTHER shape's
+    stale guard count and slice its outputs wrong (ADVICE r5, medium)."""
+    return tuple((tuple(v.shape), jnp.dtype(v.dtype).name) for v in vals)
+
+
 class StaticFunction:
     """Callable wrapping a fn/Layer with capture-compile-cache semantics.
 
@@ -79,6 +89,7 @@ class StaticFunction:
         def body(flat_args, key):
             state_vals = flat_args[:n_state]
             arg_vals = flat_args[n_state:]
+            sub = cell.setdefault(_aval_key(flat_args), {})
             kwargs = dict(static_kwargs)
             rnd.push_trace_key(key)
             try:
@@ -101,17 +112,17 @@ class StaticFunction:
                                                       is_leaf=_is_tensor_leaf)
                     leaves, treedef = jax.tree_util.tree_flatten(out_vals)
                     buf_names = [n for n in state_names if n in new_buffers]
-                    if "treedef" in cell and cell["treedef"] != treedef:
+                    if "treedef" in sub and sub["treedef"] != treedef:
                         # branch-capture re-run produced a different output
                         # STRUCTURE (e.g. dict vs tuple) — leaves alone
                         # can't reveal this; bail to the eager fallback
                         raise CaptureMismatch(
                             "data-dependent branches returned different "
-                            f"pytree structures: {cell['treedef']} vs "
+                            f"pytree structures: {sub['treedef']} vs "
                             f"{treedef}")
-                    cell["treedef"] = treedef
-                    cell["n_out"] = len(leaves)
-                    cell["buf_names"] = buf_names
+                    sub["treedef"] = treedef
+                    sub["n_out"] = len(leaves)
+                    sub["buf_names"] = buf_names
                     return tuple(leaves) + tuple(new_buffers[n] for n in buf_names)
             finally:
                 rnd.pop_trace_key()
@@ -133,7 +144,7 @@ class StaticFunction:
             # treedef equality is only meaningful WITHIN one exploration
             # (a shape-specialized retrace may legitimately change the
             # output structure via static Python branching)
-            cell.pop("treedef", None)
+            cell.setdefault(_aval_key(flat_args), {}).pop("treedef", None)
             return explore(lambda: body(flat_args, key),
                            max_paths=flags.to_static_max_cond_paths,
                            max_while_iters=flags.to_static_max_while_iters)
@@ -151,11 +162,12 @@ class StaticFunction:
 
         def impl(*flat_args, key):
             from paddle_tpu.jit import conc_capture
-            cell.pop("treedef", None)
+            sub = cell.setdefault(_aval_key(flat_args), {})
+            sub.pop("treedef", None)
             ctx = conc_capture.ConcContext("replay", values=baked_values)
             with conc_capture.capture(ctx):
                 outs = body(flat_args, key)
-            cell["guard_idx"] = list(ctx.guard_idx)
+            sub["guard_idx"] = list(ctx.guard_idx)
             return tuple(outs) + tuple(ctx.guards)
 
         return impl
@@ -258,21 +270,23 @@ class StaticFunction:
             return self._call_broken(state, cache_key, args, kwargs,
                                      static_kwargs, training, state_names,
                                      state_tensors)
-        return self._finish_outputs(outs, cell)
+        akey = _aval_key([t._value for t in state_tensors + tensor_args])
+        return self._finish_outputs(outs, cell[akey])
 
-    def _finish_outputs(self, outs, cell: dict, n_guards: int = 0):
+    def _finish_outputs(self, outs, sub: dict, n_guards: int = 0):
         """Shared compiled-call epilogue: slice leaves/buffers(/guards),
-        write mutated buffers back, unflatten the user pytree."""
+        write mutated buffers back, unflatten the user pytree. ``sub`` is
+        THIS call's per-aval trace metadata (see ``_aval_key``)."""
         if not isinstance(outs, tuple):
             outs = (outs,)
-        n_out = cell["n_out"]
+        n_out = sub["n_out"]
         end = len(outs) - n_guards
         buf_outs = outs[n_out:end]
         if self._layer is not None and buf_outs:
             buffers = dict(self._layer.named_buffers())
-            for name, v in zip(cell["buf_names"], buf_outs):
+            for name, v in zip(sub["buf_names"], buf_outs):
                 buffers[name]._set_value(v._value)
-        return jax.tree_util.tree_unflatten(cell["treedef"],
+        return jax.tree_util.tree_unflatten(sub["treedef"],
                                             list(outs[:n_out]))
 
     def _call_broken(self, state: dict, cache_key, args, kwargs,
@@ -306,22 +320,30 @@ class StaticFunction:
                     jax.errors.TracerArrayConversionError,
                     CaptureOverflow, CaptureMismatch):
                 # replay trace failed (non-deterministic concretization
-                # sequence, nested break, ...): drop the spec for good.
-                # Anything else (user error, OOM) propagates untouched.
+                # sequence, nested break, ...): drop THIS spec and count
+                # it toward the guard-miss window — a single shape-driven
+                # mismatch must not pin the whole cache key to eager
+                # forever (ADVICE r5); the miss-limit/budget paths decide
+                # permanence. Anything else (user error, OOM) propagates
+                # untouched.
                 state["specs"].pop()
-                state["permanent"] = True
+                state["misses"] = state.get("misses", 0) + 1
+                if state["misses"] >= flags.to_static_guard_miss_limit:
+                    state["permanent"] = True
             else:
                 if not isinstance(outs, tuple):
                     outs = (outs,)
-                cell = spec["cell"]
-                n_guards = len(cell["guard_idx"])
+                akey = _aval_key(
+                    [t._value for t in state_tensors + tensor_args])
+                sub = spec["cell"][akey]
+                n_guards = len(sub["guard_idx"])
                 guard_outs = outs[len(outs) - n_guards:] if n_guards else ()
-                baked = [spec["values"][i] for i in cell["guard_idx"]]
+                baked = [spec["values"][i] for i in sub["guard_idx"]]
                 if all(np.array_equal(np.asarray(g._value), b)
                        for g, b in zip(guard_outs, baked)):
                     stat_add("to_static_partial_compiled_calls")
                     state["misses"] = 0
-                    return self._finish_outputs(outs, cell, n_guards)
+                    return self._finish_outputs(outs, sub, n_guards)
                 stat_add("to_static_guard_misses")
                 state["misses"] = state.get("misses", 0) + 1
                 if state["misses"] >= flags.to_static_guard_miss_limit:

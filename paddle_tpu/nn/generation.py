@@ -21,24 +21,24 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
                     top_k: Optional[int] = None, top_p: Optional[float] = None,
                     seed: int = 0) -> np.ndarray:
     """Autoregressive decode by re-running the full forward per token
-    (no-cache fallback; O(S^2) per sequence). Greedy or sampled."""
-    import jax
-    import jax.numpy as jnp
+    (no-cache fallback; O(S^2) per sequence). Greedy or sampled.
 
-    import paddle_tpu as paddle
-    from paddle_tpu.autograd import tape
-    from paddle_tpu.inference.generate import _sample_logits
+    For ``nn.Layer`` models the whole token loop runs as ONE compiled
+    device dispatch (a ``lax.scan`` over a padded id buffer — sound for
+    causal LMs, whose logits at position i ignore positions > i); the
+    per-token host loop remains for duck-typed non-Layer callables and as
+    the ``decode_fallback``-flag debugging path."""
+    from paddle_tpu.inference.generate import decode_fallback_active
 
     ids = np.asarray(input_ids)
-    B = ids.shape[0]
     max_pos = getattr(getattr(model, "config", None),
                       "max_position_embeddings", None)
     if max_pos is not None and ids.shape[1] + max_new_tokens > max_pos:
         raise ValueError(
             f"prompt {ids.shape[1]} + {max_new_tokens} new tokens exceeds "
             f"max_position_embeddings {max_pos}")
-    key = jax.random.key(seed)
-    done = np.zeros((B,), bool)
+    if max_new_tokens <= 0:
+        return ids
     # per-sublayer snapshot: a blanket model.train() on exit would clobber
     # submodules the user deliberately froze with sub.eval(). Models are
     # duck-typed (any callable with forward(ids)->logits): no Layer, no-op.
@@ -46,25 +46,145 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
     if hasattr(model, "eval"):
         model.eval()  # deterministic decode: no live dropout
     try:
-        with tape.no_grad():
-            for _ in range(max_new_tokens):
-                logits = model(paddle.to_tensor(ids)).value[:, -1].astype(
-                    jnp.float32)
-                if do_sample:
-                    key, sub = jax.random.split(key)
-                    nxt = np.asarray(_sample_logits(logits, sub, temperature,
-                                                    top_k, top_p))
-                else:
-                    nxt = np.asarray(jnp.argmax(logits, axis=-1))
-                nxt = nxt.astype(ids.dtype)
-                if eos_token_id is not None:
-                    nxt = np.where(done, eos_token_id, nxt)
-                    done |= nxt == eos_token_id
-                ids = np.concatenate([ids, nxt[:, None]], axis=1)
-                if eos_token_id is not None and done.all():
-                    break
+        if hasattr(model, "state_dict") and not decode_fallback_active():
+            import jax
+            try:
+                return _generate_tokens_fused(model, ids, max_new_tokens,
+                                              eos_token_id, do_sample,
+                                              temperature, top_k, top_p,
+                                              seed)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError):
+                # forward has data-dependent Python control flow and can't
+                # trace into the one-dispatch scan: the per-token loop is
+                # always correct (numeric errors propagate untouched)
+                pass
+        return _generate_tokens_per_token(model, ids, max_new_tokens,
+                                          eos_token_id, do_sample,
+                                          temperature, top_k, top_p, seed)
     finally:
         mode_restore(snap)
+
+
+def _generate_tokens_fused(model, ids, max_new_tokens, eos_token_id,
+                           do_sample, temperature, top_k, top_p, seed):
+    """One-dispatch decode for an eager Layer: scan over a statically
+    shaped (B, S+N) id buffer, forwarding the whole buffer each step and
+    reading the logits row at the current length (causal models ignore
+    the not-yet-written tail). N forwards like the host loop, but zero
+    host round-trips; parameters are lifted to inputs (functional_call),
+    so the compiled program is shared across weight updates."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.inference.generate import _sample_from, _trim_after_eos
+    from paddle_tpu.nn.utils import functional_call
+
+    state = dict(model.state_dict())
+    for name, b in model.named_buffers():
+        state.setdefault(name, b)
+    names = tuple(state.keys())
+    vals = tuple(state[n].value for n in names)
+    B, S = ids.shape
+
+    jitted = getattr(model, "_ptpu_fused_generate", None)
+    if jitted is None or getattr(model, "_ptpu_fused_generate_names",
+                                 None) != names:
+        def decode(state_vals, buf, pos0, key0, done0, eos_id, steps: int,
+                   do_sample: bool, use_eos: bool, temperature: float,
+                   top_k, top_p):
+            st = dict(zip(names, state_vals))
+
+            def pick(logits, key, done):
+                if do_sample:
+                    key, sub = jax.random.split(key)
+                    tok = _sample_from(logits, sub, temperature, top_k,
+                                       top_p).astype(jnp.int32)
+                else:
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                if use_eos:
+                    tok = jnp.where(done, eos_id, tok)
+                    done = jnp.logical_or(done, tok == eos_id)
+                return tok, key, done
+
+            def body(carry, _):
+                buf, pos, key, done = carry
+                with tape.no_grad():
+                    out, _ = functional_call(model, st, (Tensor(buf),), {})
+                lg = (out._value if isinstance(out, Tensor)
+                      else jnp.asarray(out))
+                logits = jax.lax.dynamic_slice_in_dim(
+                    lg, pos - 1, 1, axis=1)[:, 0].astype(jnp.float32)
+                tok, key, done = pick(logits, key, done)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, tok[:, None].astype(buf.dtype),
+                    (jnp.asarray(0, pos.dtype), pos))
+                return (buf, pos + 1, key, done), tok
+
+            (_, _, _, _), toks = jax.lax.scan(
+                body, (buf, pos0, key0, done0), None, length=steps)
+            return jnp.moveaxis(toks, 0, 1)
+
+        jitted = jax.jit(decode, static_argnames=(
+            "steps", "do_sample", "use_eos", "temperature", "top_k",
+            "top_p"))
+        model._ptpu_fused_generate = jitted
+        model._ptpu_fused_generate_names = names
+
+    buf = jnp.zeros((B, S + max_new_tokens), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, jnp.asarray(ids, jnp.int32),
+                                       (0, 0))
+    key = jax.random.PRNGKey(seed)
+    done = jnp.zeros((B,), jnp.bool_)
+    eos = jnp.asarray(0 if eos_token_id is None else int(eos_token_id),
+                      jnp.int32)
+    toks = jitted(vals, buf, jnp.asarray(S, jnp.int32), key, done, eos,
+                  steps=max_new_tokens, do_sample=bool(do_sample),
+                  use_eos=eos_token_id is not None,
+                  temperature=float(temperature),
+                  top_k=None if top_k is None else int(top_k),
+                  top_p=None if top_p is None else float(top_p))
+    toks = np.asarray(toks)
+    if eos_token_id is not None:
+        toks = _trim_after_eos(toks, int(eos_token_id))
+    return np.concatenate([ids, toks.astype(ids.dtype)], axis=1)
+
+
+def _generate_tokens_per_token(model, ids, max_new_tokens, eos_token_id,
+                               do_sample, temperature, top_k, top_p, seed):
+    """Per-token host loop (one forward + host sync per token): serves
+    duck-typed non-Layer models and the decode_fallback debugging flag."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.inference.generate import _sample_logits
+
+    B = ids.shape[0]
+    key = jax.random.key(seed)
+    done = np.zeros((B,), bool)
+    with tape.no_grad():
+        for _ in range(max_new_tokens):
+            logits = model(paddle.to_tensor(ids)).value[:, -1].astype(
+                jnp.float32)
+            if do_sample:
+                key, sub = jax.random.split(key)
+                nxt = np.asarray(_sample_logits(logits, sub, temperature,
+                                                top_k, top_p))
+            else:
+                nxt = np.asarray(jnp.argmax(logits, axis=-1))
+            nxt = nxt.astype(ids.dtype)
+            if eos_token_id is not None:
+                nxt = np.where(done, eos_token_id, nxt)
+                done |= nxt == eos_token_id
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+            if eos_token_id is not None and done.all():
+                break
     return ids
 
 

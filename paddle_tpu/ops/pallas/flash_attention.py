@@ -23,6 +23,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.framework.jax_compat import (
+    pallas_tpu_compiler_params as _compiler_params,
+)
+
 __all__ = ["flash_attention_op", "flash_attention_fn"]
 
 DEFAULT_BLOCK_Q = 128
@@ -146,7 +150,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
                        pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0))],
             out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
                        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
         )(q, k, v)
@@ -177,7 +181,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -313,7 +317,7 @@ def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -343,7 +347,7 @@ def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)

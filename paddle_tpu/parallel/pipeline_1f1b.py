@@ -39,7 +39,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.framework.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import ProcessMesh

@@ -249,8 +249,8 @@ def test_decoder_generate_batch_padding(tmp_path):
 
 def test_decoder_bundle_multi_batch_and_limits(tmp_path):
     """Review fixes: every exported batch size is servable (per-B cache
-    metadata), max_len overflow raises, and eos via the predictor raises
-    NotImplementedError instead of silently diverging."""
+    metadata), max_len overflow raises, and eos via the predictor serves
+    the fused device-side stop (it used to raise NotImplementedError)."""
     from paddle_tpu.inference import AotPredictor, Config, \
         create_predictor, export_decoder_bundle
     from paddle_tpu.inference.generate import LlamaDecoder
@@ -285,6 +285,111 @@ def test_decoder_bundle_multi_batch_and_limits(tmp_path):
     c = Config()
     c.set_aot_bundle(bdir)
     p = create_predictor(c)
-    with pytest.raises(NotImplementedError):
-        p.generate(np.zeros((1, 4), np.int64), max_new_tokens=5,
-                   eos_token_id=2)
+    # eos through the Config/Predictor surface rides the fused device-side
+    # stop: exact parity with the in-process decoder
+    ids3 = rng.integers(0, 64, (1, 4)).astype(np.int64)
+    eos = int(dec.generate(ids3, max_new_tokens=5)[0, -2])
+    np.testing.assert_array_equal(
+        p.generate(ids3, max_new_tokens=5, eos_token_id=eos),
+        dec.generate(ids3, max_new_tokens=5, eos_token_id=eos))
+
+
+def test_padded_run_preserves_non_batch_output(tmp_path):
+    """ADVICE r6 (low): in the nearest-bucket padded run() path, a
+    NON-batch output whose leading dim coincidentally equals the padded
+    batch must not be trimmed — the exporter records which outputs are
+    batch-major (abstract re-trace at a second batch) and run() trims
+    only those."""
+    from paddle_tpu.inference import AotPredictor, export_predict_bundle
+
+    NB = 8  # the only bucket: non-batch output's leading dim == NB
+
+    class WithTable(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            # a (NB, 3) parameter returned AS-IS: not batch-major, but its
+            # leading dim equals the padded bucket batch
+            self.table = self.create_parameter(
+                [NB, 3], default_initializer=nn.initializer.Constant(2.0))
+
+        def forward(self, x):
+            return self.fc(x), self.table * 1.0
+
+    paddle.seed(0)
+    net = WithTable()
+    net.eval()
+    x8 = np.random.default_rng(0).standard_normal((NB, 4)).astype(np.float32)
+    bdir = str(tmp_path / "bundle")
+    export_predict_bundle(net, [x8], bdir, input_names=["x"],
+                          output_names=["y", "table"])
+    meta = json.load(open(os.path.join(bdir, "bundle.json")))
+    assert meta["output_batch_major"] == [True, False]
+
+    pred = AotPredictor(bdir)
+    x3 = x8[:3]
+    out = pred.run({"x": x3})                    # pads 3 -> 8
+    assert pred.padded_calls == 1
+    assert out["y"].shape == (3, 4)              # batch output trimmed
+    assert out["table"].shape == (NB, 3)         # non-batch PRESERVED
+    np.testing.assert_allclose(out["table"], np.full((NB, 3), 2.0))
+    ref = net(paddle.to_tensor(x3))[0].numpy()
+    np.testing.assert_allclose(out["y"], ref, rtol=1e-5, atol=1e-6)
+
+    # a legacy bundle (no batch-axis metadata) must refuse padded serving
+    # instead of guessing
+    meta.pop("output_batch_major")
+    json.dump(meta, open(os.path.join(bdir, "bundle.json"), "w"))
+    legacy = AotPredictor(bdir)
+    with pytest.raises(ValueError, match="batch-axis metadata"):
+        legacy.run({"x": x3})
+    # exact-shape serving still fine
+    assert legacy.run({"x": x8})["y"].shape == (NB, 4)
+
+
+def test_decoder_bundle_sampled_and_eos_fused(tmp_path):
+    """Fused-decode bundle entries: eos id + RNG key are runtime inputs
+    (one entry serves any eos/seed), sampling statics are baked at export
+    and enforced; outputs match the in-process fused decoder exactly."""
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64))
+    dec = LlamaDecoder(model, max_len=32)
+    prompt = np.random.default_rng(0).integers(0, 64, (2, 5))
+
+    sdir = str(tmp_path / "sampled")
+    export_decoder_bundle(dec, sdir, prompt_lens=[5], decode_steps=[9],
+                          batch_sizes=[2], do_sample=True,
+                          temperature=0.8, top_k=8)
+    pred = AotPredictor(sdir)
+    meta = json.load(open(os.path.join(sdir, "bundle.json")))
+    assert meta["decode_mode"]["do_sample"] is True
+
+    out = pred.generate(prompt, max_new_tokens=10, do_sample=True, seed=3)
+    ref = dec.generate(prompt, max_new_tokens=10, do_sample=True,
+                       temperature=0.8, top_k=8, seed=3)
+    np.testing.assert_array_equal(out, ref)
+    # a different seed diverges through the SAME exported module
+    out2 = pred.generate(prompt, max_new_tokens=10, do_sample=True, seed=4)
+    assert not np.array_equal(out, out2)
+    # greedy request against a sampled bundle is a contract violation
+    with pytest.raises(ValueError, match="do_sample"):
+        pred.generate(prompt, max_new_tokens=4)
+
+    # eos as a runtime input on a GREEDY fused bundle: early rows freeze,
+    # output trimmed exactly like the in-process path
+    gdir = str(tmp_path / "greedy")
+    export_decoder_bundle(dec, gdir, prompt_lens=[5], decode_steps=[9],
+                          batch_sizes=[2])
+    pg = AotPredictor(gdir)
+    free = dec.generate(prompt, max_new_tokens=10)
+    eos = int(free[0, 6])                 # forces an early stop in row 0
+    out_e = pg.generate(prompt, max_new_tokens=10, eos_token_id=eos)
+    ref_e = dec.generate(prompt, max_new_tokens=10, eos_token_id=eos)
+    np.testing.assert_array_equal(out_e, ref_e)

@@ -291,3 +291,115 @@ def test_per_layer_cache_layout_parity():
             LlamaDecoder(model, max_len=24).generate(prompt, max_new_tokens=2)
     finally:
         flags.decode_cache_layout = "stacked"
+
+
+def _with_fallback(fn):
+    """Run fn under the per-token fallback flag (the debugging path the
+    fused decode is verified against)."""
+    from paddle_tpu.flags import flags
+    flags.decode_fallback = True
+    try:
+        return fn()
+    finally:
+        flags.decode_fallback = False
+
+
+def test_every_decode_mode_is_one_fused_dispatch():
+    """Tentpole acceptance: greedy, greedy+eos, sampled and sampled+eos
+    each execute the whole token loop in ONE device dispatch after the
+    prefill (dispatch_count counts jit executions via a wrapper), and for
+    a fixed seed every mode matches the per-token fallback path exactly —
+    including the eos early-stop output length."""
+    model = _model(5)
+    dec = LlamaDecoder(model, max_len=32)
+    prompt = np.array([[1, 2, 3], [4, 5, 6]])
+    # an eos that actually fires early in row 0 (from the free-run tokens)
+    eos = int(dec.generate(prompt, max_new_tokens=12)[0, 5])
+
+    cases = [
+        dict(),
+        dict(eos_token_id=eos),
+        dict(do_sample=True, temperature=0.8, top_k=8, seed=1),
+        dict(do_sample=True, top_p=0.9, seed=3, eos_token_id=eos),
+    ]
+    for kw in cases:
+        d0 = dec.dispatch_count
+        fused = dec.generate(prompt, max_new_tokens=12, **kw)
+        assert dec.dispatch_count - d0 == 2, \
+            f"{kw}: expected prefill + one fused decode dispatch"
+        ref = _with_fallback(
+            lambda: dec.generate(prompt, max_new_tokens=12, **kw))
+        assert fused.shape == ref.shape, kw
+        np.testing.assert_array_equal(fused, ref, err_msg=str(kw))
+    # the trim is actually exercised: a single row that hits eos early
+    # yields a SHORTER output than max_new_tokens allows
+    out_eos = dec.generate(prompt[:1], max_new_tokens=12, eos_token_id=eos)
+    assert out_eos.shape[1] < 15
+    ref_eos = _with_fallback(
+        lambda: dec.generate(prompt[:1], max_new_tokens=12,
+                             eos_token_id=eos))
+    np.testing.assert_array_equal(out_eos, ref_eos)
+
+    # fallback really is per-token: many dispatches, not 2
+    d0 = dec.dispatch_count
+    _with_fallback(lambda: dec.generate(prompt, max_new_tokens=6,
+                                        do_sample=True, seed=0))
+    assert dec.dispatch_count - d0 > 2
+
+
+def test_fused_decode_zero_retrace_across_calls_and_seeds():
+    """Seeds/eos ids are runtime inputs: repeat generates with different
+    seeds and eos values reuse the SAME compiled fused program (zero new
+    traces), per decode mode."""
+    model = _model(6)
+    dec = LlamaDecoder(model, max_len=32)
+    prompt = np.array([[1, 2, 3]])
+    dec.generate(prompt, max_new_tokens=8, do_sample=True, seed=0)
+    dec.generate(prompt, max_new_tokens=8, eos_token_id=5)
+    t0 = dec.trace_count
+    dec.generate(prompt, max_new_tokens=8, do_sample=True, seed=7)
+    dec.generate(prompt, max_new_tokens=8, do_sample=True, seed=8)
+    dec.generate(prompt, max_new_tokens=8, eos_token_id=9)
+    assert dec.trace_count == t0
+
+
+def test_generate_tokens_fused_one_dispatch_and_parity():
+    """nn.generation.generate_tokens on a Layer model: the whole no-cache
+    token loop compiles into one dispatch (model.forward is never invoked
+    after the first trace) and matches the per-token loop exactly."""
+    from paddle_tpu.nn.generation import generate_tokens
+
+    model = _model(7)
+    prompt = np.array([[1, 2, 3], [7, 8, 9]])
+
+    def both(kw):
+        fused = generate_tokens(model, prompt, max_new_tokens=6, **kw)
+        from paddle_tpu.flags import flags
+        flags.decode_fallback = True
+        try:
+            ref = generate_tokens(model, prompt, max_new_tokens=6, **kw)
+        finally:
+            flags.decode_fallback = False
+        assert fused.shape == ref.shape, kw
+        np.testing.assert_array_equal(fused, ref, err_msg=str(kw))
+        return fused
+
+    both(dict())
+    free = both(dict(do_sample=True, temperature=0.8, top_k=8, seed=2))
+    eos = int(free[0, 4])
+    both(dict(eos_token_id=eos))
+
+    # compiled: a repeat call at the same shapes never invokes forward
+    calls = {"n": 0}
+    orig = model.forward
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    model.forward = counting
+    try:
+        generate_tokens(model, prompt, max_new_tokens=6)
+    finally:
+        model.forward = orig
+    assert calls["n"] == 0, "fused generate_tokens re-ran the eager forward"

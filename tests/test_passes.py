@@ -30,7 +30,8 @@ def test_fuse_rms_norm_matches_and_preserves_numerics():
 
     j = jax.make_jaxpr(fast)(x, w)
     names = [e.primitive.name for e in j.jaxpr.eqns]
-    assert names == ["custom_vjp_call"], names
+    # primitive spelled custom_vjp_call_jaxpr on older jax
+    assert len(names) == 1 and names[0].startswith("custom_vjp_call"), names
     assert rule.hits >= 1
 
     ref, got = _user_rms(x, w), fast(x, w)
@@ -80,7 +81,7 @@ def test_fuse_rms_norm_rejects_wrong_axis_and_wrong_divisor():
 
     for fn in (wrong_axis, wrong_divisor):
         j = jax.make_jaxpr(P.rewrite(fn, [P.fuse_rms_norm_rule()]))(x, w)
-        assert not any(e.primitive.name == "custom_vjp_call"
+        assert not any(e.primitive.name.startswith("custom_vjp_call")
                        for e in j.jaxpr.eqns)
 
 
@@ -97,7 +98,7 @@ def test_fuse_rms_norm_rejects_per_row_weight_broadcast():
 
     fast = P.rewrite(per_row, [P.fuse_rms_norm_rule()])
     j = jax.make_jaxpr(fast)(x, w)
-    assert not any(e.primitive.name == "custom_vjp_call"
+    assert not any(e.primitive.name.startswith("custom_vjp_call")
                    for e in j.jaxpr.eqns)
     np.testing.assert_allclose(np.asarray(fast(x, w)),
                                np.asarray(per_row(x, w)),
@@ -125,7 +126,7 @@ def test_fuse_applies_inside_jit_and_scan():
     j = jax.make_jaxpr(fast)(x, w)
     scan_eqn = next(e for e in j.jaxpr.eqns if e.primitive.name == "scan")
     body_prims = [e.primitive.name for e in scan_eqn.params["jaxpr"].jaxpr.eqns]
-    assert "custom_vjp_call" in body_prims, body_prims
+    assert any(pn.startswith("custom_vjp_call") for pn in body_prims), body_prims
 
 
 def test_amp_cast_pass_bf16_matmul_keeps_f32_output():
