@@ -757,10 +757,14 @@ def bench_decode_modes():
     """``--decode``: the fused one-dispatch decode microbenchmark.
 
     Measures tokens/s AND device-dispatch count per generate call for
-    greedy / greedy+eos / sampled at several batch sizes (the dispatch
-    count is the fused path's headline property: 2 = prefill + one fused
-    token loop, vs ~N+1 for the per-token fallback). The full breakdown
-    rides in the emitted BENCH json line under "decode"."""
+    greedy / greedy+eos / sampled / speculative at several batch sizes
+    (the dispatch count is the fused path's headline property: 2 =
+    prefill + one fused token loop — 3 for speculative, which adds the
+    draft prefill — vs ~N+1 for the per-token fallback). Speculative
+    rows additionally report the mean accepted-draft count per verify
+    step (``acceptance_len_mean``); every row carries
+    ``tokens_per_dispatch``. The full breakdown rides in the emitted
+    BENCH json line under "decode"."""
     import numpy as np
 
     import jax
@@ -775,25 +779,33 @@ def bench_decode_modes():
                           num_attention_heads=12, num_key_value_heads=12,
                           max_position_embeddings=1024, dtype="bfloat16")
         batches, prompt_len, n_new, reps = (1, 8, 32), 128, 96, 3
+        spec_draft, spec_k = "skip:3", 4
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=4,
                           max_position_embeddings=256)
         batches, prompt_len, n_new, reps = (1, 2), 8, 8, 2
+        spec_draft, spec_k = "skip:1", 2
     model = LlamaForCausalLM(cfg)
     if on_tpu:
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
-    dec = LlamaDecoder(model, max_len=prompt_len + n_new + 1)
+    # + spec_k + 1 slack: speculative rounds overshoot by up to K slots
+    dec = LlamaDecoder(model, max_len=prompt_len + n_new + spec_k + 1)
     rng = np.random.default_rng(0)
     # an eos id no token can match: full-length decode, measuring the
     # eos-enabled program's overhead rather than a data-dependent stop
     never_eos = -2
+    spec_kw = {"draft_model": spec_draft,
+               "num_speculative_tokens": spec_k}
     modes = [("greedy", {}),
              ("greedy_eos", {"eos_token_id": never_eos}),
              ("sampled", {"do_sample": True, "temperature": 0.8,
-                          "top_k": 40, "seed": 0})]
+                          "top_k": 40, "seed": 0}),
+             ("spec_greedy", dict(spec_kw)),
+             ("spec_sampled", {"do_sample": True, "temperature": 0.8,
+                               "top_k": 40, "seed": 0, **spec_kw})]
     rows = {}
     for B in batches:
         prompt = rng.integers(0, cfg.vocab_size, (B, prompt_len))
@@ -804,21 +816,31 @@ def bench_decode_modes():
             for _ in range(reps):
                 dec.generate(prompt, max_new_tokens=n_new, **kw)
             dt = time.perf_counter() - t0
-            rows[f"{name}_b{B}"] = {
+            disp = (dec.dispatch_count - d0) // reps
+            row = {
                 "tokens_per_sec": round(B * n_new * reps / dt, 1),
                 "ms_per_token": round(dt / reps / n_new * 1e3, 3),
-                "dispatches_per_generate":
-                    (dec.dispatch_count - d0) // reps,
+                "dispatches_per_generate": disp,
+                "tokens_per_dispatch": round(n_new / disp, 2),
             }
+            if name.startswith("spec_"):
+                row["acceptance_len_mean"] = round(
+                    dec.last_spec_stats["acceptance_len_mean"], 3)
+                row["num_speculative_tokens"] = spec_k
+            rows[f"{name}_b{B}"] = row
+            extra = (f", accept {row['acceptance_len_mean']:.2f}/{spec_k}"
+                     if name.startswith("spec_") else "")
             print(f"decode[{name} B={B}]: "
-                  f"{rows[f'{name}_b{B}']['tokens_per_sec']:.0f} tok/s, "
-                  f"{rows[f'{name}_b{B}']['dispatches_per_generate']} "
-                  f"dispatches/generate", file=sys.stderr)
+                  f"{row['tokens_per_sec']:.0f} tok/s, "
+                  f"{row['dispatches_per_generate']} "
+                  f"dispatches/generate{extra}", file=sys.stderr)
     head = rows[f"sampled_b{batches[-1]}"]
     line = _emit("llama_sampled_fused_decode_tokens_per_sec",
                  head["tokens_per_sec"], "tokens/sec")
     line["decode"] = {"config": "134M" if on_tpu else "tiny-cpu",
-                      "new_tokens": n_new, "reps": reps, "modes": rows}
+                      "new_tokens": n_new, "reps": reps,
+                      "speculative": {"draft": spec_draft, "k": spec_k},
+                      "modes": rows}
     # re-print the enriched record as the LAST stdout line (the driver
     # parses the final json line; _emit already printed the bare metric)
     print(json.dumps(line))

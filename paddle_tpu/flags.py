@@ -157,6 +157,12 @@ define_flag("decode_fallback", False,
             "per token) instead of the one-dispatch fused scan decode — a "
             "debugging escape hatch; the PADDLE_TPU_DECODE_FALLBACK=1 "
             "environment variable is an equivalent switch")
+define_flag("decode_speculative_tokens", 4,
+            "default number of draft tokens proposed per speculative "
+            "verify step (K) when LlamaDecoder.generate is given a "
+            "draft_model without an explicit num_speculative_tokens; the "
+            "target scores all K+1 positions in one batched forward "
+            "inside the one-dispatch decode program")
 define_flag("decode_cache_layout", "stacked",
             "KV-cache layout for the compiled decoder: 'per_layer' "
             "(one (B, L, KV, D) buffer per layer) or 'stacked' "

@@ -170,23 +170,32 @@ class Predictor:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  max_len: int = 512, eos_token_id=None,
-                 do_sample: bool = False, temperature: float = 1.0,
-                 top_k=None, top_p=None, seed: int = 0) -> np.ndarray:
+                 do_sample: bool = False, temperature=None,
+                 top_k=None, top_p=None, seed: int = 0,
+                 draft_model=None, num_speculative_tokens=None
+                 ) -> np.ndarray:
         """Autoregressive decode with a compile-once KV cache
         (block_multi_head_attention capability analog; see
         inference/generate.py). Every mode — greedy/sampled, with or
-        without eos — runs the token loop as ONE fused device dispatch.
-        Only causal-LM layers with a Llama-style config are supported;
-        the decoder is cached on the predictor so repeated calls reuse
-        the compiled executables. AOT bundles take eos id and seed as
-        runtime inputs; their sampling statics were fixed at export
-        (``bundle.json``'s ``decode_mode``), so temperature/top_k/top_p
-        here apply to the in-process decoder only."""
+        without eos — runs the token loop as ONE fused device dispatch;
+        with ``draft_model`` it runs speculatively (draft proposes
+        ``num_speculative_tokens`` per target verify) still as one decode
+        dispatch after the prefills. AOT bundles take eos id, seed and
+        temperature as runtime inputs; ``do_sample``/``top_k``/``top_p``
+        — and any draft model — were fixed at export (``bundle.json``'s
+        ``decode_mode``), so pass ``draft_model`` to
+        ``export_decoder_bundle`` rather than here when serving AOT."""
         if self._aot is not None:
+            if draft_model is not None or num_speculative_tokens is not None:
+                raise ValueError(
+                    "AOT bundles bake the draft model at export time; "
+                    "pass draft_model to export_decoder_bundle, not to "
+                    "generate()")
             return self._aot.generate(input_ids,
                                       max_new_tokens=max_new_tokens,
                                       eos_token_id=eos_token_id,
-                                      do_sample=do_sample, seed=seed)
+                                      do_sample=do_sample,
+                                      temperature=temperature, seed=seed)
         from paddle_tpu.inference.generate import LlamaDecoder
         dec = getattr(self, "_decoder", None)
         if dec is None or dec.max_len < max_len:
@@ -194,8 +203,11 @@ class Predictor:
             self._decoder = dec
         return dec.generate(input_ids, max_new_tokens=max_new_tokens,
                             eos_token_id=eos_token_id, do_sample=do_sample,
-                            temperature=temperature, top_k=top_k,
-                            top_p=top_p, seed=seed)
+                            temperature=(1.0 if temperature is None
+                                         else temperature),
+                            top_k=top_k, top_p=top_p, seed=seed,
+                            draft_model=draft_model,
+                            num_speculative_tokens=num_speculative_tokens)
 
 
 def create_predictor(config: Config) -> Predictor:

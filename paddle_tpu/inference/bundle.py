@@ -128,7 +128,10 @@ def export_decoder_bundle(decoder, out_dir: str,
                           do_sample: bool = False,
                           temperature: float = 1.0,
                           top_k: Optional[int] = None,
-                          top_p: Optional[float] = None) -> None:
+                          top_p: Optional[float] = None,
+                          draft_model=None,
+                          num_speculative_tokens: Optional[int] = None
+                          ) -> None:
     """Export a ``LlamaDecoder`` as prefill + fused scan-decode AOT
     entries (the compiled-decode serving artifact the reference ships via
     its generation ops + AnalysisPredictor). One prefill module per
@@ -136,27 +139,61 @@ def export_decoder_bundle(decoder, out_dir: str,
     are donated so serving decodes in place.
 
     Decode entries run the SAME one-dispatch fused loop the in-process
-    decoder uses: the eos id and the jax.random key are runtime inputs
-    (one entry serves any eos — pass eos=-1 for "none" — and any seed);
-    the sampling mode (``do_sample``/``temperature``/``top_k``/``top_p``)
-    is static, baked at export and recorded in the bundle metadata."""
+    decoder uses: the eos id, the jax.random key AND the temperature are
+    runtime inputs (one entry serves any eos — pass eos=-1 for "none" —
+    any seed and any temperature); ``do_sample``/``top_k``/``top_p``
+    change program structure, are baked at export and recorded in the
+    bundle metadata (``decode_mode``; the export-time ``temperature``
+    is recorded as ``default_temperature`` for callers that don't pass
+    one).
+
+    With ``draft_model`` (a LlamaForCausalLM or ``'skip:N'``; see
+    ``LlamaDecoder.generate``) the decode entries are SPECULATIVE: the
+    bundle additionally carries ``draft_prefill_b{B}_s{S}.aot`` entries
+    and draft cache metadata, each decode entry takes both cache pairs
+    and returns (tokens, rounds, accepted), and ``decode_mode``
+    records the speculation statics. For speculative buckets ``N`` is
+    the OUTPUT BUFFER size (serves max_new_tokens <= N); plain buckets
+    keep the scan-steps meaning (serves max_new_tokens <= N + 1)."""
     import jax
     import jax.numpy as jnp
 
     os.makedirs(out_dir, exist_ok=True)
     cfg = decoder.cfg
     p = decoder.params
-    prefills, decodes = [], []
-    caches = {}
+    eng, K = None, None
+    if draft_model is not None:
+        from paddle_tpu.flags import flags
+        eng = decoder._spec_engine(draft_model)
+        K = int(num_speculative_tokens if num_speculative_tokens is not None
+                else flags.decode_speculative_tokens)
+        if K < 1:
+            raise ValueError(f"num_speculative_tokens must be >= 1, got {K}")
+        worst = max(prompt_lens) + max(decode_steps) + K
+        if worst > decoder.max_len:
+            raise ValueError(
+                f"speculative buckets can overshoot the cache by up to "
+                f"K={K} slots: prompt {max(prompt_lens)} + buffer "
+                f"{max(decode_steps)} + {K} exceeds max_len "
+                f"{decoder.max_len}")
+    elif num_speculative_tokens is not None:
+        raise ValueError("num_speculative_tokens requires a draft_model")
+    prefills, dprefills, decodes = [], [], []
+    caches, dcaches = {}, {}
+
+    def _cache_meta(kc):
+        leaves = jax.tree_util.tree_leaves(kc)
+        return {"shape": list(leaves[0].shape),
+                "n_buffers": len(leaves),
+                "dtype": str(leaves[0].dtype),
+                "layout": "stacked" if len(leaves) == 1 else "per_layer"}
+
     for B in batch_sizes:
         kc, vc = decoder._empty_cache(int(B))
-        leaves = jax.tree_util.tree_leaves(kc)
-        caches[str(int(B))] = {
-            "shape": list(leaves[0].shape),
-            "n_buffers": len(leaves),
-            "dtype": str(leaves[0].dtype),
-            "layout": "stacked" if len(leaves) == 1 else "per_layer",
-        }
+        caches[str(int(B))] = _cache_meta(kc)
+        if eng is not None:
+            dkc, dvc = decoder._empty_cache(int(B), eng["cfg"])
+            dcaches[str(int(B))] = _cache_meta(dkc)
         for S in prompt_lens:
             ids = jnp.zeros((int(B), int(S)), jnp.int32)
 
@@ -169,6 +206,16 @@ def export_decoder_bundle(decoder, out_dir: str,
                       donate_argnums=(1, 2))
             prefills.append({"file": tag + ".aot", "batch": int(B),
                              "seq": int(S)})
+            if eng is not None:
+                def dprefill(ids, dkc, dvc):
+                    return eng["prefill"](eng["params"], ids, dkc, dvc)
+
+                dtag = f"draft_prefill_b{B}_s{S}"
+                _save_exp(dprefill, (ids, dkc, dvc),
+                          os.path.join(out_dir, dtag + ".aot"),
+                          donate_argnums=(1, 2))
+                dprefills.append({"file": dtag + ".aot", "batch": int(B),
+                                  "seq": int(S)})
         logits_sds = jax.eval_shape(
             lambda ids, kc, vc: decoder._prefill(p, ids, kc, vc),
             jnp.zeros((int(B), int(prompt_lens[0])), jnp.int32), kc, vc)[0]
@@ -178,21 +225,55 @@ def export_decoder_bundle(decoder, out_dir: str,
             key0 = jax.random.PRNGKey(0)
             done0 = jnp.zeros((int(B),), jnp.bool_)
             eos0 = jnp.asarray(-1, jnp.int32)
-
-            def decode(logits, kc, vc, pos, key, done, eos, N=int(N)):
-                return decoder._fused_decode(
-                    p, logits, kc, vc, pos, key, done, eos, steps=N,
-                    do_sample=bool(do_sample), use_eos=True,
-                    temperature=float(temperature),
-                    top_k=None if top_k is None else int(top_k),
-                    top_p=None if top_p is None else float(top_p))
-
+            temp0 = jnp.asarray(float(temperature), jnp.float32)
             tag = f"decode_b{B}_n{N}"
-            _save_exp(decode, (logits0, kc, vc, pos0, key0, done0, eos0),
-                      os.path.join(out_dir, tag + ".aot"),
-                      donate_argnums=(1, 2))
-            decodes.append({"file": tag + ".aot", "batch": int(B),
-                            "steps": int(N)})
+            if eng is None:
+                def decode(logits, kc, vc, pos, key, done, eos, temp,
+                           N=int(N)):
+                    return decoder._fused_decode(
+                        p, logits, kc, vc, pos, key, done, eos, temp,
+                        steps=N, do_sample=bool(do_sample), use_eos=True,
+                        top_k=None if top_k is None else int(top_k),
+                        top_p=None if top_p is None else float(top_p))
+
+                _save_exp(decode,
+                          (logits0, kc, vc, pos0, key0, done0, eos0, temp0),
+                          os.path.join(out_dir, tag + ".aot"),
+                          donate_argnums=(1, 2))
+                decodes.append({"file": tag + ".aot", "batch": int(B),
+                                "steps": int(N)})
+            else:
+                def decode(logits, kc, vc, dkc, dvc, pos, key, done, eos,
+                           temp, N=int(N)):
+                    return eng["decode"](
+                        p, eng["params"], logits, kc, vc, dkc, dvc, pos,
+                        key, done, eos, temp, max_new=N, K=K,
+                        do_sample=bool(do_sample), use_eos=True,
+                        top_k=None if top_k is None else int(top_k),
+                        top_p=None if top_p is None else float(top_p))
+
+                _save_exp(decode,
+                          (logits0, kc, vc, dkc, dvc, pos0, key0, done0,
+                           eos0, temp0),
+                          os.path.join(out_dir, tag + ".aot"),
+                          donate_argnums=(1, 2, 3, 4))
+                decodes.append({"file": tag + ".aot", "batch": int(B),
+                                "steps": int(N), "speculative": True})
+    # the fused-decode serving contract: key/done/eos/temperature are
+    # runtime inputs; do_sample/top_k/top_p (and the speculation statics)
+    # were baked at export
+    mode = {"do_sample": bool(do_sample),
+            "temperature": "runtime",
+            "default_temperature": float(temperature),
+            "top_k": None if top_k is None else int(top_k),
+            "top_p": None if top_p is None else float(top_p)}
+    if eng is not None:
+        mode["speculative"] = {
+            "num_speculative_tokens": K,
+            "draft": (draft_model if isinstance(draft_model, str)
+                      else "model"),
+            "draft_layers": eng["cfg"].num_hidden_layers,
+        }
     meta = {
         "kind": "llama_decoder",
         "inputs": ["input_ids"],
@@ -206,13 +287,11 @@ def export_decoder_bundle(decoder, out_dir: str,
         "caches": caches,
         "prefill_buckets": prefills,
         "decode_buckets": decodes,
-        # the fused-decode serving contract: key/done/eos are inputs,
-        # sampling statics were baked at export
-        "decode_mode": {"do_sample": bool(do_sample),
-                        "temperature": float(temperature),
-                        "top_k": None if top_k is None else int(top_k),
-                        "top_p": None if top_p is None else float(top_p)},
+        "decode_mode": mode,
     }
+    if eng is not None:
+        meta["draft_caches"] = dcaches
+        meta["draft_prefill_buckets"] = dprefills
     with open(os.path.join(out_dir, _META), "w") as f:
         json.dump(meta, f, indent=2)
 
@@ -252,6 +331,8 @@ class AotPredictor:
         self.cast_inputs = cast_inputs
         self.allow_bucket_padding = allow_bucket_padding
         self.padded_calls = 0      # observability: nearest-bucket serves
+        self.last_spec_stats = None  # speculative bundles: last generate's
+        #                              round/acceptance totals
         if warmup:
             self.warmup()
 
@@ -288,6 +369,7 @@ class AotPredictor:
         decode_by_batch: Dict[int, list] = {}
         for dc in self.meta["decode_buckets"]:
             decode_by_batch.setdefault(dc["batch"], []).append(dc)
+        spec = (self.meta.get("decode_mode") or {}).get("speculative")
         for pf in self.meta["prefill_buckets"]:
             B = pf["batch"]
             decs = decode_by_batch.get(B, [None]) \
@@ -296,9 +378,18 @@ class AotPredictor:
                 ids = jnp.zeros((B, pf["seq"]), jnp.int32)
                 kc, vc = self._make_cache(B)
                 logits, kc, vc = self._entry(pf["file"])(ids, kc, vc)
-                if dc is not None:
-                    self._entry(dc["file"])(*self._decode_args(
-                        logits, kc, vc, pf["seq"], B, None, 0))
+                if dc is None:
+                    continue
+                draft_caches = None
+                if spec is not None:
+                    dpf = next(b for b in self.meta["draft_prefill_buckets"]
+                               if b["batch"] == B and b["seq"] == pf["seq"])
+                    dkc, dvc = self._make_cache(B, "draft_caches")
+                    _, dkc, dvc = self._entry(dpf["file"])(ids, dkc, dvc)
+                    draft_caches = (dkc, dvc)
+                self._entry(dc["file"])(*self._decode_args(
+                    logits, kc, vc, pf["seq"], B, None, 0,
+                    draft_caches=draft_caches))
 
     def _first_prefill(self, B: int):
         return next((b for b in self.meta["prefill_buckets"]
@@ -392,9 +483,9 @@ class AotPredictor:
             f"{[b['shapes'] for b in self.meta['buckets']]}")
 
     # -- LM decode ---------------------------------------------------------
-    def _make_cache(self, B: int):
+    def _make_cache(self, B: int, which: str = "caches"):
         import jax.numpy as jnp
-        cm = self.meta["caches"][str(B)]
+        cm = self.meta[which][str(B)]
         dt = jnp.dtype(cm["dtype"])
         shape = tuple(cm["shape"])
         if cm["n_buffers"] == 1:
@@ -403,11 +494,30 @@ class AotPredictor:
         vc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
         return kc, vc
 
-    def _decode_args(self, logits, kc, vc, pos, nb, eos_token_id, seed):
+    def _decode_temp(self, temperature):
+        """Resolve the decode temperature against the bundle contract:
+        runtime-temperature bundles serve any value (export-time value as
+        the default); legacy static bundles reject a mismatching ask."""
+        mode = self.meta.get("decode_mode") or {}
+        if mode.get("temperature") == "runtime":
+            if temperature is None:
+                return float(mode.get("default_temperature", 1.0))
+            return float(temperature)
+        if temperature is not None and mode and \
+                float(temperature) != float(mode.get("temperature", 1.0)):
+            raise ValueError(
+                f"this bundle predates runtime-temperature decode entries "
+                f"(baked temperature={mode.get('temperature')}); re-export "
+                f"it to serve temperature={temperature}")
+        return None        # static bundles take no temperature input
+
+    def _decode_args(self, logits, kc, vc, pos, nb, eos_token_id, seed,
+                     temperature=None, draft_caches=None):
         """Positional inputs for a decode entry. Fused-decode bundles
         (``decode_mode`` in the metadata) take (logits, caches, pos, key,
-        done, eos) — eos=-1 means "no eos"; legacy greedy bundles take
-        the original 4 inputs."""
+        done, eos[, temperature]) — eos=-1 means "no eos"; speculative
+        bundles insert the draft cache pair after the target's; legacy
+        greedy bundles take the original 4 inputs."""
         import jax.numpy as jnp
 
         pos = jnp.asarray(pos, jnp.int32)
@@ -418,19 +528,35 @@ class AotPredictor:
         done = jnp.zeros((nb,), jnp.bool_)
         eos = jnp.asarray(-1 if eos_token_id is None else int(eos_token_id),
                           jnp.int32)
-        return (logits, kc, vc, pos, key, done, eos)
+        args = (logits, kc, vc)
+        if draft_caches is not None:
+            args = args + tuple(draft_caches)
+        args = args + (pos, key, done, eos)
+        t = self._decode_temp(temperature)
+        if t is not None:
+            args = args + (jnp.asarray(t, jnp.float32),)
+        return args
 
     def generate(self, input_ids, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
-                 do_sample: bool = False, seed: int = 0) -> np.ndarray:
+                 do_sample: bool = False,
+                 temperature: Optional[float] = None,
+                 seed: int = 0) -> np.ndarray:
         """Serve a decode: the whole token loop is ONE exported fused
-        module execution (sampling statics were fixed at export — a
-        ``do_sample`` request must match the bundle's ``decode_mode``;
-        eos id and seed are runtime inputs)."""
+        module execution. Eos id (``None`` or negative = no eos), seed
+        and — on current bundles — temperature are runtime inputs;
+        ``do_sample``/``top_k``/``top_p`` were fixed at export and a
+        mismatching request is a contract violation. Speculative bundles
+        (``decode_mode.speculative``) additionally run the exported
+        draft prefill and record the round/acceptance totals in
+        ``last_spec_stats``."""
         if self.meta["kind"] != "llama_decoder":
             raise ValueError(f"bundle kind {self.meta['kind']!r} cannot "
                              "generate; use run()")
         import jax.numpy as jnp
+
+        from paddle_tpu.inference.generate import _normalize_eos
+        eos_token_id = _normalize_eos(eos_token_id)
 
         mode = self.meta.get("decode_mode")
         if mode is None:
@@ -445,6 +571,7 @@ class AotPredictor:
                 f"{mode['do_sample']} (temperature={mode['temperature']}, "
                 f"top_k={mode['top_k']}, top_p={mode['top_p']}); "
                 f"requested do_sample={do_sample}")
+        spec = (mode or {}).get("speculative")
 
         ids = np.asarray(input_ids)
         B, S = ids.shape
@@ -469,15 +596,23 @@ class AotPredictor:
         nb = batches[0]
         pf = next(b for b in self.meta["prefill_buckets"]
                   if b["batch"] == nb and b["seq"] == S)
+
+        # bucket capacity: plain entries decode steps+1 tokens (scan steps
+        # + the last pick); speculative entries' ``steps`` IS the output
+        # buffer size
+        def cap(b):
+            return b["steps"] + (0 if b.get("speculative") else 1)
+
         cands = [b for b in self.meta["decode_buckets"]
-                 if b["batch"] == nb and b["steps"] >= max_new_tokens - 1]
+                 if b["batch"] == nb and cap(b) >= max_new_tokens]
         if not cands:
-            have = [(b["batch"], b["steps"])
+            have = [(b["batch"], cap(b))
                     for b in self.meta["decode_buckets"]]
             raise ValueError(
                 f"no decode bucket with B={nb}, "
-                f"steps>={max_new_tokens - 1}; exported: {have}")
-        dc = min(cands, key=lambda b: b["steps"])
+                f"capacity>={max_new_tokens}; exported (batch, capacity): "
+                f"{have}")
+        dc = min(cands, key=cap)
 
         fed = ids
         if nb != B:
@@ -487,8 +622,28 @@ class AotPredictor:
         kc, vc = self._make_cache(nb)
         logits, kc, vc = self._entry(pf["file"])(
             jnp.asarray(fed, jnp.int32), kc, vc)
-        toks = self._entry(dc["file"])(*self._decode_args(
-            logits, kc, vc, S, nb, eos_token_id, seed))
+        draft_caches = None
+        if spec is not None:
+            dpf = next(b for b in self.meta["draft_prefill_buckets"]
+                       if b["batch"] == nb and b["seq"] == S)
+            dkc, dvc = self._make_cache(nb, "draft_caches")
+            _, dkc, dvc = self._entry(dpf["file"])(
+                jnp.asarray(fed, jnp.int32), dkc, dvc)
+            draft_caches = (dkc, dvc)
+        out = self._entry(dc["file"])(*self._decode_args(
+            logits, kc, vc, S, nb, eos_token_id, seed,
+            temperature=temperature, draft_caches=draft_caches))
+        if spec is not None:
+            toks, sr, sa = out
+            r, a = int(sr), int(sa)
+            self.last_spec_stats = {
+                "rounds": r, "accepted_drafts": a,
+                "acceptance_len_mean": (a / r) if r else float(
+                    spec["num_speculative_tokens"]),
+                "num_speculative_tokens": spec["num_speculative_tokens"],
+            }
+        else:
+            toks = out
         toks = np.asarray(toks)[:B, :max_new_tokens]
         if eos_token_id is not None:
             from paddle_tpu.inference.generate import _trim_after_eos

@@ -18,10 +18,17 @@ matvec it fuses well); GQA routes through the Pallas decode-attention
 kernel (ops/pallas/decode_attention.py — no repeated-KV
 materialization). The Pallas flash kernel covers chunked prefill
 (bottom-right-aligned causal, sq != sk).
+
+Positions may be a traced scalar (the classic lockstep decode) OR a
+per-row ``(B,)`` vector: speculative decoding accepts a variable number
+of draft tokens per row per round, so each row owns its cache write
+offset, causal mask bound, and rope phase (``_cache_update`` vmaps the
+dynamic-update-slice over the batch in that case).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -37,14 +44,21 @@ def _rope_at(x, pos, cfg, p):
     """Rotate (B, S, H, D) by positions ``pos + [0..S)``: a dynamic slice
     of the tables precomputed at init from the training-path frequency
     function (_rope_tables), so decode can never diverge from training if
-    rope scaling changes — and no per-step exp/pow work."""
+    rope scaling changes — and no per-step exp/pow work. ``pos`` may be a
+    scalar or a per-row (B,) vector (speculative rows advance unevenly)."""
     S = x.shape[1]
     d2 = cfg.head_dim // 2
-    cos = jax.lax.dynamic_slice(p["rope.cos"], (pos, 0),
-                                (S, d2)).astype(x.dtype)
-    sin = jax.lax.dynamic_slice(p["rope.sin"], (pos, 0),
-                                (S, d2)).astype(x.dtype)
-    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    if jnp.ndim(pos) == 1:
+        idx = pos[:, None] + jnp.arange(S)                  # (B, S)
+        cos = jnp.take(p["rope.cos"], idx, axis=0).astype(x.dtype)
+        sin = jnp.take(p["rope.sin"], idx, axis=0).astype(x.dtype)
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    else:
+        cos = jax.lax.dynamic_slice(p["rope.cos"], (pos, 0),
+                                    (S, d2)).astype(x.dtype)
+        sin = jax.lax.dynamic_slice(p["rope.sin"], (pos, 0),
+                                    (S, d2)).astype(x.dtype)
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
     x1, x2 = x[..., :d2], x[..., d2:]
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
 
@@ -71,10 +85,28 @@ def _mm(x, p, name):
     return x @ p[name]
 
 
+def _cache_update(buf, t, pos, head_major):
+    """Write t into ONE layer's cache buffer at [pos, pos+S). Scalar pos:
+    a single dynamic-update-slice. Per-row (B,) pos: the same DUS vmapped
+    over the batch (lowers to scatter — each row lands at its own
+    offset, the speculative-decode requirement)."""
+    if jnp.ndim(pos) == 1:
+        if head_major:     # buf (B, KV, L, D), t (B, KV, S, D)
+            f = lambda c, u, p0: jax.lax.dynamic_update_slice(  # noqa: E731
+                c, u, (0, p0, 0))
+        else:              # buf (B, L, KV, D), t (B, S, KV, D)
+            f = lambda c, u, p0: jax.lax.dynamic_update_slice(  # noqa: E731
+                c, u, (p0, 0, 0))
+        return jax.vmap(f)(buf, t, pos)
+    at = (0, 0, pos, 0) if head_major else (0, pos, 0, 0)
+    return jax.lax.dynamic_update_slice(buf, t, at)
+
+
 def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
     """One decoder block over h (B, S, H) writing K/V into the cache at
     [pos, pos+S); attention reads the whole cache masked to < pos+S with
-    causal alignment to the bottom-right (query i attends to <= pos+i)."""
+    causal alignment to the bottom-right (query i attends to <= pos+i).
+    ``pos``: scalar or per-row (B,) vector."""
     B, S, _ = h.shape
     H, KV, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     pre = f"model.layers.{li}."
@@ -98,23 +130,29 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
     #                        which XLA's fused matvec prefers (measured)
     kt = jnp.swapaxes(k, 1, 2) if head_major else k
     vt = jnp.swapaxes(v, 1, 2) if head_major else v
-    at = (0, 0, pos, 0) if head_major else (0, pos, 0, 0)
     if isinstance(kc, tuple):
-        # per-layer cache buffers: a DUS on THIS layer's array only
-        kc_l = jax.lax.dynamic_update_slice(kc[li], kt, at)
-        vc_l = jax.lax.dynamic_update_slice(vc[li], vt, at)
+        # per-layer cache buffers: an update on THIS layer's array only
+        kc_l = _cache_update(kc[li], kt, pos, head_major)
+        vc_l = _cache_update(vc[li], vt, pos, head_major)
         kc = tuple(kc_l if i == li else c for i, c in enumerate(kc))
         vc = tuple(vc_l if i == li else c for i, c in enumerate(vc))
     else:
-        kc = jax.lax.dynamic_update_slice(kc, kt[None], (li,) + at)
-        vc = jax.lax.dynamic_update_slice(vc, vt[None], (li,) + at)
-        kc_l, vc_l = kc[li], vc[li]
+        kc_l = _cache_update(kc[li], kt, pos, head_major)
+        vc_l = _cache_update(vc[li], vt, pos, head_major)
+        kc = jax.lax.dynamic_update_slice(kc, kc_l[None],
+                                          (li, 0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vc_l[None],
+                                          (li, 0, 0, 0, 0))
 
     from paddle_tpu.flags import flags as _flags
     from paddle_tpu.ops.pallas import decode_attention as _da
-    use_kernel = (head_major and S == 1 and _flags.use_decode_attention
+    use_kernel = (head_major and S == 1 and jnp.ndim(pos) == 0
+                  and _flags.use_decode_attention
                   and jax.default_backend() == "tpu"
                   and _da.supported(q[:, 0], kc_l))
+    # per-row qpos: scalar pos broadcasts as (1,1,S,1), vector as (B,1,S,1)
+    qpos = (jnp.reshape(pos, (-1, 1, 1, 1))
+            + jnp.arange(S)[None, None, :, None])
     if use_kernel:
         # one-kernel GQA cache attention (block_multi_head_attention
         # capability): no repeated-KV materialization, online softmax,
@@ -129,7 +167,6 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
         scores = jnp.einsum("bqhd,bhkd->bhqk", q, kk) / jnp.sqrt(
             jnp.float32(D)).astype(q.dtype)
         kpos = jnp.arange(max_len)[None, None, None, :]
-        qpos = pos + jnp.arange(S)[None, None, :, None]
         mask = kpos <= qpos                       # bottom-right causal
         scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -139,7 +176,6 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(
             jnp.float32(D)).astype(q.dtype)
         kpos = jnp.arange(max_len)[None, None, None, :]
-        qpos = pos + jnp.arange(S)[None, None, :, None]
         mask = kpos <= qpos                       # bottom-right causal
         scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -153,21 +189,172 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
     return h + _mm(a, p, pre + "mlp.down_proj.weight"), kc, vc
 
 
-def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len):
-    """ids (B, S) -> logits of the LAST position (B, V), updated caches."""
+def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len,
+                    return_all: bool = False):
+    """ids (B, S) -> logits (B, V) of the LAST position — or of ALL S
+    positions (B, S, V) with ``return_all=True`` (speculative verify
+    scores every drafted position in one batched forward) — plus the
+    updated caches. ``pos``: scalar or per-row (B,) vector."""
     h = p["model.embed_tokens.weight"][ids]
     for li in range(cfg.num_hidden_layers):
         h, kc, vc = _block_forward(p, cfg, li, h, kc, vc, pos, max_len)
     var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
     h = (h.astype(jnp.float32) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
          ).astype(h.dtype) * p["model.norm.weight"]
+    hh = h if return_all else h[:, -1]
     if "head:int8" in p:
-        logits = _mm(h[:, -1], p, "head").astype(jnp.float32)
+        logits = _mm(hh, p, "head").astype(jnp.float32)
     else:
         head = (p["model.embed_tokens.weight"].T if cfg.tie_word_embeddings
                 else p["lm_head.weight"])
-        logits = (h[:, -1] @ head).astype(jnp.float32)   # (B, V)
+        logits = (hh @ head).astype(jnp.float32)
     return logits, kc, vc
+
+
+def _build_params(model: LlamaForCausalLM, max_len: int,
+                  weight_dtype: Optional[str]):
+    """Snapshot + decode-shape a model's weights: fused qkv / gate_up
+    matmuls, optional int8 weight-only quantization, precomputed rope
+    tables for the whole cache window. Shared by the target decoder and
+    any separate-weights draft model (speculative decoding)."""
+    raw = {name: t.value for name, t in model.state_dict().items()}
+    # fuse qkv and gate/up per layer (one matmul each; fewer kernels)
+    for li in range(model.config.num_hidden_layers):
+        pre = f"model.layers.{li}."
+        raw[pre + "self_attn.qkv.weight"] = jnp.concatenate(
+            [raw.pop(pre + "self_attn.q_proj.weight"),
+             raw.pop(pre + "self_attn.k_proj.weight"),
+             raw.pop(pre + "self_attn.v_proj.weight")], axis=1)
+        raw[pre + "mlp.gate_up.weight"] = jnp.concatenate(
+            [raw.pop(pre + "mlp.gate_proj.weight"),
+             raw.pop(pre + "mlp.up_proj.weight")], axis=1)
+    p = {}
+    for name, v in raw.items():
+        if (weight_dtype == "int8" and v.ndim == 2
+                and ("self_attn." in name or "mlp." in name)):
+            from paddle_tpu.quantization import weight_quantize
+            from paddle_tpu.framework.tensor import Tensor
+            q, scale = weight_quantize(Tensor(v))
+            p[name + ":int8"] = q.value
+            p[name + ":scale"] = scale.value
+            continue
+        p[name] = v
+    # the lm head (tied: transposed embedding) is the single biggest
+    # matrix in the step — quantize a dedicated copy of it too
+    if weight_dtype == "int8":
+        from paddle_tpu.quantization import weight_quantize
+        from paddle_tpu.framework.tensor import Tensor
+        head = (p["model.embed_tokens.weight"].T
+                if model.config.tie_word_embeddings
+                else p.pop("lm_head.weight"))
+        q, scale = weight_quantize(Tensor(head))
+        p["head:int8"] = q.value
+        p["head:scale"] = scale.value
+    # precomputed rope tables for the whole cache window
+    cos, sin = _rope_tables(max_len, model.config.head_dim,
+                            model.config.rope_theta,
+                            jnp.dtype(model.config.dtype), offset=0)
+    p["rope.cos"], p["rope.sin"] = cos, sin
+    return p
+
+
+def _spec_round(p, dp, cfg, dcfg, tok, pos, key, done, kc, vc, dkc, dvc,
+                eos_id, temperature, max_len, *, K: int, do_sample: bool,
+                use_eos: bool, top_k, top_p):
+    """One draft-propose / target-verify / accept round (Leviathan et
+    al., arXiv:2211.17192) as a pure trace-level function, so the SAME
+    code runs inside the fused while-loop program AND as the per-round
+    fallback's jitted step (that identity is what makes fused-vs-fallback
+    token parity bit-exact).
+
+    ``pos`` is PER-ROW (B,): acceptance is data-dependent, so rows
+    advance by different amounts and each owns its cache offset. The
+    draft runs K+1 single-token forwards from its own cache (the +1
+    keeps the draft cache complete when every proposal is accepted); the
+    target scores all K+1 positions in ONE batched cached forward.
+    Acceptance: greedy = exact match against the target argmax;
+    sampling = the rejection rule u < min(1, p(d)/q(d)) over the
+    FILTERED (temperature/top-k/top-p) target/draft distributions, with
+    the first rejection resampled from norm(max(p - q, 0)) — preserving
+    the target distribution exactly. Rows that were done (eos) flush eos
+    at the full K+1 rate so the output buffer fills like the non-
+    speculative program's.
+
+    Returns (emit (B, K+1), accepted (B,), next_tok (B,), key, done,
+    kc, vc, dkc, dvc): emit slot j < a holds the accepted draft
+    d_{j+1}, slot a the target's correction/bonus token; slots > a are
+    padding the caller drops. Cache rows past each row's committed
+    length are stale but masked, and the next round overwrites them
+    before they could ever unmask.
+    """
+    B = tok.shape[0]
+    if do_sample:
+        key, sub = jax.random.split(key)
+        rk = jax.random.split(sub, 3)
+        dkeys = jax.random.split(rk[0], K)      # draft proposal keys
+        u = jax.random.uniform(rk[1], (B, K))   # acceptance uniforms
+        ckey = rk[2]                            # correction/bonus key
+
+    def dbody(carry, j):
+        cur, dkc, dvc = carry
+        lg, dkc, dvc = _forward_cached(dp, dcfg, cur[:, None], dkc, dvc,
+                                       pos + j, max_len)
+        if do_sample:
+            kj = jax.lax.dynamic_index_in_dim(
+                dkeys, jnp.minimum(j, K - 1), keepdims=False)
+            flt = _filter_logits(lg, temperature, top_k, top_p)
+            nxt = jax.random.categorical(kj, flt,
+                                         axis=-1).astype(jnp.int32)
+            return (nxt, dkc, dvc), (nxt, flt)
+        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+        return (nxt, dkc, dvc), nxt
+
+    (_, dkc, dvc), ys = jax.lax.scan(dbody, (tok, dkc, dvc),
+                                     jnp.arange(K + 1))
+    props = jnp.moveaxis((ys[0] if do_sample else ys)[:K], 0, 1)  # (B, K)
+    seq = jnp.concatenate([tok[:, None], props], axis=1)       # (B, K+1)
+    all_lg, kc, vc = _forward_cached(p, cfg, seq, kc, vc, pos, max_len,
+                                     return_all=True)          # (B,K+1,V)
+    if do_sample:
+        pprob = jax.nn.softmax(
+            _filter_logits(all_lg, temperature, top_k, top_p), axis=-1)
+        qprob = jax.nn.softmax(jnp.moveaxis(ys[1][:K], 0, 1), axis=-1)
+        pd = jnp.take_along_axis(pprob[:, :K], props[..., None],
+                                 axis=-1)[..., 0]
+        qd = jnp.take_along_axis(qprob, props[..., None], axis=-1)[..., 0]
+        accept = u * qd < pd       # u < min(1, p/q) without the divide
+        a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+        pa = jnp.take_along_axis(pprob, a[:, None, None], axis=1)[:, 0]
+        qa = jnp.take_along_axis(
+            qprob, jnp.minimum(a, K - 1)[:, None, None], axis=1)[:, 0]
+        resid = jnp.maximum(pa - qa, 0.0)
+        rs = jnp.sum(resid, axis=-1, keepdims=True)
+        # all-accepted rows draw the bonus token from p_K itself; a
+        # degenerate all-zero residual (p <= q everywhere) falls back to p
+        resid = jnp.where(rs > 0, resid / jnp.where(rs > 0, rs, 1.0), pa)
+        dist = jnp.where((a == K)[:, None], pa, resid)
+        corr = jax.random.categorical(ckey, jnp.log(dist),
+                                      axis=-1).astype(jnp.int32)
+    else:
+        tgt = jnp.argmax(all_lg, -1).astype(jnp.int32)         # (B, K+1)
+        match = props == tgt[:, :K]
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        corr = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
+    jidx = jnp.arange(K + 1)[None, :]
+    ext = jnp.concatenate([props, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    emit = jnp.where(jidx < a[:, None], ext,
+                     jnp.where(jidx == a[:, None], corr[:, None], 0))
+    if use_eos:
+        a = jnp.where(done, K, a)    # finished rows flush eos full-rate
+        emit = jnp.where(done[:, None], eos_id, emit)
+        valid = jidx <= a[:, None]
+        hit = jnp.logical_and(emit == eos_id, valid)
+        after = (jnp.cumsum(hit.astype(jnp.int32), axis=1)
+                 - hit.astype(jnp.int32)) > 0
+        emit = jnp.where(jnp.logical_and(after, valid), eos_id, emit)
+        done = jnp.logical_or(done, jnp.any(hit, axis=1))
+    tok_next = jnp.take_along_axis(emit, a[:, None], axis=1)[:, 0]
+    return emit, a, tok_next, key, done, kc, vc, dkc, dvc
 
 
 class LlamaDecoder:
@@ -178,9 +365,17 @@ class LlamaDecoder:
     temperature/top-k/top-p sampling, per-step key splits, per-row eos
     freezing) as one ``lax.scan`` program, so a ``generate`` of N tokens
     is 2 device dispatches regardless of mode, with zero retraces across
-    calls/seeds/eos ids. ``dispatch_count`` counts executions so the
-    one-dispatch property is assertable in tests; the per-token ``step``
-    executable remains for the ``decode_fallback`` debugging flag.
+    calls/seeds/eos ids/temperatures (temperature is a runtime input).
+    With a ``draft_model`` (a smaller LlamaForCausalLM or a ``'skip:N'``
+    layer-skip view of the target), ``generate`` runs SPECULATIVE
+    decoding: the draft proposes K tokens per round from its own cache,
+    the target verifies all K+1 positions in one batched forward, and
+    accept/reject + per-row cache advance + eos freezing all live inside
+    one ``lax.while_loop`` program — prefill(target) + prefill(draft) +
+    ONE decode dispatch. ``dispatch_count`` counts executions so both
+    one-dispatch properties are assertable in tests; the per-token
+    ``step`` / per-round speculative fallback remain behind the
+    ``decode_fallback`` flag.
     """
 
     def __init__(self, model: LlamaForCausalLM, max_len: int = 512,
@@ -203,48 +398,12 @@ class LlamaDecoder:
         self.cfg = model.config
         self.max_len = max_len
         self.weight_dtype = weight_dtype
-        raw = {name: t.value for name, t in model.state_dict().items()}
-        # fuse qkv and gate/up per layer (one matmul each; fewer kernels)
-        for li in range(model.config.num_hidden_layers):
-            pre = f"model.layers.{li}."
-            raw[pre + "self_attn.qkv.weight"] = jnp.concatenate(
-                [raw.pop(pre + "self_attn.q_proj.weight"),
-                 raw.pop(pre + "self_attn.k_proj.weight"),
-                 raw.pop(pre + "self_attn.v_proj.weight")], axis=1)
-            raw[pre + "mlp.gate_up.weight"] = jnp.concatenate(
-                [raw.pop(pre + "mlp.gate_proj.weight"),
-                 raw.pop(pre + "mlp.up_proj.weight")], axis=1)
-        p = {}
-        for name, v in raw.items():
-            if (weight_dtype == "int8" and v.ndim == 2
-                    and ("self_attn." in name or "mlp." in name)):
-                from paddle_tpu.quantization import weight_quantize
-                from paddle_tpu.framework.tensor import Tensor
-                q, scale = weight_quantize(Tensor(v))
-                p[name + ":int8"] = q.value
-                p[name + ":scale"] = scale.value
-                continue
-            p[name] = v
-        # the lm head (tied: transposed embedding) is the single biggest
-        # matrix in the step — quantize a dedicated copy of it too
-        if weight_dtype == "int8":
-            from paddle_tpu.quantization import weight_quantize
-            from paddle_tpu.framework.tensor import Tensor
-            head = (p["model.embed_tokens.weight"].T
-                    if model.config.tie_word_embeddings
-                    else p.pop("lm_head.weight"))
-            q, scale = weight_quantize(Tensor(head))
-            p["head:int8"] = q.value
-            p["head:scale"] = scale.value
-        # precomputed rope tables for the whole cache window
-        cos, sin = _rope_tables(max_len, model.config.head_dim,
-                                model.config.rope_theta,
-                                jnp.dtype(model.config.dtype), offset=0)
-        p["rope.cos"], p["rope.sin"] = cos, sin
-        self.params = p
+        self.params = _build_params(model, max_len, weight_dtype)
         cfg = self.cfg
         self.trace_count = 0     # python side effect: bumps only on (re)trace
         self.dispatch_count = 0  # one per device program execution
+        self._spec_engines = {}  # draft-model state for speculative decode
+        self.last_spec_stats = None
 
         def prefill(p, ids, kc, vc):
             self.trace_count += 1
@@ -255,8 +414,8 @@ class LlamaDecoder:
             return _forward_cached(p, cfg, ids, kc, vc, pos, max_len)
 
         def fused_decode(p, logits0, kc, vc, pos0, key0, done0, eos_id,
-                         steps: int, do_sample: bool, use_eos: bool,
-                         temperature: float, top_k, top_p):
+                         temperature, steps: int, do_sample: bool,
+                         use_eos: bool, top_k, top_p):
             """The whole token loop — sampling and EOS handling included —
             as ONE device program (lax.scan): over a network-tunneled chip,
             per-token host dispatches dominate, so this collapses N tokens
@@ -264,7 +423,10 @@ class LlamaDecoder:
             threads through the carry and splits once per step (identical
             stream to the per-token fallback); ``done0`` rows that hit
             ``eos_id`` freeze to eos, and the host trims post-eos columns
-            after the fact (``_trim_after_eos``)."""
+            after the fact (``_trim_after_eos``). Temperature is a RUNTIME
+            scalar input (one compiled program / one AOT entry serves any
+            temperature); top-k/top-p change program structure and stay
+            static."""
             self.trace_count += 1
 
             def pick(logits, key, done):
@@ -293,21 +455,21 @@ class LlamaDecoder:
             return jnp.concatenate([jnp.moveaxis(toks, 0, 1),
                                     last[:, None]], axis=1)
 
-        def counted(jitted):
-            def call(*args, **kwargs):
-                self.dispatch_count += 1
-                return jitted(*args, **kwargs)
-            return call
-
-        self._prefill = counted(jax.jit(prefill))
-        self._step = counted(jax.jit(step))
-        self._fused_decode = counted(jax.jit(
+        self._prefill = self._counted(jax.jit(prefill))
+        self._step = self._counted(jax.jit(step))
+        self._fused_decode = self._counted(jax.jit(
             fused_decode,
-            static_argnames=("steps", "do_sample", "use_eos", "temperature",
-                             "top_k", "top_p")))
+            static_argnames=("steps", "do_sample", "use_eos", "top_k",
+                             "top_p")))
 
-    def _empty_cache(self, B):
-        cfg = self.cfg
+    def _counted(self, jitted):
+        def call(*args, **kwargs):
+            self.dispatch_count += 1
+            return jitted(*args, **kwargs)
+        return call
+
+    def _empty_cache(self, B, cfg: Optional[LlamaConfig] = None):
+        cfg = self.cfg if cfg is None else cfg
         dt = jnp.dtype(cfg.dtype)
         from paddle_tpu.flags import flags
         if flags.decode_cache_layout not in ("stacked", "per_layer"):
@@ -327,23 +489,153 @@ class LlamaDecoder:
                               for _ in range(cfg.num_hidden_layers))
         return zeros(), zeros()
 
+    # -- speculative decoding ---------------------------------------------
+    def _spec_engine(self, draft_model):
+        """Prepare (and cache) the draft side of speculative decoding.
+        ``draft_model``: a LlamaForCausalLM with the same vocab (its
+        weights are snapshotted exactly like the target's), or 'skip:N'
+        — a layer-skip view that reuses the TARGET's first N layers plus
+        its final norm/head as the draft, zero extra weights."""
+        import dataclasses
+        cfg, max_len = self.cfg, self.max_len
+        if isinstance(draft_model, str):
+            if not draft_model.startswith("skip:"):
+                raise ValueError(
+                    "draft_model must be a LlamaForCausalLM or 'skip:N' "
+                    f"(layer-skip view of the target), got {draft_model!r}")
+            n = int(draft_model.split(":", 1)[1])
+            if not 0 < n < cfg.num_hidden_layers:
+                raise ValueError(
+                    f"'skip:{n}' needs 0 < N < num_hidden_layers "
+                    f"({cfg.num_hidden_layers})")
+            ekey = ("skip", n)
+        else:
+            ekey = ("model", id(draft_model))
+        eng = self._spec_engines.get(ekey)
+        if eng is not None:
+            return eng
+        if isinstance(draft_model, str):
+            dcfg = dataclasses.replace(cfg, num_hidden_layers=n)
+            dp = self.params
+        else:
+            dcfg = draft_model.config
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {dcfg.vocab_size} != target "
+                    f"vocab_size {cfg.vocab_size}")
+            dp = _build_params(draft_model, max_len, self.weight_dtype)
+
+        def draft_prefill(dp_, ids, dkc, dvc):
+            self.trace_count += 1
+            return _forward_cached(dp_, dcfg, ids, dkc, dvc, 0, max_len)
+
+        def spec_round(p, dp_, tok, pos, key, done, kc, vc, dkc, dvc,
+                       eos_id, temperature, K: int, do_sample: bool,
+                       use_eos: bool, top_k, top_p):
+            self.trace_count += 1
+            return _spec_round(p, dp_, cfg, dcfg, tok, pos, key, done, kc,
+                               vc, dkc, dvc, eos_id, temperature, max_len,
+                               K=K, do_sample=do_sample, use_eos=use_eos,
+                               top_k=top_k, top_p=top_p)
+
+        def spec_decode(p, dp_, logits0, kc, vc, dkc, dvc, pos0, key0,
+                        done0, eos_id, temperature, max_new: int, K: int,
+                        do_sample: bool, use_eos: bool, top_k, top_p):
+            """Speculative decode as ONE device program: a lax.while_loop
+            of draft-propose/verify/accept rounds, each round committing
+            a variable 1..K+1 tokens per row (scattered into the output
+            buffer at per-row offsets), until every row has its
+            ``max_new`` tokens. Also returns (rounds, accepted) totals
+            over live rows for acceptance-length reporting."""
+            self.trace_count += 1
+            B = logits0.shape[0]
+            if do_sample:
+                key0, sub0 = jax.random.split(key0)
+                tok0 = _sample_from(logits0, sub0, temperature, top_k,
+                                    top_p).astype(jnp.int32)
+            else:
+                tok0 = jnp.argmax(logits0, -1).astype(jnp.int32)
+            done = done0
+            if use_eos:
+                tok0 = jnp.where(done, eos_id, tok0)
+                done = jnp.logical_or(done, tok0 == eos_id)
+            buf = jnp.zeros((B, max_new), jnp.int32).at[:, 0].set(tok0)
+            pos = jnp.broadcast_to(pos0, (B,)).astype(jnp.int32)
+            rows = jnp.arange(B)[:, None]
+            jidx = jnp.arange(K + 1)[None, :]
+
+            def cond(c):
+                return jnp.any(c[1] - pos0 + 1 < max_new)
+
+            def body(c):
+                buf, pos, tok, key, done, kc, vc, dkc, dvc, sr, sa = c
+                active = (pos - pos0 + 1) < max_new
+                live = jnp.logical_and(active, jnp.logical_not(done))
+                (emit, a, tok2, key, done2, kc, vc, dkc,
+                 dvc) = _spec_round(p, dp_, cfg, dcfg, tok, pos, key,
+                                    done, kc, vc, dkc, dvc, eos_id,
+                                    temperature, max_len, K=K,
+                                    do_sample=do_sample, use_eos=use_eos,
+                                    top_k=top_k, top_p=top_p)
+                sr = sr + jnp.sum(live.astype(jnp.int32))
+                sa = sa + jnp.sum(jnp.where(live, a, 0).astype(jnp.int32))
+                idx = (pos - pos0 + 1)[:, None] + jidx
+                valid = jnp.logical_and(jidx <= a[:, None],
+                                        active[:, None])
+                idx = jnp.where(valid, idx, max_new)  # OOB -> dropped
+                buf = buf.at[rows, idx].set(emit, mode="drop")
+                pos = jnp.where(active, pos + a + 1, pos)
+                tok = jnp.where(active, tok2, tok)
+                done = jnp.where(active, done2, done)
+                return (buf, pos, tok, key, done, kc, vc, dkc, dvc,
+                        sr, sa)
+
+            z = jnp.asarray(0, jnp.int32)
+            out = jax.lax.while_loop(
+                cond, body,
+                (buf, pos, tok0, key0, done, kc, vc, dkc, dvc, z, z))
+            return out[0], out[9], out[10]
+
+        eng = {
+            "cfg": dcfg, "params": dp,
+            "prefill": self._counted(jax.jit(draft_prefill)),
+            "round": self._counted(jax.jit(spec_round, static_argnames=(
+                "K", "do_sample", "use_eos", "top_k", "top_p"))),
+            "decode": self._counted(jax.jit(spec_decode, static_argnames=(
+                "max_new", "K", "do_sample", "use_eos", "top_k",
+                "top_p"))),
+        }
+        self._spec_engines[ekey] = eng
+        return eng
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0, draft_model=None,
+                 num_speculative_tokens: Optional[int] = None) -> np.ndarray:
         """Decode. input_ids: (B, S) ints. Returns (B, S + new).
 
         Greedy by default; ``do_sample=True`` draws from the
         temperature/top-k/top-p-filtered distribution (the reference
         generation-op sampling surface). EVERY mode — greedy, greedy+eos,
         sampled, sampled+eos — runs the whole token loop as one fused
-        device dispatch (``fused_decode``); set the ``decode_fallback``
-        flag or ``PADDLE_TPU_DECODE_FALLBACK=1`` to debug against the
-        per-token host loop, which emits the same tokens for a fixed seed.
+        device dispatch (``fused_decode``). With ``draft_model`` (a
+        smaller LlamaForCausalLM or ``'skip:N'``) the loop runs
+        SPECULATIVELY: ``num_speculative_tokens`` (default
+        ``flags.decode_speculative_tokens``) draft proposals per target
+        verify, still one decode dispatch after the two prefills, with
+        the target distribution preserved exactly (greedy: exact-match
+        accept; sampling: Leviathan rejection rule). ``eos_token_id``
+        accepts ``None`` or any negative id (the bundles' ``-1``
+        convention) as "no eos". Set the ``decode_fallback`` flag or
+        ``PADDLE_TPU_DECODE_FALLBACK=1`` to debug against the per-token
+        (or per-speculative-round) host loop, which emits the same
+        tokens for a fixed seed.
         """
         import jax.random as jrandom
 
+        eos_token_id = _normalize_eos(eos_token_id)
         ids = jnp.asarray(np.asarray(input_ids))
         B, S = ids.shape
         if S + max_new_tokens > self.max_len:
@@ -351,6 +643,35 @@ class LlamaDecoder:
                              f"exceeds max_len {self.max_len}")
         if max_new_tokens <= 0:
             return np.asarray(ids)
+        if draft_model is not None:
+            from paddle_tpu.flags import flags
+            K = int(num_speculative_tokens
+                    if num_speculative_tokens is not None
+                    else flags.decode_speculative_tokens)
+            if K < 1:
+                raise ValueError(
+                    f"num_speculative_tokens must be >= 1, got {K}")
+            if S + max_new_tokens + K > self.max_len:
+                raise ValueError(
+                    f"speculative decode can overshoot the cache by up to "
+                    f"K={K} slots: prompt {S} + {max_new_tokens} new + {K} "
+                    f"exceeds max_len {self.max_len}; build the decoder "
+                    f"with more slack")
+            eng = self._spec_engine(draft_model)
+            gen = (self._generate_speculative_fallback
+                   if decode_fallback_active()
+                   else self._generate_speculative)
+            toks = gen(ids, max_new_tokens, eos_token_id, do_sample,
+                       temperature, top_k, top_p, seed, eng, K)
+            toks = np.asarray(toks)
+            if eos_token_id is not None:
+                toks = _trim_after_eos(toks, eos_token_id)
+            return np.concatenate(
+                [np.asarray(ids), toks.astype(np.asarray(ids).dtype)],
+                axis=1)
+        if num_speculative_tokens is not None:
+            raise ValueError("num_speculative_tokens requires a "
+                             "draft_model")
         if decode_fallback_active():
             return self._generate_per_token(ids, max_new_tokens,
                                             eos_token_id, do_sample,
@@ -361,13 +682,13 @@ class LlamaDecoder:
         # (and a plain array, so AOT bundles export the identical function)
         key = jrandom.PRNGKey(seed)
         done = jnp.zeros((B,), jnp.bool_)
-        eos = jnp.asarray(0 if eos_token_id is None else int(eos_token_id),
+        eos = jnp.asarray(-1 if eos_token_id is None else int(eos_token_id),
                           jnp.int32)
         toks = self._fused_decode(
             self.params, logits, kc, vc, jnp.asarray(S, jnp.int32), key,
-            done, eos, steps=max_new_tokens - 1, do_sample=bool(do_sample),
+            done, eos, jnp.asarray(float(temperature), jnp.float32),
+            steps=max_new_tokens - 1, do_sample=bool(do_sample),
             use_eos=eos_token_id is not None,
-            temperature=float(temperature),
             top_k=None if top_k is None else int(top_k),
             top_p=None if top_p is None else float(top_p))
         toks = np.asarray(toks)
@@ -375,6 +696,104 @@ class LlamaDecoder:
             toks = _trim_after_eos(toks, int(eos_token_id))
         return np.concatenate(
             [np.asarray(ids), toks.astype(np.asarray(ids).dtype)], axis=1)
+
+    def _generate_speculative(self, ids, max_new, eos_norm, do_sample,
+                              temperature, top_k, top_p, seed, eng, K):
+        """Fused speculative decode: prefill(target) + prefill(draft) +
+        ONE while-loop dispatch. Records acceptance stats into
+        ``last_spec_stats``."""
+        import jax.random as jrandom
+
+        B, _ = ids.shape
+        kc, vc = self._empty_cache(B)
+        dkc, dvc = self._empty_cache(B, eng["cfg"])
+        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        _, dkc, dvc = eng["prefill"](eng["params"], ids, dkc, dvc)
+        key = jrandom.PRNGKey(seed)
+        done0 = jnp.zeros((B,), jnp.bool_)
+        eos = jnp.asarray(-1 if eos_norm is None else int(eos_norm),
+                          jnp.int32)
+        buf, sr, sa = eng["decode"](
+            self.params, eng["params"], logits, kc, vc, dkc, dvc,
+            jnp.asarray(ids.shape[1], jnp.int32), key, done0, eos,
+            jnp.asarray(float(temperature), jnp.float32),
+            max_new=int(max_new), K=int(K), do_sample=bool(do_sample),
+            use_eos=eos_norm is not None,
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p))
+        self._record_spec_stats(int(sr), int(sa), K)
+        return np.asarray(buf)
+
+    def _generate_speculative_fallback(self, ids, max_new, eos_norm,
+                                       do_sample, temperature, top_k,
+                                       top_p, seed, eng, K):
+        """Per-round host loop (the debugging escape hatch): one jitted
+        ``_spec_round`` dispatch per draft-and-verify round plus a host
+        sync each round — the parity reference the fused while-loop is
+        tested against (identical key discipline and round function)."""
+        import jax.random as jrandom
+
+        B, S = ids.shape
+        kc, vc = self._empty_cache(B)
+        dkc, dvc = self._empty_cache(B, eng["cfg"])
+        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        _, dkc, dvc = eng["prefill"](eng["params"], ids, dkc, dvc)
+        key = jrandom.PRNGKey(seed)
+        temp = jnp.asarray(float(temperature), jnp.float32)
+        use_eos = eos_norm is not None
+        eos = jnp.asarray(-1 if eos_norm is None else int(eos_norm),
+                          jnp.int32)
+        if do_sample:
+            key, sub = jrandom.split(key)
+            tok = jnp.asarray(_sample_logits(logits, sub, temp, top_k,
+                                             top_p), jnp.int32)
+        else:
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        done = jnp.zeros((B,), jnp.bool_)
+        if use_eos:
+            tok = jnp.where(done, eos, tok)
+            done = jnp.logical_or(done, tok == eos)
+        buf = np.zeros((B, max_new), np.int32)
+        buf[:, 0] = np.asarray(tok)
+        count = np.ones((B,), np.int64)
+        pos = jnp.full((B,), S, jnp.int32)
+        sr = sa = 0
+        tk = None if top_k is None else int(top_k)
+        tp = None if top_p is None else float(top_p)
+        while bool((count < max_new).any()):
+            active = count < max_new
+            live = active & ~np.asarray(done)
+            emit, a, tok2, key, done2, kc, vc, dkc, dvc = eng["round"](
+                self.params, eng["params"], tok, pos, key, done, kc, vc,
+                dkc, dvc, eos, temp, K=int(K), do_sample=bool(do_sample),
+                use_eos=use_eos, top_k=tk, top_p=tp)
+            emit_h, a_h = np.asarray(emit), np.asarray(a)
+            sr += int(live.sum())
+            sa += int(a_h[live].sum())
+            for b in range(B):
+                if not active[b]:
+                    continue
+                n = min(int(a_h[b]) + 1, int(max_new - count[b]))
+                buf[b, count[b]:count[b] + n] = emit_h[b, :n]
+                count[b] += int(a_h[b]) + 1
+            act_d = jnp.asarray(active)
+            pos = jnp.where(act_d, pos + a + 1, pos)
+            tok = jnp.where(act_d, tok2, tok)
+            done = jnp.where(act_d, done2, done)
+        self._record_spec_stats(sr, sa, K)
+        return buf
+
+    def _record_spec_stats(self, rounds: int, accepted: int, K: int):
+        self.last_spec_stats = {
+            "rounds": rounds,
+            "accepted_drafts": accepted,
+            # mean accepted draft tokens per verify step, over rows that
+            # were live (not eos-done, budget not yet filled); emitted
+            # tokens per verify step is this + 1 (the correction/bonus)
+            "acceptance_len_mean": (accepted / rounds) if rounds
+            else float(K),
+            "num_speculative_tokens": K,
+        }
 
     def _generate_per_token(self, ids, max_new_tokens, eos_token_id,
                             do_sample, temperature, top_k, top_p, seed):
@@ -416,9 +835,6 @@ class LlamaDecoder:
         return np.asarray(jnp.concatenate(out, axis=1))
 
 
-import functools
-
-
 def decode_fallback_active() -> bool:
     """True when the per-token debugging path is requested, via the
     ``decode_fallback`` flag or the ``PADDLE_TPU_DECODE_FALLBACK`` env."""
@@ -431,40 +847,62 @@ def decode_fallback_active() -> bool:
         in ("1", "true", "yes", "on")
 
 
+def _normalize_eos(eos_token_id) -> Optional[int]:
+    """Uniform "no eos" convention across the decode surfaces: ``None``
+    OR any negative id (the AOT bundles encode "none" as ``-1``, which no
+    vocab token can match) both mean "decode to the full length"."""
+    if eos_token_id is None:
+        return None
+    e = int(eos_token_id)
+    return None if e < 0 else e
+
+
 def _trim_after_eos(toks: np.ndarray, eos_token_id: int) -> np.ndarray:
     """Drop columns past the point where every row has emitted eos — the
     fused path pins finished rows to eos on device, so trimming here
-    reproduces the per-token loop's early-stop output length exactly."""
+    reproduces the per-token loop's early-stop output length exactly.
+    A row whose FIRST emitted token is eos contributes length 1 (never
+    0): the eos itself is part of the output, as in the host loop."""
     hit = toks == eos_token_id
     n = toks.shape[1]
     first = np.where(hit.any(axis=1), hit.argmax(axis=1), n - 1)
     return toks[:, :int(first.max()) + 1]
 
 
-def _sample_from(logits, key, temperature: float = 1.0,
-                 top_k=None, top_p=None):
-    """Temperature / top-k / top-p filtered categorical sample.
-    (B, V) -> (B,). Pure trace-level function: runs inside the fused
-    decode scan body and under the jitted `_sample_logits` wrapper."""
-    lg = logits / jnp.maximum(temperature, 1e-6)
+def _filter_logits(logits, temperature=1.0, top_k=None, top_p=None):
+    """Temperature / top-k / top-p logit filtering over the LAST axis
+    (any leading dims: (B, V) sampling, (B, K+1, V) speculative verify).
+    ``temperature`` may be a traced runtime scalar; top-k/top-p change
+    program structure and stay static. Returns filtered logits with
+    excluded entries at -inf — the distribution BOTH sampling and the
+    speculative accept/reject rule see (they must match exactly for the
+    rejection rule to preserve the target distribution)."""
+    lg = logits / jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
     if top_k is not None:
-        kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+        kth = jnp.sort(lg, axis=-1)[..., -int(top_k)][..., None]
         lg = jnp.where(lg < kth, -jnp.inf, lg)
     if top_p is not None:
-        sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+        sorted_lg = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
         probs = jax.nn.softmax(sorted_lg, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # smallest logit still inside the nucleus
-        keep_n = jnp.sum(cum - probs < top_p, axis=-1)  # (B,)
+        keep_n = jnp.sum(cum - probs < top_p, axis=-1)
         cutoff = jnp.take_along_axis(
-            sorted_lg, jnp.maximum(keep_n - 1, 0)[:, None], axis=-1)
+            sorted_lg, jnp.maximum(keep_n - 1, 0)[..., None], axis=-1)
         lg = jnp.where(lg < cutoff, -jnp.inf, lg)
-    return jax.random.categorical(key, lg, axis=-1)
+    return lg
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("temperature", "top_k", "top_p"))
-def _sample_logits(logits, key, temperature: float = 1.0,
-                   top_k=None, top_p=None):
-    """Jitted `_sample_from` (the per-token host loops' sampling op)."""
+def _sample_from(logits, key, temperature=1.0, top_k=None, top_p=None):
+    """Temperature / top-k / top-p filtered categorical sample.
+    (B, V) -> (B,). Pure trace-level function: runs inside the fused
+    decode scan body and under the jitted `_sample_logits` wrapper."""
+    return jax.random.categorical(
+        key, _filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def _sample_logits(logits, key, temperature=1.0, top_k=None, top_p=None):
+    """Jitted `_sample_from` (the per-token host loops' sampling op).
+    Temperature is a traced argument — no retrace across temperatures."""
     return _sample_from(logits, key, temperature, top_k, top_p)

@@ -245,6 +245,10 @@ class LlamaForCausalLM(nn.Layer):
         """Decode with the compile-once KV-cache engine (GenerationMixin
         surface; inference/generate.py). The decoder is cached on the
         model, so repeated calls reuse the compiled executables.
+        ``draft_model=`` (a smaller LlamaForCausalLM or 'skip:N') plus
+        ``num_speculative_tokens=`` run the speculative one-dispatch
+        decode; the cache is sized with K slots of slack (speculative
+        rounds can overshoot the budget by up to K positions).
         decode_strategy='beam_search' routes to the no-cache beam decoder
         (nn/generation.py — the cached engine is greedy/sampling-only)."""
         import numpy as np
@@ -253,6 +257,12 @@ class LlamaForCausalLM(nn.Layer):
                                    "beam_search"):
             raise ValueError(f"unknown decode_strategy {decode_strategy!r}")
         need = int(np.asarray(input_ids).shape[1]) + max_new_tokens
+        if kwargs.get("draft_model") is not None:
+            k = kwargs.get("num_speculative_tokens")
+            if k is None:
+                from paddle_tpu.flags import flags as _flags
+                k = _flags.decode_speculative_tokens
+            need += int(k)
         if max_len is not None and max_len < need:
             raise ValueError(f"max_len {max_len} < prompt + new tokens "
                              f"({need})")

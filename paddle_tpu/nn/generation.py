@@ -28,8 +28,10 @@ def generate_tokens(model, input_ids, max_new_tokens: int = 32,
     causal LMs, whose logits at position i ignore positions > i); the
     per-token host loop remains for duck-typed non-Layer callables and as
     the ``decode_fallback``-flag debugging path."""
-    from paddle_tpu.inference.generate import decode_fallback_active
+    from paddle_tpu.inference.generate import (_normalize_eos,
+                                               decode_fallback_active)
 
+    eos_token_id = _normalize_eos(eos_token_id)
     ids = np.asarray(input_ids)
     max_pos = getattr(getattr(model, "config", None),
                       "max_position_embeddings", None)
@@ -94,8 +96,8 @@ def _generate_tokens_fused(model, ids, max_new_tokens, eos_token_id,
     jitted = getattr(model, "_ptpu_fused_generate", None)
     if jitted is None or getattr(model, "_ptpu_fused_generate_names",
                                  None) != names:
-        def decode(state_vals, buf, pos0, key0, done0, eos_id, steps: int,
-                   do_sample: bool, use_eos: bool, temperature: float,
+        def decode(state_vals, buf, pos0, key0, done0, eos_id, temperature,
+                   steps: int, do_sample: bool, use_eos: bool,
                    top_k, top_p):
             st = dict(zip(names, state_vals))
 
@@ -129,9 +131,10 @@ def _generate_tokens_fused(model, ids, max_new_tokens, eos_token_id,
                 body, (buf, pos0, key0, done0), None, length=steps)
             return jnp.moveaxis(toks, 0, 1)
 
+        # temperature is a runtime input (no retrace across temperatures,
+        # matching the KV-cache decoder's fused program)
         jitted = jax.jit(decode, static_argnames=(
-            "steps", "do_sample", "use_eos", "temperature", "top_k",
-            "top_p"))
+            "steps", "do_sample", "use_eos", "top_k", "top_p"))
         model._ptpu_fused_generate = jitted
         model._ptpu_fused_generate_names = names
 
@@ -143,9 +146,9 @@ def _generate_tokens_fused(model, ids, max_new_tokens, eos_token_id,
     eos = jnp.asarray(0 if eos_token_id is None else int(eos_token_id),
                       jnp.int32)
     toks = jitted(vals, buf, jnp.asarray(S, jnp.int32), key, done, eos,
+                  jnp.asarray(float(temperature), jnp.float32),
                   steps=max_new_tokens, do_sample=bool(do_sample),
                   use_eos=eos_token_id is not None,
-                  temperature=float(temperature),
                   top_k=None if top_k is None else int(top_k),
                   top_p=None if top_p is None else float(top_p))
     toks = np.asarray(toks)

@@ -393,3 +393,121 @@ def test_decoder_bundle_sampled_and_eos_fused(tmp_path):
     out_e = pg.generate(prompt, max_new_tokens=10, eos_token_id=eos)
     ref_e = dec.generate(prompt, max_new_tokens=10, eos_token_id=eos)
     np.testing.assert_array_equal(out_e, ref_e)
+
+
+def _tiny_decoder(seed=0, max_len=32):
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(seed)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64))
+    return LlamaDecoder(model, max_len=max_len)
+
+
+def test_speculative_decoder_bundle_parity_and_stats(tmp_path):
+    """Speculative AOT bundle: the export carries draft prefill entries +
+    draft cache metadata, ``decode_mode`` records the speculation
+    statics, and serving is draft-prefill + prefill + ONE decode module
+    execution with exact token parity against the in-process speculative
+    decoder (greedy speculation == plain greedy, so the bundle's output
+    must also equal a non-speculative greedy serve)."""
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+
+    dec = _tiny_decoder(21)
+    prompt = np.random.default_rng(0).integers(0, 64, (2, 5))
+    bdir = str(tmp_path / "spec")
+    export_decoder_bundle(dec, bdir, prompt_lens=[5], decode_steps=[8],
+                          batch_sizes=[2], draft_model="skip:1",
+                          num_speculative_tokens=2)
+    meta = json.load(open(os.path.join(bdir, "bundle.json")))
+    assert meta["decode_mode"]["speculative"] == {
+        "num_speculative_tokens": 2, "draft": "skip:1", "draft_layers": 1}
+    assert meta["decode_mode"]["temperature"] == "runtime"
+    assert meta["draft_prefill_buckets"] == [
+        {"file": "draft_prefill_b2_s5.aot", "batch": 2, "seq": 5}]
+    assert "2" in meta["draft_caches"]
+    assert meta["decode_buckets"][0]["speculative"] is True
+
+    pred = AotPredictor(bdir, warmup=False)
+    out = pred.generate(prompt, max_new_tokens=8)
+    ref = dec.generate(prompt, max_new_tokens=8, draft_model="skip:1",
+                       num_speculative_tokens=2)
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out, dec.generate(prompt,
+                                                    max_new_tokens=8))
+    stats = pred.last_spec_stats
+    assert stats["num_speculative_tokens"] == 2
+    assert stats["rounds"] > 0
+    assert 0.0 <= stats["acceptance_len_mean"] <= 2.0
+
+    # eos as a runtime input through the speculative entry (and the
+    # negative-id "none" convention)
+    free = dec.generate(prompt, max_new_tokens=8)
+    eos = int(free[0, 7])
+    out_e = pred.generate(prompt, max_new_tokens=8, eos_token_id=eos)
+    ref_e = dec.generate(prompt, max_new_tokens=8, eos_token_id=eos,
+                         draft_model="skip:1", num_speculative_tokens=2)
+    np.testing.assert_array_equal(out_e, ref_e)
+    np.testing.assert_array_equal(
+        pred.generate(prompt, max_new_tokens=8, eos_token_id=-1), out)
+
+    # speculative buckets serve max_new_tokens <= steps (the buffer
+    # size), not steps + 1
+    with pytest.raises(ValueError, match="capacity"):
+        pred.generate(prompt, max_new_tokens=9)
+    # exporting with K but no draft is rejected
+    with pytest.raises(ValueError, match="requires a draft_model"):
+        export_decoder_bundle(dec, str(tmp_path / "bad"), prompt_lens=[5],
+                              decode_steps=[8], batch_sizes=[2],
+                              num_speculative_tokens=2)
+    # and a bucket that could overshoot the cache is rejected up front
+    with pytest.raises(ValueError, match="overshoot"):
+        export_decoder_bundle(dec, str(tmp_path / "bad2"), prompt_lens=[5],
+                              decode_steps=[30], batch_sizes=[2],
+                              draft_model="skip:1",
+                              num_speculative_tokens=2)
+
+
+def test_decoder_bundle_runtime_temperature(tmp_path):
+    """Satellite: temperature is a runtime input to exported decode
+    entries — ONE sampled bundle serves any temperature (bit-exact with
+    the in-process decoder at that temperature); a legacy bundle whose
+    metadata still records a baked temperature refuses a mismatching
+    request instead of silently serving the wrong distribution."""
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+
+    dec = _tiny_decoder(22)
+    prompt = np.random.default_rng(1).integers(0, 64, (2, 5))
+    bdir = str(tmp_path / "sampled")
+    export_decoder_bundle(dec, bdir, prompt_lens=[5], decode_steps=[8],
+                          batch_sizes=[2], do_sample=True,
+                          temperature=0.8, top_k=8)
+    meta = json.load(open(os.path.join(bdir, "bundle.json")))
+    assert meta["decode_mode"]["temperature"] == "runtime"
+    assert meta["decode_mode"]["default_temperature"] == 0.8
+
+    pred = AotPredictor(bdir, warmup=False)
+    for temp in (0.5, 1.3):
+        out = pred.generate(prompt, max_new_tokens=8, do_sample=True,
+                            temperature=temp, seed=3)
+        ref = dec.generate(prompt, max_new_tokens=8, do_sample=True,
+                           temperature=temp, top_k=8, seed=3)
+        np.testing.assert_array_equal(out, ref, err_msg=str(temp))
+    # no temperature passed: the export-time value is the default
+    np.testing.assert_array_equal(
+        pred.generate(prompt, max_new_tokens=8, do_sample=True, seed=4),
+        dec.generate(prompt, max_new_tokens=8, do_sample=True,
+                     temperature=0.8, top_k=8, seed=4))
+
+    # legacy static-temperature metadata: asking for a different value
+    # is a contract violation (re-export, don't mis-serve)
+    meta["decode_mode"]["temperature"] = 0.8
+    del meta["decode_mode"]["default_temperature"]
+    json.dump(meta, open(os.path.join(bdir, "bundle.json"), "w"))
+    legacy = AotPredictor(bdir, warmup=False)
+    with pytest.raises(ValueError, match="re-export"):
+        legacy.generate(prompt, max_new_tokens=8, do_sample=True,
+                        temperature=1.3, seed=3)

@@ -63,8 +63,10 @@ def test_run_guarded_nontransient_fails_fast_with_class(capsys):
 @pytest.mark.slow
 def test_bench_decode_emits_modes_breakdown():
     """`python bench.py --decode` contract: final stdout json carries
-    tokens/s + dispatch counts for every mode/batch, each mode fused into
-    2 dispatches per generate."""
+    tokens/s + dispatch counts + tokens_per_dispatch for every
+    mode/batch — plain modes fuse into 2 dispatches per generate,
+    speculative modes into 3 (the extra draft prefill) and additionally
+    report the mean acceptance length."""
     import subprocess
     import sys
 
@@ -76,6 +78,15 @@ def test_bench_decode_emits_modes_breakdown():
     assert any(k.startswith("greedy_b") for k in modes)
     assert any(k.startswith("greedy_eos_b") for k in modes)
     assert any(k.startswith("sampled_b") for k in modes)
-    for row in modes.values():
-        assert row["dispatches_per_generate"] == 2
+    assert any(k.startswith("spec_greedy_b") for k in modes)
+    assert any(k.startswith("spec_sampled_b") for k in modes)
+    spec = rec["decode"]["speculative"]
+    assert spec["k"] >= 1 and spec["draft"]
+    for name, row in modes.items():
+        expected = 3 if name.startswith("spec_") else 2
+        assert row["dispatches_per_generate"] == expected, name
         assert row["tokens_per_sec"] > 0
+        assert row["tokens_per_dispatch"] > 0
+        if name.startswith("spec_"):
+            assert 0.0 <= row["acceptance_len_mean"] <= spec["k"]
+            assert row["num_speculative_tokens"] == spec["k"]

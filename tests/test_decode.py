@@ -403,3 +403,224 @@ def test_generate_tokens_fused_one_dispatch_and_parity():
     finally:
         model.forward = orig
     assert calls["n"] == 0, "fused generate_tokens re-ran the eager forward"
+
+
+def test_speculative_decode_one_dispatch_and_parity_sweep():
+    """Speculative tentpole acceptance: {greedy, temperature, top-k,
+    top-p} x {eos, no-eos} x batch sizes, asserting (a) the fused
+    speculative generate is prefill(target) + prefill(draft) + exactly
+    ONE decode dispatch, (b) bit-exact token parity against the
+    per-round speculative fallback, and (c) greedy speculative == the
+    non-speculative fused greedy decode (speculation must be invisible
+    in the output)."""
+    model = _model(8)
+    dec = LlamaDecoder(model, max_len=40)
+    rng = np.random.default_rng(0)
+    modes = [
+        dict(),                                            # greedy
+        dict(do_sample=True, temperature=0.7, seed=1),     # temperature
+        dict(do_sample=True, temperature=0.9, top_k=8, seed=2),
+        dict(do_sample=True, top_p=0.9, seed=3),
+    ]
+    for B in (1, 3):
+        prompt = rng.integers(0, 64, (B, 5))
+        plain = dec.generate(prompt, max_new_tokens=8)
+        # an eos that actually fires early in row 0 of the greedy run
+        eos_live = int(plain[0, 7])
+        for kw in modes:
+            for eos in (None, eos_live):
+                kw = dict(kw, draft_model="skip:1",
+                          num_speculative_tokens=2)
+                if eos is not None:
+                    kw["eos_token_id"] = eos
+                d0 = dec.dispatch_count
+                fused = dec.generate(prompt, max_new_tokens=8, **kw)
+                assert dec.dispatch_count - d0 == 3, \
+                    f"{kw}: expected 2 prefills + ONE decode dispatch"
+                stats = dec.last_spec_stats
+                assert stats["num_speculative_tokens"] == 2
+                assert 0.0 <= stats["acceptance_len_mean"] <= 2.0
+                ref = _with_fallback(
+                    lambda: dec.generate(prompt, max_new_tokens=8, **kw))
+                assert fused.shape == ref.shape, kw
+                np.testing.assert_array_equal(fused, ref, err_msg=str(kw))
+                if not kw.get("do_sample") and eos is None:
+                    # greedy speculation preserves the target's argmax
+                    # sequence exactly
+                    np.testing.assert_array_equal(fused, plain)
+        # the fallback really is per-round: more than 3 dispatches
+        d0 = dec.dispatch_count
+        _with_fallback(lambda: dec.generate(
+            prompt, max_new_tokens=8, draft_model="skip:1",
+            num_speculative_tokens=2))
+        assert dec.dispatch_count - d0 > 3
+
+
+def test_speculative_separate_draft_model():
+    """A standalone smaller LlamaForCausalLM as the draft: same
+    one-dispatch + fallback-parity contract as the layer-skip view."""
+    model = _model(9)
+    paddle.seed(10)
+    draft = LlamaForCausalLM(LlamaConfig(**{**CFG, "num_hidden_layers": 1}))
+    dec = LlamaDecoder(model, max_len=40)
+    prompt = np.random.default_rng(1).integers(0, 64, (2, 4))
+    d0 = dec.dispatch_count
+    fused = dec.generate(prompt, max_new_tokens=8, draft_model=draft,
+                         num_speculative_tokens=3)
+    assert dec.dispatch_count - d0 == 3
+    ref = _with_fallback(lambda: dec.generate(
+        prompt, max_new_tokens=8, draft_model=draft,
+        num_speculative_tokens=3))
+    np.testing.assert_array_equal(fused, ref)
+    # speculation never changes greedy output
+    np.testing.assert_array_equal(fused, dec.generate(prompt,
+                                                      max_new_tokens=8))
+
+
+def test_speculative_validation_errors():
+    model = _model(10)
+    dec = LlamaDecoder(model, max_len=20)
+    prompt = np.array([[1, 2, 3]])
+    with pytest.raises(ValueError, match="skip"):
+        dec.generate(prompt, max_new_tokens=4, draft_model="skip:0")
+    with pytest.raises(ValueError, match="skip"):
+        dec.generate(prompt, max_new_tokens=4, draft_model="skip:2")
+    with pytest.raises(ValueError, match="draft_model must be"):
+        dec.generate(prompt, max_new_tokens=4, draft_model="tiny")
+    with pytest.raises(ValueError, match=">= 1"):
+        dec.generate(prompt, max_new_tokens=4, draft_model="skip:1",
+                     num_speculative_tokens=0)
+    with pytest.raises(ValueError, match="requires a draft_model"):
+        dec.generate(prompt, max_new_tokens=4, num_speculative_tokens=2)
+    # speculative rounds can overshoot by K: the cache must have slack
+    with pytest.raises(ValueError, match="slack"):
+        dec.generate(prompt, max_new_tokens=17, draft_model="skip:1",
+                     num_speculative_tokens=2)
+    paddle.seed(11)
+    bad_vocab = LlamaForCausalLM(LlamaConfig(**{**CFG, "vocab_size": 32}))
+    with pytest.raises(ValueError, match="vocab"):
+        dec.generate(prompt, max_new_tokens=4, draft_model=bad_vocab)
+
+
+def test_trim_after_eos_edge_cases():
+    """Satellite: first-emitted-token-is-eos and negative-eos ("none")
+    conventions are uniform across LlamaDecoder.generate,
+    generate_tokens, and the trim helper itself."""
+    from paddle_tpu.inference.generate import (_normalize_eos,
+                                               _trim_after_eos)
+    from paddle_tpu.nn.generation import generate_tokens
+
+    # unit: a row whose FIRST token is eos contributes length 1, never 0
+    toks = np.array([[7, 1, 2, 3]])
+    np.testing.assert_array_equal(_trim_after_eos(toks, 7), [[7]])
+    # no row hits eos: full length retained
+    np.testing.assert_array_equal(_trim_after_eos(toks, 9), toks)
+    # trim length is the LATEST first-eos across rows
+    toks2 = np.array([[7, 7, 7, 7], [1, 2, 7, 7]])
+    np.testing.assert_array_equal(_trim_after_eos(toks2, 7),
+                                  toks2[:, :3])
+    assert _normalize_eos(None) is None
+    assert _normalize_eos(-1) is None
+    assert _normalize_eos(-5) is None
+    assert _normalize_eos(3) == 3
+
+    model = _model(12)
+    dec = LlamaDecoder(model, max_len=32)
+    prompt = np.array([[1, 2, 3], [4, 5, 6]])
+    free = dec.generate(prompt, max_new_tokens=8)
+    # negative eos == None: the bundles' "-1 means no eos" convention
+    np.testing.assert_array_equal(
+        dec.generate(prompt, max_new_tokens=8, eos_token_id=-1), free)
+    # eos == the very first emitted token of BOTH rows: output is
+    # prompt + exactly one (eos) column, fused and fallback alike
+    eos01 = int(free[0, 3])
+    forced = np.array([[1, 2, 3], [1, 2, 3]])
+    out = dec.generate(forced, max_new_tokens=8, eos_token_id=eos01)
+    assert out.shape == (2, 4)
+    assert np.all(out[:, 3] == eos01)
+    ref = _with_fallback(lambda: dec.generate(forced, max_new_tokens=8,
+                                              eos_token_id=eos01))
+    np.testing.assert_array_equal(out, ref)
+    # same conventions through the speculative path
+    sout = dec.generate(forced, max_new_tokens=8, eos_token_id=eos01,
+                        draft_model="skip:1", num_speculative_tokens=2)
+    np.testing.assert_array_equal(sout, out)
+    np.testing.assert_array_equal(
+        dec.generate(prompt, max_new_tokens=8, eos_token_id=-1,
+                     draft_model="skip:1", num_speculative_tokens=2),
+        free)
+
+    # generate_tokens: same negative-eos and first-token-eos handling
+    gfree = generate_tokens(model, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(
+        generate_tokens(model, prompt, max_new_tokens=6, eos_token_id=-1),
+        gfree)
+    g0 = int(gfree[0, 3])
+    gout = generate_tokens(model, forced, max_new_tokens=6,
+                           eos_token_id=g0)
+    assert gout.shape == (2, 4)
+    assert np.all(gout[:, 3] == g0)
+
+
+def test_runtime_temperature_is_not_a_static():
+    """Satellite: temperature is a runtime scalar input to the fused
+    decode programs — changing it never retraces (the same compiled
+    program serves any temperature) and still matches the per-token
+    fallback bit-exactly."""
+    model = _model(13)
+    dec = LlamaDecoder(model, max_len=32)
+    prompt = np.array([[1, 2, 3], [4, 5, 6]])
+    dec.generate(prompt, max_new_tokens=6, do_sample=True,
+                 temperature=0.8, seed=0)
+    # warm the fallback's step program too: only temperature-driven
+    # retraces should show up in the window below
+    _with_fallback(lambda: dec.generate(prompt, max_new_tokens=6,
+                                        do_sample=True, temperature=0.8,
+                                        seed=0))
+    t0 = dec.trace_count
+    for temp in (0.5, 1.0, 1.7):
+        fused = dec.generate(prompt, max_new_tokens=6, do_sample=True,
+                             temperature=temp, seed=1)
+        ref = _with_fallback(lambda: dec.generate(
+            prompt, max_new_tokens=6, do_sample=True, temperature=temp,
+            seed=1))
+        np.testing.assert_array_equal(fused, ref, err_msg=str(temp))
+    assert dec.trace_count == t0, "temperature change retraced the program"
+    # speculative program too
+    dec2 = LlamaDecoder(model, max_len=40)
+    kw = dict(do_sample=True, top_k=8, seed=2, draft_model="skip:1",
+              num_speculative_tokens=2)
+    dec2.generate(prompt, max_new_tokens=6, temperature=0.8, **kw)
+    t0 = dec2.trace_count
+    dec2.generate(prompt, max_new_tokens=6, temperature=1.4, **kw)
+    assert dec2.trace_count == t0
+
+    # generate_tokens' fused program: one compiled entry across temps
+    from paddle_tpu.nn.generation import generate_tokens
+    generate_tokens(model, prompt, max_new_tokens=4, do_sample=True,
+                    temperature=0.6, seed=3)
+    jitted = model._ptpu_fused_generate
+    generate_tokens(model, prompt, max_new_tokens=4, do_sample=True,
+                    temperature=1.9, seed=3)
+    assert model._ptpu_fused_generate is jitted
+    assert jitted._cache_size() == 1
+
+
+def test_model_generate_speculative_surface_and_flag_default():
+    """The GenerationMixin surface threads draft_model/K through and
+    sizes the decoder cache with K slots of slack; with no explicit K
+    the ``decode_speculative_tokens`` flag supplies the default."""
+    model = _model(14)
+    prompt = np.array([[1, 2, 3]])
+    plain = model.generate(prompt, max_new_tokens=6)
+    out = model.generate(prompt, max_new_tokens=6, draft_model="skip:1",
+                         num_speculative_tokens=2)
+    np.testing.assert_array_equal(out, plain)  # greedy: invisible
+
+    paddle.set_flags({"decode_speculative_tokens": 2})
+    try:
+        out2 = model.generate(prompt, max_new_tokens=6,
+                              draft_model="skip:1")
+        np.testing.assert_array_equal(out2, plain)
+    finally:
+        paddle.set_flags({"decode_speculative_tokens": 4})

@@ -7,6 +7,9 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
   2. llama flagship bench (regression check for the flash masked-row
      guards + everything else this round touched)
   3. decode1b_served (the BASELINE served-decode row)
+  4. decode_modes (`bench.py --decode`): the fused-decode sweep incl.
+     the speculative rows (tokens/s, dispatch counts, mean acceptance
+     length) to be recorded into BASELINE.md
 
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
@@ -25,6 +28,7 @@ STEPS = [
     ("llama", [sys.executable, "bench.py"]),
     ("decode1b_served", [sys.executable, "bench.py", "--config",
                          "decode1b_served"]),
+    ("decode_modes", [sys.executable, "bench.py", "--decode"]),
 ]
 
 
