@@ -860,42 +860,44 @@ CONFIGS = {
     "decode1b_served": bench_decode_1b_served,
 }
 
-# exception-message markers treated as transient backend trouble worth a
-# backoff-retry (round-5 evidence loss: one UNAVAILABLE compile error cost
-# the whole BENCH artifact)
-TRANSIENT_MARKERS = ("UNAVAILABLE",)
-
-
 def _run_guarded(name, fn, attempts=3, base_delay=5.0, sleep=time.sleep):
-    """Run one bench config with >=3 backoff retries on transient backend
-    errors (``UNAVAILABLE: TPU backend setup/compile error`` and friends).
-    On final failure, emit a PARSEABLE BENCH json line carrying the
-    failure class as the last stdout line — never a raw-traceback rc=1
-    tail — then exit nonzero (traceback goes to stderr)."""
-    for i in range(1, attempts + 1):
-        try:
-            return fn()
-        except SystemExit:
-            raise
-        except Exception as e:
-            transient = any(m in str(e) for m in TRANSIENT_MARKERS)
-            if transient and i < attempts:
-                delay = base_delay * (2 ** (i - 1))
-                print(f"{name}: transient backend failure "
-                      f"(attempt {i}/{attempts}, retrying in {delay:.0f}s): "
-                      f"{str(e)[:300]}", file=sys.stderr)
-                sleep(delay)
-                continue
-            import traceback
-            traceback.print_exc(file=sys.stderr)
-            print(json.dumps({
-                "metric": name, "value": None, "unit": None,
-                "vs_baseline": None, "failed": True,
-                "failure_class": ("backend_unavailable" if transient
-                                  else type(e).__name__),
-                "error": str(e)[:400], "attempts": i,
-            }))
-            sys.exit(1)
+    """Run one bench config under the SHARED retry layer
+    (runtime/resilience.resilient_call — the round-5 private
+    ``TRANSIENT_MARKERS`` copy is gone): transient backend errors
+    (UNAVAILABLE / DEADLINE_EXCEEDED / ABORTED / connection drops, plus
+    RESOURCE_EXHAUSTED — bench runs are all setup phase) retry with
+    exponential backoff. On final failure, emit a PARSEABLE BENCH json
+    line carrying the failure class as the last stdout line — never a
+    raw-traceback rc=1 tail — then exit nonzero (traceback goes to
+    stderr)."""
+    from paddle_tpu.runtime.resilience import classify_error, resilient_call
+
+    retry_count = [0]
+
+    def _log_retry(ev):
+        retry_count[0] += 1
+        print(f"{name}: transient backend failure "
+              f"(attempt {ev.attempt}/{ev.max_attempts}, retrying in "
+              f"{ev.delay_s:.0f}s): {ev.error}", file=sys.stderr)
+
+    try:
+        return resilient_call(fn, retries=attempts - 1, backoff=base_delay,
+                              phase="setup", site=f"bench.{name}",
+                              on_event=_log_retry, sleep=sleep)
+    except SystemExit:
+        raise
+    except Exception as e:
+        transient = classify_error(e, phase="setup") == "transient"
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": name, "value": None, "unit": None,
+            "vs_baseline": None, "failed": True,
+            "failure_class": ("backend_unavailable" if transient
+                              else type(e).__name__),
+            "error": str(e)[:400], "attempts": retry_count[0] + 1,
+        }))
+        sys.exit(1)
 
 
 def main():

@@ -89,7 +89,7 @@ def __getattr__(name):
                 "static", "hapi", "device", "distribution", "sparse",
                 "quantization", "text", "audio", "fft", "signal", "onnx",
                 "linalg", "geometric", "hub", "inference", "native",
-                "cost_model"):
+                "cost_model", "runtime"):
         mod = _lazy(name)
         globals()[name] = mod
         return mod

@@ -17,10 +17,23 @@ Consequences (vs the round-2 global-value-per-rank design):
   arrays), and
 - cross-topology resume (tp4 -> tp2, different meshes at load) still
   works because stored slices carry global coordinates.
+
+Crash safety (runtime/resilience.py): every file — data npz, rank meta,
+merged metadata — is written to a temp name and atomically renamed, so a
+rank killed mid-save leaves either the previous checkpoint or the new
+one, never a torn file under a final name. The merged metadata carries a
+per-data-file sha256 manifest (hashed from the intended bytes BEFORE
+they hit disk); load verifies each data file when it is first opened and
+raises a typed ``CorruptCheckpointError`` on mismatch/absence — and only
+the files this process's read plan actually needs are opened, so
+corruption confined to shards owned elsewhere never blocks a load
+(the per-shard recovery path).
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
 import json
 import os
 from typing import Dict, List, Optional
@@ -30,8 +43,10 @@ import numpy as np
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.parallel.api import named_sharding
 from paddle_tpu.parallel.placements import Replicate, Shard
+from paddle_tpu.runtime.resilience import (CorruptCheckpointError,
+                                           atomic_write_bytes)
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "CorruptCheckpointError"]
 
 _META = "metadata.json"
 _RANK_META = "meta_r{rank}.json"
@@ -113,23 +128,39 @@ def save_state_dict(state_dict: Dict[str, "Tensor"], path: str,
                 "dtype": dtype_tag,
             })
         meta[name] = entry
-    np.savez(os.path.join(path, _DATA.format(rank=rank)), **arrays)
-    with open(os.path.join(path, _RANK_META.format(rank=rank)), "w") as f:
-        json.dump({"tensors": meta}, f)
+    # serialize the shard npz in memory, hash the INTENDED bytes, then
+    # write crash-safely (temp + atomic rename): a rank killed mid-save
+    # can never leave a torn file under the final name, and the manifest
+    # digest predates any disk corruption
+    fname = _DATA.format(rank=rank)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    digest = hashlib.sha256(payload).hexdigest()
+    atomic_write_bytes(os.path.join(path, fname), payload)
+    atomic_write_bytes(
+        os.path.join(path, _RANK_META.format(rank=rank)),
+        json.dumps({"tensors": meta,
+                    "files": {fname: {"sha256": digest,
+                                      "bytes": len(payload)}}}).encode())
     _sync("ckpt-save-shards")
     if rank == coordinator_rank:
         merged: Dict[str, dict] = {}
+        files: Dict[str, dict] = {}
         for r in range(nprocs):
             with open(os.path.join(path, _RANK_META.format(rank=r))) as f:
-                rmeta = json.load(f)["tensors"]
-            for name, entry in rmeta.items():
+                rj = json.load(f)
+            files.update(rj.get("files", {}))
+            for name, entry in rj["tensors"].items():
                 if name not in merged:
                     merged[name] = {k: v for k, v in entry.items()
                                     if k != "storage"}
                     merged[name]["storage"] = []
                 merged[name]["storage"].extend(entry["storage"])
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump({"version": 2, "tensors": merged}, f)
+        atomic_write_bytes(
+            os.path.join(path, _META),
+            json.dumps({"version": 3, "tensors": merged,
+                        "files": files}).encode())
     _sync("ckpt-save-meta")
 
 
@@ -150,11 +181,58 @@ def _target_sharding(t: Tensor):
     return None
 
 
+def _open_data(path: str, fname: str, files_manifest: Optional[dict],
+               cache: Dict[str, "np.lib.npyio.NpzFile"]):
+    """Open one shard data file for the read plan, verifying it first.
+
+    With a manifest entry the file's on-disk bytes are sha256-checked
+    against the save-time digest — a torn write (crash mid-shard) or a
+    flipped bit raises a typed :class:`CorruptCheckpointError` naming the
+    file, never a numpy parse error or silently wrong values. Files are
+    only opened when some needed slice lives in them, so a corrupt shard
+    owned entirely by other processes never blocks THIS process's load —
+    the per-shard recovery property."""
+    npz = cache.get(fname)
+    if npz is not None:
+        return npz
+    full = os.path.join(path, fname)
+    expect = (files_manifest or {}).get(fname)
+    try:
+        if expect is None:          # pre-manifest checkpoint: best effort
+            npz = np.load(full)
+        else:
+            with open(full, "rb") as f:
+                raw = f.read()
+            got = hashlib.sha256(raw).hexdigest()
+            if got != expect["sha256"]:
+                raise CorruptCheckpointError(
+                    f"checkpoint shard {fname} is corrupt: sha256 "
+                    f"{got[:16]}… != manifest {expect['sha256'][:16]}… "
+                    f"({len(raw)} bytes on disk, {expect['bytes']} "
+                    f"expected) — torn write or media corruption; "
+                    f"restore the shard or resave")
+            npz = np.load(io.BytesIO(raw))
+    except FileNotFoundError as e:
+        raise CorruptCheckpointError(
+            f"checkpoint shard {fname} is missing from {path} — "
+            f"incomplete save (crash before the shard was written?)"
+        ) from e
+    except (CorruptCheckpointError, MemoryError):
+        raise
+    except Exception as e:          # torn legacy file and friends
+        raise CorruptCheckpointError(
+            f"checkpoint shard {fname} failed to parse: {e}") from e
+    cache[fname] = npz
+    return npz
+
+
 def _assemble(entry: dict, want_offs: List[int], want_shape: List[int],
               cache: Dict[str, "np.lib.npyio.NpzFile"], path: str,
-              np_dtype) -> np.ndarray:
+              np_dtype, files_manifest: Optional[dict] = None
+              ) -> np.ndarray:
     """Read-plan execution: fill [want_offs, want_offs+want_shape) from the
-    stored pieces that overlap it (only those npz members are read)."""
+    stored pieces that overlap it (only those npz members — and only
+    those FILES, each sha256-verified on first open — are read)."""
     buf = np.zeros(tuple(want_shape), dtype=np_dtype)
     filled = 0
     for st in entry["storage"]:
@@ -165,19 +243,19 @@ def _assemble(entry: dict, want_offs: List[int], want_shape: List[int],
               zip(want_offs, want_shape, s_offs, s_shape)]
         if any(l >= h for l, h in zip(lo, hi)):
             continue
-        fname = st["file"]
-        if fname not in cache:
-            cache[fname] = np.load(os.path.join(path, fname))
-        piece = _np_restore(cache[fname][st["key"]], st["dtype"])
+        npz = _open_data(path, st["file"], files_manifest, cache)
+        piece = _np_restore(npz[st["key"]], st["dtype"])
         src = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, s_offs))
         dst = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, want_offs))
         buf[dst] = piece[src]
         filled += int(np.prod([h - l for l, h in zip(lo, hi)]))
     want = int(np.prod(want_shape)) if want_shape else 1
     if filled < want:
-        raise ValueError(
+        raise CorruptCheckpointError(
             f"checkpoint read plan incomplete: {filled}/{want} elements "
-            f"for slice at {want_offs} (shape {want_shape})")
+            f"for slice at {want_offs} (shape {want_shape}) — the "
+            f"checkpoint does not cover the requested region (partial "
+            f"save?)")
     return buf
 
 
@@ -189,9 +267,20 @@ def load_state_dict(state_dict: Dict[str, "Tensor"], path: str,
     import jax
     import jax.numpy as jnp
 
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
+    try:
+        with open(os.path.join(path, _META)) as f:
+            meta = json.load(f)
+    except FileNotFoundError as e:
+        raise CorruptCheckpointError(
+            f"no {_META} in {path} — the save never completed its "
+            f"metadata merge (crash mid-save?) or the path is not a "
+            f"checkpoint directory") from e
+    except json.JSONDecodeError as e:
+        raise CorruptCheckpointError(
+            f"{_META} in {path} is not valid JSON: torn metadata "
+            f"write") from e
     tensors_meta = meta["tensors"]
+    files_manifest = meta.get("files")
     cache: Dict[str, np.lib.npyio.NpzFile] = {}
     for name, t in state_dict.items():
         entry = tensors_meta.get(name)
@@ -206,7 +295,7 @@ def load_state_dict(state_dict: Dict[str, "Tensor"], path: str,
         sharding = _target_sharding(t)
         if sharding is None:
             full = _assemble(entry, [0] * len(gshape), list(gshape),
-                             cache, path, np_dtype)
+                             cache, path, np_dtype, files_manifest)
             t._set_value(jnp.asarray(full, dtype=t.dtype))
             continue
         idx_map = sharding.addressable_devices_indices_map(gshape)
@@ -221,7 +310,8 @@ def load_state_dict(state_dict: Dict[str, "Tensor"], path: str,
             offs, exts = _shard_offsets(index, gshape)
             key = tuple(offs)
             if key not in bufs:
-                buf = _assemble(entry, offs, exts, cache, path, np_dtype)
+                buf = _assemble(entry, offs, exts, cache, path, np_dtype,
+                                files_manifest)
                 if buf.dtype != dst_dtype:
                     buf = buf.astype(dst_dtype)
                 bufs[key] = buf

@@ -7,13 +7,25 @@ native TCPStore plays the etcd role (no external dependency), nodes
 register with heartbeats, the manager watches membership within an
 ``np="min:max"`` range and signals scale events so the launcher restarts
 training from the latest distributed checkpoint.
+
+Liveness is judged with OBSERVER-LOCAL ``time.monotonic()`` bookkeeping,
+not sender wall-clock timestamps: each heartbeat publishes an opaque
+monotonically-changing value (boot nonce + sequence number), and every
+observer tracks when it last SAW each node's value change on its own
+monotonic clock. Consequences: NTP steps / wall-clock adjustments can't
+expire healthy members or resurrect dead ones, the scheme needs no
+clock agreement between hosts, and a node restart (fresh nonce) reads
+as a change — no stale-sequence collision. Heartbeats route through the
+fault injector (``dead_heartbeat`` / ``delay_heartbeat`` plans), so
+preemption drills run without killing real processes.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["ElasticManager", "ElasticStatus"]
 
@@ -41,14 +53,25 @@ class ElasticManager:
         self._stop = threading.Event()
         self._members: List[str] = []
         self._thread: Optional[threading.Thread] = None
+        # boot nonce: a restarted node's fresh sequence can never collide
+        # with the value an observer cached from its previous life
+        self._nonce = f"{os.getpid():x}-{id(self):x}"
+        self._seq = 0
+        # observer-local liveness: node -> (last value seen, monotonic
+        # time the value last CHANGED on THIS observer's clock)
+        self._seen: Dict[str, Tuple[bytes, float]] = {}
 
     # -- registry (manager.py:217 heartbeat analog over TCPStore) ----------
     def _beat(self):
+        from paddle_tpu.runtime.resilience import fault_injector
+        if fault_injector.heartbeat_action(self.node_id) != "ok":
+            return    # injected dead/delayed heartbeat (preemption drill)
+        self._seq += 1
         self.store.set(f"__elastic__/node/{self.node_id}",
-                       str(time.time()).encode())
+                       f"{self._nonce}:{self._seq}".encode())
 
     def _alive_nodes(self) -> List[str]:
-        now = time.time()
+        now = time.monotonic()
         alive = []
         idx = self.store.get("__elastic__/index")
         known = (idx.decode().split(",") if idx else [])
@@ -57,7 +80,13 @@ class ElasticManager:
             self.store.set("__elastic__/index", ",".join(sorted(known)))
         for nid in known:
             v = self.store.get(f"__elastic__/node/{nid}")
-            if v is not None and now - float(v) < self.ttl_s:
+            if v is None:
+                continue
+            prev = self._seen.get(nid)
+            if prev is None or prev[0] != v:
+                self._seen[nid] = (v, now)   # value changed: beat observed
+                alive.append(nid)
+            elif now - prev[1] < self.ttl_s:
                 alive.append(nid)
         return sorted(alive)
 

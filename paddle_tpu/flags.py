@@ -163,6 +163,25 @@ define_flag("decode_speculative_tokens", 4,
             "draft_model without an explicit num_speculative_tokens; the "
             "target scores all K+1 positions in one batched forward "
             "inside the one-dispatch decode program")
+define_flag("resilience_retries", 3,
+            "transient-backend-error retries per device dispatch in "
+            "runtime/resilience.resilient_call (UNAVAILABLE / "
+            "DEADLINE_EXCEEDED / ABORTED, plus RESOURCE_EXHAUSTED during "
+            "setup); 0 disables retrying")
+define_flag("resilience_backoff_s", 0.5,
+            "base exponential-backoff delay (seconds) between "
+            "resilient_call retries: attempt i sleeps base * 2**(i-1)")
+define_flag("resilience_deadline_s", 0.0,
+            "total wall-clock budget (seconds) a resilient_call may "
+            "spend retrying before the last transient error propagates; "
+            "0 means no deadline")
+define_flag("resilience_auto_degrade", True,
+            "step the decode ladder down automatically on dispatch "
+            "failure (fused speculative -> fused plain -> per-token "
+            "fallback), recording a typed DegradationEvent per step; "
+            "off = the first level's error propagates (the pre-round-8 "
+            "behavior, where only the manual decode_fallback flag could "
+            "change the path)")
 define_flag("decode_cache_layout", "stacked",
             "KV-cache layout for the compiled decoder: 'per_layer' "
             "(one (B, L, KV, D) buffer per layer) or 'stacked' "

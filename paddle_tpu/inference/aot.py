@@ -9,7 +9,8 @@ model code, it just feeds the deserialized executable.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+import hashlib
+from typing import Callable, Optional, Sequence
 
 import jax
 from jax import export as _jexport
@@ -20,28 +21,47 @@ _MAGIC = b"PTPU-AOT1\n"
 
 
 def save_compiled(fn: Callable, example_args: Sequence, path: str,
-                  donate_argnums=()) -> None:
+                  donate_argnums=()) -> str:
     """Trace+lower ``fn`` at the example args' shapes/dtypes and write the
     serialized StableHLO executable to ``path`` (save_inference_model
     analog). The export is shape-polymorphism-free: static shapes are the
-    TPU deployment contract."""
+    TPU deployment contract. The write is crash-safe (temp + atomic
+    rename — a killed exporter never leaves a half-written module under
+    the final name). Returns the sha256 hexdigest of the INTENDED file
+    bytes, computed before the write hits disk, so bundle manifests can
+    refuse any later on-disk corruption (inference/bundle.py)."""
     exp = _jexport.export(jax.jit(fn, donate_argnums=donate_argnums))(
         *example_args)
     blob = exp.serialize()
     # raw StableHLO bytes after the magic — NOT pickle: loading a model
     # artifact must never execute arbitrary code from the file
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        f.write(bytes(blob))
+    from paddle_tpu.runtime.resilience import atomic_write_bytes
+    payload = _MAGIC + bytes(blob)
+    digest = hashlib.sha256(payload).hexdigest()
+    atomic_write_bytes(path, payload)
+    return digest
 
 
-def load_compiled(path: str) -> Callable:
+def load_compiled(path: str, expected_sha256: Optional[str] = None
+                  ) -> Callable:
     """Load an AOT-exported executable; returns a callable. No Python model
-    code runs — the deserialized module is invoked directly."""
+    code runs — the deserialized module is invoked directly. With
+    ``expected_sha256`` (a bundle-manifest digest) the file bytes are
+    verified first and a mismatch — a flipped bit in the baked weight
+    constants, a truncated module — raises a typed
+    ``CorruptBundleError`` instead of serving wrong numerics."""
     with open(path, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise ValueError(f"{path}: not a paddle_tpu AOT export")
-        blob = f.read()
+        raw = f.read()
+    if expected_sha256 is not None:
+        got = hashlib.sha256(raw).hexdigest()
+        if got != expected_sha256:
+            from paddle_tpu.runtime.resilience import CorruptBundleError
+            raise CorruptBundleError(
+                f"{path}: sha256 {got[:16]}… does not match the bundle "
+                f"manifest's {expected_sha256[:16]}… — refusing to serve "
+                f"a corrupt module ({len(raw)} bytes on disk)")
+    magic, blob = raw[:len(_MAGIC)], raw[len(_MAGIC):]
+    if magic != _MAGIC:
+        raise ValueError(f"{path}: not a paddle_tpu AOT export")
     exp = _jexport.deserialize(bytearray(blob))
     return lambda *args: exp.call(*args)

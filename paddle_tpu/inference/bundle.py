@@ -38,13 +38,24 @@ _META = "bundle.json"
 
 
 def _save_exp(fn, args, path, donate_argnums=()):
+    """Export one entry module (crash-safe write) and return its sha256
+    for the bundle manifest."""
     from paddle_tpu.inference.aot import save_compiled
-    save_compiled(fn, args, path, donate_argnums=donate_argnums)
+    return save_compiled(fn, args, path, donate_argnums=donate_argnums)
 
 
-def _load_exp(path):
+def _load_exp(path, expected_sha256=None):
     from paddle_tpu.inference.aot import load_compiled
-    return load_compiled(path)
+    return load_compiled(path, expected_sha256=expected_sha256)
+
+
+def _write_meta(out_dir: str, meta: dict) -> None:
+    """bundle.json write: temp + atomic rename, so a killed exporter
+    leaves either the previous metadata or the new one — never a torn
+    JSON that would poison every later load."""
+    from paddle_tpu.runtime.resilience import atomic_write_bytes
+    atomic_write_bytes(os.path.join(out_dir, _META),
+                       json.dumps(meta, indent=2).encode())
 
 
 def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
@@ -78,6 +89,7 @@ def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
     os.makedirs(out_dir, exist_ok=True)
     examples = [jnp.asarray(a) for a in example_inputs]
     buckets = []
+    manifest = {}
     shapes_list = [tuple(tuple(a.shape) for a in examples)]
     for b in extra_batch_sizes:
         shapes_list.append(tuple((int(b),) + tuple(a.shape[1:])
@@ -86,7 +98,8 @@ def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
         args = [jnp.zeros(s, a.dtype) for s, a in zip(shapes, examples)]
         tag = "predict_" + "_".join(
             "x".join(map(str, s)) for s in shapes)
-        _save_exp(fwd, args, os.path.join(out_dir, tag + ".aot"))
+        manifest[tag + ".aot"] = _save_exp(
+            fwd, args, os.path.join(out_dir, tag + ".aot"))
         buckets.append({"file": tag + ".aot",
                         "shapes": [list(s) for s in shapes],
                         "dtypes": [str(a.dtype) for a in examples]})
@@ -97,6 +110,7 @@ def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
         "inputs": input_names or [f"x{i}" for i in range(len(examples))],
         "outputs": output_names or [f"out_{i}" for i in range(n_out)],
         "buckets": buckets,
+        "manifest": manifest,
     }
     # Identify which outputs are batch-major BY CONSTRUCTION (abstract
     # re-trace at a different batch: an output is batch-major iff its
@@ -117,8 +131,7 @@ def export_predict_bundle(layer, example_inputs: Sequence[np.ndarray],
         # batch-polymorphic retrace unsupported (e.g. batch-baked model):
         # leave batch axes unknown -> run() serves exact shapes only
         pass
-    with open(os.path.join(out_dir, _META), "w") as f:
-        json.dump(meta, f, indent=2)
+    _write_meta(out_dir, meta)
 
 
 def export_decoder_bundle(decoder, out_dir: str,
@@ -130,8 +143,8 @@ def export_decoder_bundle(decoder, out_dir: str,
                           top_k: Optional[int] = None,
                           top_p: Optional[float] = None,
                           draft_model=None,
-                          num_speculative_tokens: Optional[int] = None
-                          ) -> None:
+                          num_speculative_tokens: Optional[int] = None,
+                          plain_fallback: bool = True) -> None:
     """Export a ``LlamaDecoder`` as prefill + fused scan-decode AOT
     entries (the compiled-decode serving artifact the reference ships via
     its generation ops + AnalysisPredictor). One prefill module per
@@ -154,7 +167,14 @@ def export_decoder_bundle(decoder, out_dir: str,
     and returns (tokens, rounds, accepted), and ``decode_mode``
     records the speculation statics. For speculative buckets ``N`` is
     the OUTPUT BUFFER size (serves max_new_tokens <= N); plain buckets
-    keep the scan-steps meaning (serves max_new_tokens <= N + 1)."""
+    keep the scan-steps meaning (serves max_new_tokens <= N + 1).
+
+    ``plain_fallback`` (default on, speculative bundles only) also
+    exports a plain fused decode entry per bucket — the serve-side
+    degradation ladder's lower rung: when the speculative entry keeps
+    failing dispatch at serve time, AotPredictor steps down to the plain
+    entry automatically (bit-exact for greedy bundles) instead of
+    failing the request."""
     import jax
     import jax.numpy as jnp
 
@@ -180,6 +200,7 @@ def export_decoder_bundle(decoder, out_dir: str,
         raise ValueError("num_speculative_tokens requires a draft_model")
     prefills, dprefills, decodes = [], [], []
     caches, dcaches = {}, {}
+    manifest = {}
 
     def _cache_meta(kc):
         leaves = jax.tree_util.tree_leaves(kc)
@@ -201,9 +222,10 @@ def export_decoder_bundle(decoder, out_dir: str,
                 return decoder._prefill(p, ids, kc, vc)
 
             tag = f"prefill_b{B}_s{S}"
-            _save_exp(prefill, (ids, kc, vc),
-                      os.path.join(out_dir, tag + ".aot"),
-                      donate_argnums=(1, 2))
+            manifest[tag + ".aot"] = _save_exp(
+                prefill, (ids, kc, vc),
+                os.path.join(out_dir, tag + ".aot"),
+                donate_argnums=(1, 2))
             prefills.append({"file": tag + ".aot", "batch": int(B),
                              "seq": int(S)})
             if eng is not None:
@@ -211,9 +233,10 @@ def export_decoder_bundle(decoder, out_dir: str,
                     return eng["prefill"](eng["params"], ids, dkc, dvc)
 
                 dtag = f"draft_prefill_b{B}_s{S}"
-                _save_exp(dprefill, (ids, dkc, dvc),
-                          os.path.join(out_dir, dtag + ".aot"),
-                          donate_argnums=(1, 2))
+                manifest[dtag + ".aot"] = _save_exp(
+                    dprefill, (ids, dkc, dvc),
+                    os.path.join(out_dir, dtag + ".aot"),
+                    donate_argnums=(1, 2))
                 dprefills.append({"file": dtag + ".aot", "batch": int(B),
                                   "seq": int(S)})
         logits_sds = jax.eval_shape(
@@ -236,10 +259,11 @@ def export_decoder_bundle(decoder, out_dir: str,
                         top_k=None if top_k is None else int(top_k),
                         top_p=None if top_p is None else float(top_p))
 
-                _save_exp(decode,
-                          (logits0, kc, vc, pos0, key0, done0, eos0, temp0),
-                          os.path.join(out_dir, tag + ".aot"),
-                          donate_argnums=(1, 2))
+                manifest[tag + ".aot"] = _save_exp(
+                    decode,
+                    (logits0, kc, vc, pos0, key0, done0, eos0, temp0),
+                    os.path.join(out_dir, tag + ".aot"),
+                    donate_argnums=(1, 2))
                 decodes.append({"file": tag + ".aot", "batch": int(B),
                                 "steps": int(N)})
             else:
@@ -252,13 +276,35 @@ def export_decoder_bundle(decoder, out_dir: str,
                         top_k=None if top_k is None else int(top_k),
                         top_p=None if top_p is None else float(top_p))
 
-                _save_exp(decode,
-                          (logits0, kc, vc, dkc, dvc, pos0, key0, done0,
-                           eos0, temp0),
-                          os.path.join(out_dir, tag + ".aot"),
-                          donate_argnums=(1, 2, 3, 4))
+                manifest[tag + ".aot"] = _save_exp(
+                    decode,
+                    (logits0, kc, vc, dkc, dvc, pos0, key0, done0,
+                     eos0, temp0),
+                    os.path.join(out_dir, tag + ".aot"),
+                    donate_argnums=(1, 2, 3, 4))
                 decodes.append({"file": tag + ".aot", "batch": int(B),
                                 "steps": int(N), "speculative": True})
+                if plain_fallback and N >= 1:
+                    # the ladder's lower rung: a plain fused entry with
+                    # the SAME serve capacity (N tokens) as the
+                    # speculative buffer above it
+                    def pdecode(logits, kc, vc, pos, key, done, eos,
+                                temp, N=int(N)):
+                        return decoder._fused_decode(
+                            p, logits, kc, vc, pos, key, done, eos, temp,
+                            steps=N - 1, do_sample=bool(do_sample),
+                            use_eos=True,
+                            top_k=None if top_k is None else int(top_k),
+                            top_p=None if top_p is None else float(top_p))
+
+                    ptag = f"decode_plain_b{B}_n{N}"
+                    manifest[ptag + ".aot"] = _save_exp(
+                        pdecode,
+                        (logits0, kc, vc, pos0, key0, done0, eos0, temp0),
+                        os.path.join(out_dir, ptag + ".aot"),
+                        donate_argnums=(1, 2))
+                    decodes.append({"file": ptag + ".aot",
+                                    "batch": int(B), "steps": int(N) - 1})
     # the fused-decode serving contract: key/done/eos/temperature are
     # runtime inputs; do_sample/top_k/top_p (and the speculation statics)
     # were baked at export
@@ -288,12 +334,15 @@ def export_decoder_bundle(decoder, out_dir: str,
         "prefill_buckets": prefills,
         "decode_buckets": decodes,
         "decode_mode": mode,
+        # per-file sha256 of the intended bytes (computed BEFORE the
+        # write hit disk): AotPredictor verifies each entry at load and
+        # refuses corrupt modules with a typed CorruptBundleError
+        "manifest": manifest,
     }
     if eng is not None:
         meta["draft_caches"] = dcaches
         meta["draft_prefill_buckets"] = dprefills
-    with open(os.path.join(out_dir, _META), "w") as f:
-        json.dump(meta, f, indent=2)
+    _write_meta(out_dir, meta)
 
 
 class AotPredictor:
@@ -333,6 +382,9 @@ class AotPredictor:
         self.padded_calls = 0      # observability: nearest-bucket serves
         self.last_spec_stats = None  # speculative bundles: last generate's
         #                              round/acceptance totals
+        self.last_resilience = None  # retry/degradation record of the
+        #                              last generate (also on the result)
+        self._events = []
         if warmup:
             self.warmup()
 
@@ -346,9 +398,31 @@ class AotPredictor:
     def _entry(self, fname):
         fn = self._entries.get(fname)
         if fn is None:
-            fn = _load_exp(os.path.join(self._dir, fname))
+            # verify-on-load: bundles carrying a manifest get each entry's
+            # on-disk bytes checked against the export-time sha256 — a
+            # bit-flipped weight constant raises CorruptBundleError here
+            # instead of silently serving wrong numerics. Pre-manifest
+            # bundles load unchecked (legacy contract).
+            expected = (self.meta.get("manifest") or {}).get(fname)
+            fn = _load_exp(os.path.join(self._dir, fname),
+                           expected_sha256=expected)
             self._entries[fname] = fn
         return fn
+
+    def _run_entry(self, fname, site, *args):
+        """Execute one exported module under the resilience contract:
+        the fault-injection hook fires first, then transient backend
+        errors retry with backoff; retry events accumulate on the
+        in-flight generate/run record."""
+        from paddle_tpu.runtime.resilience import (fault_injector,
+                                                   resilient_call)
+
+        def attempt():
+            fault_injector.on_call(site)
+            return self._entry(fname)(*args)
+
+        return resilient_call(attempt, site=site,
+                              on_event=self._events.append)
 
     # -- config/ops surface ------------------------------------------------
     def warmup(self) -> None:
@@ -369,7 +443,6 @@ class AotPredictor:
         decode_by_batch: Dict[int, list] = {}
         for dc in self.meta["decode_buckets"]:
             decode_by_batch.setdefault(dc["batch"], []).append(dc)
-        spec = (self.meta.get("decode_mode") or {}).get("speculative")
         for pf in self.meta["prefill_buckets"]:
             B = pf["batch"]
             decs = decode_by_batch.get(B, [None]) \
@@ -381,7 +454,7 @@ class AotPredictor:
                 if dc is None:
                     continue
                 draft_caches = None
-                if spec is not None:
+                if dc.get("speculative"):
                     dpf = next(b for b in self.meta["draft_prefill_buckets"]
                                if b["batch"] == B and b["seq"] == pf["seq"])
                     dkc, dvc = self._make_cache(B, "draft_caches")
@@ -430,10 +503,11 @@ class AotPredictor:
         names = self.meta["inputs"]
         args = [np.asarray(feeds[n]) for n in names]
         shapes = tuple(tuple(a.shape) for a in args)
+        self._events = []
         for b in self.meta["buckets"]:
             if tuple(tuple(s) for s in b["shapes"]) == shapes:
                 args = [self._cast(a, d) for a, d in zip(args, b["dtypes"])]
-                outs = self._entry(b["file"])(*args)
+                outs = self._run_entry(b["file"], "bundle.predict", *args)
                 outs = outs if isinstance(outs, (list, tuple)) else [outs]
                 return {n: np.asarray(o)
                         for n, o in zip(self.meta["outputs"], outs)}
@@ -460,7 +534,7 @@ class AotPredictor:
                 a = self._cast(a, d)
                 pad = np.zeros((nb - a.shape[0],) + a.shape[1:], a.dtype)
                 padded.append(np.concatenate([a, pad], axis=0))
-            outs = self._entry(b["file"])(*padded)
+            outs = self._run_entry(b["file"], "bundle.predict", *padded)
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
             # trim ONLY the outputs the exporter identified as batch-major
             # (abstract re-trace at a second batch size); a non-batch
@@ -575,6 +649,9 @@ class AotPredictor:
 
         ids = np.asarray(input_ids)
         B, S = ids.shape
+        # admission hook for batch-conditional faults (OOM above batch B)
+        from paddle_tpu.runtime.resilience import fault_injector
+        fault_injector.on_call("bundle.generate", batch=B)
         if S + max_new_tokens > self.meta["max_len"]:
             raise ValueError(
                 f"prompt {S} + {max_new_tokens} new tokens exceeds the "
@@ -603,8 +680,10 @@ class AotPredictor:
         def cap(b):
             return b["steps"] + (0 if b.get("speculative") else 1)
 
+        want_spec = spec is not None
         cands = [b for b in self.meta["decode_buckets"]
-                 if b["batch"] == nb and cap(b) >= max_new_tokens]
+                 if b["batch"] == nb and cap(b) >= max_new_tokens
+                 and bool(b.get("speculative")) == want_spec]
         if not cands:
             have = [(b["batch"], cap(b))
                     for b in self.meta["decode_buckets"]]
@@ -619,21 +698,73 @@ class AotPredictor:
             self.padded_calls += 1
             fed = np.concatenate(
                 [ids, np.zeros((nb - B, S), ids.dtype)], axis=0)
-        kc, vc = self._make_cache(nb)
-        logits, kc, vc = self._entry(pf["file"])(
-            jnp.asarray(fed, jnp.int32), kc, vc)
-        draft_caches = None
-        if spec is not None:
-            dpf = next(b for b in self.meta["draft_prefill_buckets"]
-                       if b["batch"] == nb and b["seq"] == S)
-            dkc, dvc = self._make_cache(nb, "draft_caches")
-            _, dkc, dvc = self._entry(dpf["file"])(
-                jnp.asarray(fed, jnp.int32), dkc, dvc)
-            draft_caches = (dkc, dvc)
-        out = self._entry(dc["file"])(*self._decode_args(
-            logits, kc, vc, S, nb, eos_token_id, seed,
-            temperature=temperature, draft_caches=draft_caches))
-        if spec is not None:
+        fed_d = jnp.asarray(fed, jnp.int32)
+
+        def run_level(dcb):
+            """One serve attempt at one decode bucket, from fresh caches
+            (a failed higher rung may have consumed its donated
+            buffers)."""
+            use_spec = bool(dcb.get("speculative"))
+            kc, vc = self._make_cache(nb)
+            logits, kc, vc = self._run_entry(pf["file"], "bundle.prefill",
+                                             fed_d, kc, vc)
+            draft_caches = None
+            if use_spec:
+                dpf = next(b for b in self.meta["draft_prefill_buckets"]
+                           if b["batch"] == nb and b["seq"] == S)
+                dkc, dvc = self._make_cache(nb, "draft_caches")
+                _, dkc, dvc = self._run_entry(
+                    dpf["file"], "bundle.draft_prefill", fed_d, dkc, dvc)
+                draft_caches = (dkc, dvc)
+            site = "bundle.spec_decode" if use_spec else "bundle.decode"
+            out = self._run_entry(dcb["file"], site,
+                                  *self._decode_args(
+                                      logits, kc, vc, S, nb, eos_token_id,
+                                      seed, temperature=temperature,
+                                      draft_caches=draft_caches))
+            return out, use_spec
+
+        # serve-side degradation ladder: the speculative bucket steps
+        # down to a plain fused bucket of the same batch/capacity when
+        # the bundle exported one (export_decoder_bundle plain_fallback)
+        ladder = [("speculative" if want_spec else "fused", dc)]
+        if want_spec:
+            plain = [b for b in self.meta["decode_buckets"]
+                     if b["batch"] == nb and not b.get("speculative")
+                     and cap(b) >= max_new_tokens]
+            if plain:
+                ladder.append(("fused", min(plain, key=cap)))
+
+        from paddle_tpu.flags import flags as _flags
+        from paddle_tpu.runtime.resilience import (
+            DecodeFailedError, DegradationEvent, GenerateResult,
+            classify_error, record_event)
+        self._events = []
+        self.last_resilience = None
+        degradations = []
+        out, use_spec, level = None, False, None
+        for li, (name, dcb) in enumerate(ladder):
+            try:
+                out, use_spec = run_level(dcb)
+                level = name
+                break
+            except Exception as e:
+                if classify_error(e) != "transient":
+                    raise
+                if (li == len(ladder) - 1
+                        or not _flags.resilience_auto_degrade):
+                    raise DecodeFailedError(
+                        f"bundle decode failed at ladder level {name!r} "
+                        f"with no further fallback: {str(e)[:300]}",
+                        events=list(self._events), last_error=e) from e
+                ev = DegradationEvent(
+                    site="bundle.generate", from_level=name,
+                    to_level=ladder[li + 1][0],
+                    error_class=type(e).__name__, error=str(e)[:300])
+                record_event(ev)
+                self._events.append(ev)
+                degradations.append(ev)
+        if use_spec:
             toks, sr, sa = out
             r, a = int(sr), int(sa)
             self.last_spec_stats = {
@@ -644,8 +775,19 @@ class AotPredictor:
             }
         else:
             toks = out
+            self.last_spec_stats = None
         toks = np.asarray(toks)[:B, :max_new_tokens]
         if eos_token_id is not None:
             from paddle_tpu.inference.generate import _trim_after_eos
             toks = _trim_after_eos(toks, int(eos_token_id))
-        return np.concatenate([ids, toks.astype(ids.dtype)], axis=1)
+        self.last_resilience = {
+            "level": level,
+            "requested_level": ladder[0][0],
+            "retries": sum(1 for e in self._events
+                           if getattr(e, "kind", "") == "retry"),
+            "degradations": [e.as_dict() for e in degradations],
+            "events": [e.as_dict() for e in self._events],
+        }
+        return GenerateResult.wrap(
+            np.concatenate([ids, toks.astype(ids.dtype)], axis=1),
+            self.last_resilience)

@@ -376,6 +376,15 @@ class LlamaDecoder:
     one-dispatch properties are assertable in tests; the per-token
     ``step`` / per-round speculative fallback remain behind the
     ``decode_fallback`` flag.
+
+    Resilience (runtime/resilience.py): every device dispatch retries
+    transient backend errors (UNAVAILABLE and friends) with exponential
+    backoff, and ``generate`` walks a DEGRADATION LADDER — fused
+    speculative -> fused plain -> per-token fallback — stepping down
+    automatically when a level keeps failing (``FLAGS_resilience_*``).
+    Each retry/degradation is a typed event; the record rides on the
+    returned array (``GenerateResult.resilience``) and on
+    ``self.last_resilience``.
     """
 
     def __init__(self, model: LlamaForCausalLM, max_len: int = 512,
@@ -404,6 +413,9 @@ class LlamaDecoder:
         self.dispatch_count = 0  # one per device program execution
         self._spec_engines = {}  # draft-model state for speculative decode
         self.last_spec_stats = None
+        self.last_resilience = None  # retry/degradation record of the last
+        #                              generate (also on the result array)
+        self._events = []        # typed events of the in-flight generate
 
         def prefill(p, ids, kc, vc):
             self.trace_count += 1
@@ -455,17 +467,31 @@ class LlamaDecoder:
             return jnp.concatenate([jnp.moveaxis(toks, 0, 1),
                                     last[:, None]], axis=1)
 
-        self._prefill = self._counted(jax.jit(prefill))
-        self._step = self._counted(jax.jit(step))
+        self._prefill = self._counted(jax.jit(prefill), "decode.prefill")
+        self._step = self._counted(jax.jit(step), "decode.step")
         self._fused_decode = self._counted(jax.jit(
             fused_decode,
             static_argnames=("steps", "do_sample", "use_eos", "top_k",
-                             "top_p")))
+                             "top_p")), "decode.fused")
 
-    def _counted(self, jitted):
-        def call(*args, **kwargs):
+    def _counted(self, jitted, site="decode.dispatch"):
+        """Count dispatches AND guard each one: the fault-injection hook
+        fires first (an injected failure is a dispatch that never ran, so
+        counters stay parity-comparable with the no-fault run), then the
+        execution retries transient backend errors with backoff
+        (resilient_call; FLAGS_resilience_retries/backoff_s). Retry
+        events land in the in-flight generate's record."""
+        from paddle_tpu.runtime.resilience import (fault_injector,
+                                                   resilient_call)
+
+        def attempt(args, kwargs):
+            fault_injector.on_call(site)
             self.dispatch_count += 1
             return jitted(*args, **kwargs)
+
+        def call(*args, **kwargs):
+            return resilient_call(attempt, args, kwargs, site=site,
+                                  on_event=self._events.append)
         return call
 
     def _empty_cache(self, B, cfg: Optional[LlamaConfig] = None):
@@ -598,12 +624,14 @@ class LlamaDecoder:
 
         eng = {
             "cfg": dcfg, "params": dp,
-            "prefill": self._counted(jax.jit(draft_prefill)),
+            "prefill": self._counted(jax.jit(draft_prefill),
+                                     "spec.prefill"),
             "round": self._counted(jax.jit(spec_round, static_argnames=(
-                "K", "do_sample", "use_eos", "top_k", "top_p"))),
+                "K", "do_sample", "use_eos", "top_k", "top_p")),
+                "spec.round"),
             "decode": self._counted(jax.jit(spec_decode, static_argnames=(
                 "max_new", "K", "do_sample", "use_eos", "top_k",
-                "top_p"))),
+                "top_p")), "spec.decode"),
         }
         self._spec_engines[ekey] = eng
         return eng
@@ -632,17 +660,36 @@ class LlamaDecoder:
         ``PADDLE_TPU_DECODE_FALLBACK=1`` to debug against the per-token
         (or per-speculative-round) host loop, which emits the same
         tokens for a fixed seed.
+
+        Dispatch failures walk the degradation ladder automatically
+        (``FLAGS_resilience_auto_degrade``): speculative falls back to
+        fused plain decode, fused to the per-token loop. Greedy levels
+        are bit-exact with each other, so degraded greedy output ==
+        the no-fault output; sampled levels preserve the distribution
+        but consume the RNG stream differently. The returned array
+        carries the retry/degradation record (``.resilience``); a run
+        whose every rung fails raises a typed ``DecodeFailedError``.
         """
-        import jax.random as jrandom
+        from paddle_tpu.flags import flags as _flags
+        from paddle_tpu.runtime.resilience import (
+            DecodeFailedError, DegradationEvent, GenerateResult,
+            classify_error, record_event)
 
         eos_token_id = _normalize_eos(eos_token_id)
         ids = jnp.asarray(np.asarray(input_ids))
         B, S = ids.shape
+        # admission hook: batch-conditional faults (the injected
+        # OOM-above-batch-B class) fire here, BEFORE any device work —
+        # steady-state RESOURCE_EXHAUSTED is fatal and propagates typed
+        from paddle_tpu.runtime.resilience import fault_injector
+        fault_injector.on_call("decode.generate", batch=B)
         if S + max_new_tokens > self.max_len:
             raise ValueError(f"prompt {S} + {max_new_tokens} new tokens "
                              f"exceeds max_len {self.max_len}")
         if max_new_tokens <= 0:
             return np.asarray(ids)
+        fallback = decode_fallback_active()
+        ladder = []
         if draft_model is not None:
             from paddle_tpu.flags import flags
             K = int(num_speculative_tokens
@@ -658,24 +705,69 @@ class LlamaDecoder:
                     f"exceeds max_len {self.max_len}; build the decoder "
                     f"with more slack")
             eng = self._spec_engine(draft_model)
-            gen = (self._generate_speculative_fallback
-                   if decode_fallback_active()
+            gen = (self._generate_speculative_fallback if fallback
                    else self._generate_speculative)
-            toks = gen(ids, max_new_tokens, eos_token_id, do_sample,
-                       temperature, top_k, top_p, seed, eng, K)
-            toks = np.asarray(toks)
-            if eos_token_id is not None:
-                toks = _trim_after_eos(toks, eos_token_id)
-            return np.concatenate(
-                [np.asarray(ids), toks.astype(np.asarray(ids).dtype)],
-                axis=1)
-        if num_speculative_tokens is not None:
+            ladder.append(("speculative", lambda: gen(
+                ids, max_new_tokens, eos_token_id, do_sample, temperature,
+                top_k, top_p, seed, eng, K)))
+        elif num_speculative_tokens is not None:
             raise ValueError("num_speculative_tokens requires a "
                              "draft_model")
-        if decode_fallback_active():
-            return self._generate_per_token(ids, max_new_tokens,
-                                            eos_token_id, do_sample,
-                                            temperature, top_k, top_p, seed)
+        if not fallback:
+            ladder.append(("fused", lambda: self._generate_fused(
+                ids, max_new_tokens, eos_token_id, do_sample, temperature,
+                top_k, top_p, seed)))
+        ladder.append(("per_token", lambda: self._generate_per_token(
+            ids, max_new_tokens, eos_token_id, do_sample, temperature,
+            top_k, top_p, seed)))
+
+        self._events = []
+        self.last_resilience = None
+        degradations = []
+        toks, level = None, None
+        for li, (name, run) in enumerate(ladder):
+            try:
+                toks = run()
+                level = name
+                break
+            except Exception as e:
+                if classify_error(e) != "transient":
+                    raise     # fatal (programming/capacity error): as-is
+                if (li == len(ladder) - 1
+                        or not _flags.resilience_auto_degrade):
+                    raise DecodeFailedError(
+                        f"decode failed at ladder level {name!r} with no "
+                        f"further fallback: {str(e)[:300]}",
+                        events=list(self._events), last_error=e) from e
+                ev = DegradationEvent(
+                    site="decode.generate", from_level=name,
+                    to_level=ladder[li + 1][0],
+                    error_class=type(e).__name__, error=str(e)[:300])
+                record_event(ev)
+                self._events.append(ev)
+                degradations.append(ev)
+        toks = np.asarray(toks)
+        if eos_token_id is not None:
+            toks = _trim_after_eos(toks, int(eos_token_id))
+        out = np.concatenate(
+            [np.asarray(ids), toks.astype(np.asarray(ids).dtype)], axis=1)
+        self.last_resilience = {
+            "level": level,
+            "requested_level": ladder[0][0],
+            "retries": sum(1 for e in self._events
+                           if getattr(e, "kind", "") == "retry"),
+            "degradations": [e.as_dict() for e in degradations],
+            "events": [e.as_dict() for e in self._events],
+        }
+        return GenerateResult.wrap(out, self.last_resilience)
+
+    def _generate_fused(self, ids, max_new_tokens, eos_token_id, do_sample,
+                        temperature, top_k, top_p, seed):
+        """Fused plain decode: prefill + ONE scan-loop dispatch. Returns
+        the untrimmed (B, max_new) token buffer."""
+        import jax.random as jrandom
+
+        B, S = ids.shape
         kc, vc = self._empty_cache(B)
         logits, kc, vc = self._prefill(self.params, ids, kc, vc)
         # raw uint32 key: same threefry stream as the fallback's typed key
@@ -684,18 +776,13 @@ class LlamaDecoder:
         done = jnp.zeros((B,), jnp.bool_)
         eos = jnp.asarray(-1 if eos_token_id is None else int(eos_token_id),
                           jnp.int32)
-        toks = self._fused_decode(
+        return self._fused_decode(
             self.params, logits, kc, vc, jnp.asarray(S, jnp.int32), key,
             done, eos, jnp.asarray(float(temperature), jnp.float32),
             steps=max_new_tokens - 1, do_sample=bool(do_sample),
             use_eos=eos_token_id is not None,
             top_k=None if top_k is None else int(top_k),
             top_p=None if top_p is None else float(top_p))
-        toks = np.asarray(toks)
-        if eos_token_id is not None:
-            toks = _trim_after_eos(toks, int(eos_token_id))
-        return np.concatenate(
-            [np.asarray(ids), toks.astype(np.asarray(ids).dtype)], axis=1)
 
     def _generate_speculative(self, ids, max_new, eos_norm, do_sample,
                               temperature, top_k, top_p, seed, eng, K):
@@ -798,16 +885,18 @@ class LlamaDecoder:
     def _generate_per_token(self, ids, max_new_tokens, eos_token_id,
                             do_sample, temperature, top_k, top_p, seed):
         """Per-token host loop (the pre-fused path): one device dispatch
-        per token plus a host sync each step. Kept only as the
-        ``decode_fallback`` debugging escape hatch and as the parity
-        reference the fused path is tested against."""
+        per token plus a host sync each step. Kept as the
+        ``decode_fallback`` debugging escape hatch, as the parity
+        reference the fused path is tested against, and as the decode
+        ladder's last rung. Returns the NEW tokens only (B, <=max_new) —
+        the caller owns prompt concat and eos trimming."""
         import jax.random as jrandom
 
         B, S = ids.shape
         kc, vc = self._empty_cache(B)
         logits, kc, vc = self._prefill(self.params, ids, kc, vc)
         key = jrandom.key(seed)
-        out = [ids]
+        out = []
         pos = S
         done = np.zeros((B,), bool)
         for i in range(max_new_tokens):

@@ -1,0 +1,32 @@
+"""paddle_tpu.runtime — process-level runtime services.
+
+First resident: the resilience layer (fault injection, typed
+transient-error retry, decode degradation ladder support) — the
+robustness spine under bench, decode serving, distributed checkpointing
+and the elastic manager. Reference capability: the elastic/fault-
+tolerant subsystem (PAPER §5.3: elastic manager, watchdog, fault-
+tolerant fleet).
+"""
+
+from paddle_tpu.runtime.resilience import (  # noqa: F401
+    CorruptBundleError,
+    CorruptCheckpointError,
+    DecodeFailedError,
+    DegradationEvent,
+    FaultEvent,
+    FaultInjector,
+    InjectedFault,
+    RetryEvent,
+    classify_error,
+    drain_events,
+    fault_injector,
+    recent_events,
+    resilient_call,
+)
+
+__all__ = [
+    "CorruptBundleError", "CorruptCheckpointError", "DecodeFailedError",
+    "DegradationEvent", "FaultEvent", "FaultInjector", "InjectedFault",
+    "RetryEvent", "classify_error", "drain_events", "fault_injector",
+    "recent_events", "resilient_call",
+]

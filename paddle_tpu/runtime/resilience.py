@@ -1,0 +1,529 @@
+"""Resilience layer: fault injection, typed retry, degradation support.
+
+Reference capability: the elastic/fault-tolerant training subsystem
+(PAPER §5.3 — elastic manager, watchdog, fault-tolerant fleet) and the
+serving stack's tolerance of TPU preemptions. A runtime meant for
+sustained traffic cannot treat transient ``UNAVAILABLE`` backend errors,
+preempted chips, or torn checkpoint writes as test-only events, so the
+whole repo shares ONE vocabulary for them here:
+
+- **FaultInjector** — a deterministic, flag-controlled injector usable
+  from tests, ``bench.py`` and ``tools/fault_matrix.py``. A *plan* (a
+  JSON list, programmatic or via the ``PADDLE_TPU_FAULT_PLAN`` env var)
+  names fault sites and schedules: a transient dispatch error on call N,
+  an OOM above batch B, a torn/corrupt byte on a checkpoint or bundle
+  write, a dead/delayed heartbeat. Injection points are explicit hooks
+  (``on_call`` / ``on_write`` via :func:`atomic_write_bytes` /
+  ``heartbeat_action``) placed in the decode, checkpoint, bundle and
+  elastic paths; with no plan configured every hook is a cheap no-op.
+
+- **resilient_call** — the one retry loop: classifies jax/XLA
+  exceptions into transient (``UNAVAILABLE``, ``DEADLINE_EXCEEDED``,
+  ``ABORTED``, connection drops; ``RESOURCE_EXHAUSTED`` only during
+  *setup*, where a neighbor's compile spike can steal HBM) vs fatal,
+  retries transients with exponential backoff under an optional
+  deadline, and emits structured :class:`RetryEvent` records. Replaces
+  the ad-hoc copy ``bench.py`` grew in round 5.
+
+- **Typed failures** — :class:`CorruptCheckpointError`,
+  :class:`CorruptBundleError`, :class:`DecodeFailedError`: the
+  documented terminal errors the fault matrix accepts. Anything else
+  escaping a fault drill is a bug.
+
+Degradation ladder (wired in ``inference/generate.py`` /
+``inference/bundle.py``): fused speculative decode → fused plain decode
+→ per-token fallback, stepping down automatically on dispatch failure
+and recording each step as a :class:`DegradationEvent`.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import fnmatch
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "RetryEvent", "DegradationEvent", "FaultEvent",
+    "InjectedFault", "CorruptCheckpointError", "CorruptBundleError",
+    "DecodeFailedError",
+    "classify_error", "resilient_call",
+    "FaultInjector", "fault_injector", "atomic_write_bytes",
+    "record_event", "drain_events", "recent_events",
+    "GenerateResult",
+]
+
+
+# ---------------------------------------------------------------------------
+# Typed events (the structured records retries/degradations/injections emit)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryEvent:
+    """One transient failure absorbed by ``resilient_call``."""
+    site: str
+    attempt: int            # 1-based attempt that failed
+    max_attempts: int
+    error_class: str
+    error: str              # truncated message
+    delay_s: float          # backoff slept before the next attempt
+    kind: str = "retry"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradationEvent:
+    """One automatic step down the decode ladder."""
+    site: str
+    from_level: str
+    to_level: str
+    error_class: str
+    error: str
+    kind: str = "degradation"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One injected fault firing (the injector's own audit record)."""
+    site: str
+    fault: str              # plan rule kind
+    detail: str
+    kind: str = "fault"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_EVENTS: "collections.deque" = collections.deque(maxlen=512)
+_EVENTS_LOCK = threading.Lock()
+
+
+def record_event(ev) -> None:
+    """Append a typed event to the bounded process-wide resilience log."""
+    with _EVENTS_LOCK:
+        _EVENTS.append(ev)
+
+
+def drain_events() -> List[Any]:
+    """Pop and return all logged events (tests/tools consume them)."""
+    with _EVENTS_LOCK:
+        out = list(_EVENTS)
+        _EVENTS.clear()
+    return out
+
+
+def recent_events() -> List[Any]:
+    """Non-destructive view of the logged events."""
+    with _EVENTS_LOCK:
+        return list(_EVENTS)
+
+
+# ---------------------------------------------------------------------------
+# Typed failures (the documented terminal errors of the fault taxonomy)
+# ---------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """Raised by FaultInjector hooks. The message STARTS with the status
+    code (``UNAVAILABLE: ...``) so the same marker classification handles
+    injected and real backend errors identically."""
+
+    def __init__(self, message: str, code: str = "UNAVAILABLE"):
+        super().__init__(message)
+        self.code = code
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed integrity verification (torn shard, sha256
+    mismatch, missing manifest) and the needed slices could not be
+    recovered from intact shards. Never raised for corruption in shards
+    this process does not need — that is the per-shard recovery path."""
+
+
+class CorruptBundleError(RuntimeError):
+    """An AOT bundle entry's bytes do not match the bundle manifest's
+    sha256 (bit-flipped weight constants, truncated module) — the entry
+    is refused rather than served."""
+
+
+class DecodeFailedError(RuntimeError):
+    """Every rung of the decode degradation ladder failed. Carries the
+    resilience events of the attempt and the last underlying error."""
+
+    def __init__(self, message: str, events: Optional[List[Any]] = None,
+                 last_error: Optional[BaseException] = None):
+        super().__init__(message)
+        self.events = list(events or [])
+        self.last_error = last_error
+
+
+# ---------------------------------------------------------------------------
+# Transient / fatal classification
+# ---------------------------------------------------------------------------
+
+# markers that indicate transient backend trouble in ANY phase — a retry
+# with backoff is worth it (the round-5 evidence loss: one UNAVAILABLE
+# compile error cost a whole BENCH artifact)
+TRANSIENT_MARKERS: Tuple[str, ...] = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "socket closed",
+    "Socket closed",
+    "Connection reset",
+    "connection reset",
+    "Failed to connect",
+    "failed to connect",
+    "context deadline exceeded",
+)
+
+# transient ONLY while setting up (compile/warmup/first dispatch): a
+# neighbor's compile spike or a not-yet-freed prior program can steal
+# HBM; in steady state the same error means the workload truly does not
+# fit and retrying is futile
+SETUP_TRANSIENT_MARKERS: Tuple[str, ...] = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+)
+
+
+def classify_error(exc: BaseException, phase: str = "steady") -> str:
+    """Classify a jax/XLA (or injected) exception: ``"transient"`` —
+    worth an exponential-backoff retry — or ``"fatal"``. ``phase`` is
+    ``"setup"`` (compiling/warming, where RESOURCE_EXHAUSTED is usually
+    a passing HBM spike) or ``"steady"``."""
+    msg = str(exc)
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return "transient"
+    if phase == "setup" and any(m in msg for m in SETUP_TRANSIENT_MARKERS):
+        return "transient"
+    return "fatal"
+
+
+def _flag(name: str, default):
+    try:
+        from paddle_tpu.flags import flags
+        return flags.get(name)
+    except Exception:
+        return default
+
+
+def resilient_call(fn: Callable, *args,
+                   retries: Optional[int] = None,
+                   backoff: Optional[float] = None,
+                   deadline_s: Optional[float] = None,
+                   phase: str = "steady",
+                   site: str = "call",
+                   classify: Optional[Callable] = None,
+                   on_event: Optional[Callable] = None,
+                   sleep: Callable[[float], None] = time.sleep,
+                   **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying transient backend errors.
+
+    Transient exceptions (see :func:`classify_error`; ``phase`` tunes the
+    RESOURCE_EXHAUSTED rule) are retried up to ``retries`` times with
+    exponential backoff ``backoff * 2**(i-1)`` seconds, bounded by
+    ``deadline_s`` of total elapsed time when given. Fatal exceptions —
+    and the last transient one once the budget is spent — propagate
+    unchanged, so callers keep the real error class. Each absorbed
+    failure emits a :class:`RetryEvent` to the process event log and to
+    ``on_event``. Defaults come from ``FLAGS_resilience_retries`` /
+    ``FLAGS_resilience_backoff_s`` / ``FLAGS_resilience_deadline_s``
+    (0 = no deadline)."""
+    if retries is None:
+        retries = int(_flag("resilience_retries", 3))
+    if backoff is None:
+        backoff = float(_flag("resilience_backoff_s", 0.5))
+    if deadline_s is None:
+        d = float(_flag("resilience_deadline_s", 0.0))
+        deadline_s = d if d > 0 else None
+    classify = classify or classify_error
+    attempts = max(1, retries + 1)
+    t0 = time.monotonic()
+    for i in range(1, attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if i >= attempts or classify(e, phase) != "transient":
+                raise
+            delay = backoff * (2 ** (i - 1))
+            if deadline_s is not None and \
+                    (time.monotonic() - t0) + delay > deadline_s:
+                raise
+            ev = RetryEvent(site=site, attempt=i, max_attempts=attempts,
+                            error_class=type(e).__name__,
+                            error=str(e)[:300], delay_s=delay)
+            record_event(ev)
+            if on_event is not None:
+                on_event(ev)
+            sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+class FaultInjector:
+    """Deterministic, plan-driven fault injection.
+
+    A plan is a list of rules (dicts). Sites/paths match with fnmatch
+    patterns; call/beat schedules are exact counters, so a given plan
+    fires at the same instant on every run. Rule kinds:
+
+    - ``{"kind": "dispatch_error", "site": "decode.fused", "call": 2,
+       "times": 1, "code": "UNAVAILABLE"}`` — raise an
+      :class:`InjectedFault` on the Nth matching ``on_call`` (1-based;
+      default the first), for ``times`` consecutive calls (default 1).
+    - ``{"kind": "oom", "site": "decode.*", "above_batch": 8}`` — raise
+      ``RESOURCE_EXHAUSTED`` whenever ``on_call`` sees ``batch`` above
+      the bound (default: every time; bound with ``times``).
+    - ``{"kind": "torn_write", "path": "*data_r0.npz", "at_byte": 100}``
+      — :func:`atomic_write_bytes` writes only the first ``at_byte``
+      bytes (default half) STRAIGHT to the destination — no atomic
+      rename — then raises, simulating a crash mid-write.
+    - ``{"kind": "bit_flip", "path": "*.aot", "at_byte": 7}`` — flip one
+      bit in the written bytes (default middle byte): silent media
+      corruption the sha256 manifests must catch on load.
+    - ``{"kind": "dead_heartbeat", "node": "node1", "after_beats": 3}``
+      — ``heartbeat_action`` reports the node dead (beats suppressed
+      forever) after N successful beats (default: immediately).
+    - ``{"kind": "delay_heartbeat", "node": "*", "after_beats": 2,
+       "skip_beats": 4}`` — suppress a window of beats, then resume
+      (the stalled-but-alive member).
+
+    Configure programmatically (``configure(plan)`` / ``clear()``) or
+    via the ``PADDLE_TPU_FAULT_PLAN`` env var (a JSON list, read once at
+    first use). Every firing appends a :class:`FaultEvent` to
+    ``self.fired`` and the process event log.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[dict] = []
+        self._counts: Dict[int, int] = {}   # rule idx -> matched count
+        self._beats: Dict[str, int] = {}    # node -> beats attempted
+        self._env_loaded = False
+        self.fired: List[FaultEvent] = []
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, plan) -> "FaultInjector":
+        """Install a plan (list of rule dicts, a single dict, or a JSON
+        string) and reset all schedule counters."""
+        if isinstance(plan, str):
+            plan = json.loads(plan)
+        if isinstance(plan, dict):
+            plan = [plan]
+        with self._lock:
+            self._rules = [dict(r) for r in (plan or [])]
+            self._counts = {}
+            self._beats = {}
+            self.fired = []
+            self._env_loaded = True   # explicit plan wins over the env
+        return self
+
+    def clear(self) -> None:
+        self.configure([])
+
+    def active(self) -> bool:
+        self._maybe_load_env()
+        return bool(self._rules)
+
+    def _maybe_load_env(self) -> None:
+        if self._env_loaded:
+            return
+        self._env_loaded = True
+        plan = os.environ.get("PADDLE_TPU_FAULT_PLAN", "").strip()
+        if plan:
+            parsed = json.loads(plan)
+            self._rules = [dict(r)
+                           for r in (parsed if isinstance(parsed, list)
+                                     else [parsed])]
+
+    def _fire(self, site: str, rule: dict, detail: str) -> None:
+        ev = FaultEvent(site=site, fault=rule["kind"], detail=detail)
+        self.fired.append(ev)
+        record_event(ev)
+
+    # -- hooks --------------------------------------------------------------
+    def on_call(self, site: str, batch: Optional[int] = None) -> None:
+        """Dispatch-shaped injection point. Placed where a device program
+        is about to execute; raises :class:`InjectedFault` when a
+        ``dispatch_error`` rule schedules a failure here. ``batch``
+        (passed by ADMISSION hooks like ``decode.generate``, not by raw
+        dispatch sites) additionally arms ``oom`` rules — a plan
+        targeting ``decode.*`` dispatch errors therefore never trips an
+        admission check, and vice versa."""
+        self._maybe_load_env()
+        if not self._rules:
+            return
+        with self._lock:
+            for idx, rule in enumerate(self._rules):
+                kind = rule.get("kind")
+                if not fnmatch.fnmatchcase(site, rule.get("site", "*")):
+                    continue
+                if kind == "oom":
+                    if batch is None or batch <= int(rule["above_batch"]):
+                        continue
+                    times = rule.get("times")   # default: structural
+                    n = self._counts.get(idx, 0)
+                    if times is not None and n >= int(times):
+                        continue
+                    self._counts[idx] = n + 1
+                    code = rule.get("code", "RESOURCE_EXHAUSTED")
+                    detail = (f"batch {batch} > {rule['above_batch']} "
+                              f"at {site}")
+                    self._fire(site, rule, detail)
+                    raise InjectedFault(
+                        f"{code}: injected OOM ({detail})", code=code)
+                if kind != "dispatch_error" or batch is not None:
+                    continue
+                # dispatch_error: exact call-count schedule
+                n = self._counts.get(idx, 0) + 1
+                self._counts[idx] = n
+                first = int(rule.get("call", 1))
+                times = int(rule.get("times", 1))
+                if first <= n < first + times:
+                    code = rule.get("code", "UNAVAILABLE")
+                    detail = f"call {n} at {site}"
+                    self._fire(site, rule, detail)
+                    raise InjectedFault(
+                        f"{code}: injected transient dispatch error "
+                        f"({detail})", code=code)
+
+    def on_write(self, path: str, data: bytes) -> Tuple[bytes, bool]:
+        """Write-shaped injection point. Returns ``(bytes_to_write,
+        crash)``: ``bit_flip`` corrupts the payload silently; a
+        ``torn_write`` truncates it AND sets ``crash`` — the caller must
+        write the torn bytes to the real destination (no rename) and
+        raise, simulating the process dying mid-write."""
+        self._maybe_load_env()
+        if not self._rules:
+            return data, False
+        name = os.path.basename(path)
+        with self._lock:
+            for idx, rule in enumerate(self._rules):
+                kind = rule.get("kind")
+                if kind not in ("torn_write", "bit_flip"):
+                    continue
+                pat = rule.get("path", "*")
+                if not (fnmatch.fnmatchcase(name, pat)
+                        or fnmatch.fnmatchcase(path, pat)):
+                    continue
+                n = self._counts.get(idx, 0)
+                if n >= int(rule.get("times", 1)):
+                    continue
+                self._counts[idx] = n + 1
+                if kind == "torn_write":
+                    cut = int(rule.get("at_byte", max(1, len(data) // 2)))
+                    cut = max(0, min(cut, len(data)))
+                    self._fire(path, rule,
+                               f"torn at byte {cut}/{len(data)}")
+                    return data[:cut], True
+                at = int(rule.get("at_byte", len(data) // 2))
+                at = max(0, min(at, max(0, len(data) - 1)))
+                corrupted = bytearray(data)
+                if corrupted:
+                    corrupted[at] ^= 0x01
+                self._fire(path, rule, f"bit flipped at byte {at}")
+                return bytes(corrupted), False
+        return data, False
+
+    def heartbeat_action(self, node: str) -> str:
+        """Heartbeat-shaped injection point: ``"ok"`` (beat normally),
+        ``"dead"`` (suppress forever) or ``"skip"`` (suppress this
+        beat)."""
+        self._maybe_load_env()
+        if not self._rules:
+            return "ok"
+        with self._lock:
+            beats = self._beats.get(node, 0)
+            self._beats[node] = beats + 1
+            for rule in self._rules:
+                kind = rule.get("kind")
+                if kind not in ("dead_heartbeat", "delay_heartbeat"):
+                    continue
+                if not fnmatch.fnmatchcase(node, rule.get("node", "*")):
+                    continue
+                after = int(rule.get("after_beats", 0))
+                if beats < after:
+                    continue
+                if kind == "dead_heartbeat":
+                    if beats == after:
+                        self._fire(node, rule,
+                                   f"heartbeat dead after {after} beats")
+                    return "dead"
+                skip = int(rule.get("skip_beats", 1))
+                if beats < after + skip:
+                    if beats == after:
+                        self._fire(node, rule,
+                                   f"heartbeat delayed {skip} beats "
+                                   f"after {after}")
+                    return "skip"
+        return "ok"
+
+
+fault_injector = FaultInjector()
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-safe file write: bytes go to ``path + '.tmp.<pid>'`` and are
+    fsynced before an atomic ``os.replace`` — a reader never observes a
+    half-written file. The one place torn/corrupt write faults inject:
+    a ``bit_flip`` plan corrupts the payload (still atomically renamed —
+    silent media corruption); a ``torn_write`` plan writes the truncated
+    prefix STRAIGHT to ``path`` and raises (the mid-write crash)."""
+    data, crash = fault_injector.on_write(path, bytes(data))
+    if crash:
+        with open(path, "wb") as f:
+            f.write(data)
+        raise InjectedFault(
+            f"DATA_LOSS: injected crash mid-write of {path} "
+            f"({len(data)} bytes written)", code="DATA_LOSS")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Decode result carrier
+# ---------------------------------------------------------------------------
+
+class GenerateResult(np.ndarray):
+    """An ``np.ndarray`` of tokens that additionally carries the
+    resilience record of the generate/serve call that produced it
+    (``.resilience``: dict with the final ladder level, retry count and
+    typed events) — drop-in for every existing caller, and the fault
+    matrix asserts on the attached record."""
+
+    resilience: Optional[dict] = None
+
+    @classmethod
+    def wrap(cls, arr: np.ndarray, resilience: Optional[dict]):
+        obj = np.asarray(arr).view(cls)
+        obj.resilience = resilience
+        return obj
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self.resilience = getattr(obj, "resilience", None)

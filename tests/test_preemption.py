@@ -32,14 +32,15 @@ def test_preemption_detect_and_resume(tmp_path):
     loss_fn = lambda m, x, y: paddle.nn.functional.cross_entropy(m(x), y)
 
     # --- epoch 0: two elastic members training; one gets preempted -------
-    # generous ttl: under a fully loaded machine (suite runs many compile
-    # jobs) heartbeat threads can starve for hundreds of ms; a tight ttl
-    # makes healthy members expire spuriously
+    # ttl = 5 heartbeat periods: liveness now runs on observer-local
+    # time.monotonic() bookkeeping (elastic.py), so wall-clock steps
+    # can't expire healthy members and the once-necessary 15x ttl
+    # cushion is back to a plain missed-beats budget
     store = TCPStore(is_master=True, world_size=1)
     survivor = ElasticManager(store, "node0", np_range="1:2",
-                              heartbeat_s=0.2, ttl_s=3.0)
+                              heartbeat_s=0.2, ttl_s=1.0)
     victim = ElasticManager(store, "node1", np_range="1:2",
-                            heartbeat_s=0.2, ttl_s=3.0)
+                            heartbeat_s=0.2, ttl_s=1.0)
     survivor.start()
     victim.start()
     deadline = time.monotonic() + 15

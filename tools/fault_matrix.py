@@ -1,0 +1,209 @@
+"""Fault-matrix runner: sweep every injectable fault class and gate on it.
+
+For each fault class the drill asserts the resilience contract
+(ISSUE/README "Robustness"): the system either RECOVERS with bit-exact
+output parity vs the no-fault run (and the retry/degradation counters
+say how), or raises a TYPED, documented error — never a raw traceback,
+never a silent wrong answer.
+
+Classes swept (decode + checkpoint + bundle + elastic paths):
+  transient_dispatch    one UNAVAILABLE on the fused decode dispatch ->
+                        retried, bit-exact, retries==1, no degradation
+  spec_verify_dispatch  speculative decode program dead -> automatic
+                        degradation to fused plain decode, bit-exact
+                        (greedy), DegradationEvent recorded
+  torn_checkpoint       save crashes mid-shard -> reload raises typed
+                        CorruptCheckpointError (no silent partial load)
+  corrupt_bundle        bit-flipped AOT module bytes -> sha256 manifest
+                        refuses it with CorruptBundleError
+  dead_elastic          member's heartbeat dies (injected) -> survivor
+                        TTL-detects it on the monotonic clock
+
+Prints one human line per class to stderr and ONE parseable JSON line
+to stdout (the bench.py last-line contract); exit code 0 iff all pass.
+Wired into tools/roundtail_bench.py. Usage: python tools/fault_matrix.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _tiny_decoder(max_len=48):
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64)
+    return LlamaDecoder(LlamaForCausalLM(cfg), max_len=max_len)
+
+
+def drill_transient_dispatch():
+    import numpy as np
+    from paddle_tpu.runtime.resilience import fault_injector
+    dec = _tiny_decoder()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 64, (2, 8))
+    ref = dec.generate(prompt, max_new_tokens=6)
+    fault_injector.configure([{"kind": "dispatch_error",
+                               "site": "decode.fused", "call": 1,
+                               "times": 1}])
+    out = dec.generate(prompt, max_new_tokens=6)
+    assert np.array_equal(np.asarray(out), np.asarray(ref)), \
+        "retried decode diverged from the no-fault run"
+    r = out.resilience
+    assert r["retries"] == 1 and not r["degradations"] \
+        and r["level"] == "fused", r
+    return f"recovered via retry (retries={r['retries']}, bit-exact)"
+
+
+def drill_spec_verify_dispatch():
+    import numpy as np
+    from paddle_tpu.runtime.resilience import fault_injector
+    dec = _tiny_decoder()
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 64, (2, 8))
+    ref = dec.generate(prompt, max_new_tokens=6)   # greedy == spec greedy
+    fault_injector.configure([{"kind": "dispatch_error",
+                               "site": "spec.decode", "call": 1,
+                               "times": 1000}])
+    out = dec.generate(prompt, max_new_tokens=6, draft_model="skip:1",
+                       num_speculative_tokens=2)
+    assert np.array_equal(np.asarray(out), np.asarray(ref)), \
+        "degraded speculative decode diverged from the no-fault run"
+    r = out.resilience
+    assert r["level"] == "fused" and r["degradations"], r
+    assert r["degradations"][0]["from_level"] == "speculative"
+    return (f"degraded speculative->fused (retries={r['retries']}, "
+            f"bit-exact)")
+
+
+def drill_torn_checkpoint(tmp):
+    import numpy as np
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.runtime.resilience import (CorruptCheckpointError,
+                                               InjectedFault,
+                                               fault_injector)
+    w = Tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+    cdir = os.path.join(tmp, "torn_ck")
+    fault_injector.configure([{"kind": "torn_write",
+                               "path": "data_r0.npz", "at_byte": 64}])
+    try:
+        ckpt.save_state_dict({"w": w}, cdir)
+        raise AssertionError("torn-write injection did not fire")
+    except InjectedFault:
+        pass                       # the simulated mid-shard crash
+    dst = Tensor(np.zeros((8, 8), np.float32))
+    try:
+        ckpt.load_state_dict({"w": dst}, cdir)
+        raise AssertionError("partial checkpoint loaded silently")
+    except CorruptCheckpointError as e:
+        return f"typed refusal: {str(e)[:80]}"
+
+
+def drill_corrupt_bundle(tmp):
+    import numpy as np
+    from paddle_tpu.inference.bundle import (AotPredictor,
+                                             export_decoder_bundle)
+    from paddle_tpu.runtime.resilience import CorruptBundleError
+    dec = _tiny_decoder(max_len=32)
+    bdir = os.path.join(tmp, "bundle")
+    export_decoder_bundle(dec, bdir, prompt_lens=[4], decode_steps=[4],
+                          batch_sizes=[1])
+    # silent media corruption: flip one bit inside the baked weights
+    victim = next(f for f in sorted(os.listdir(bdir))
+                  if f.startswith("decode_") and f.endswith(".aot"))
+    fp = os.path.join(bdir, victim)
+    blob = bytearray(open(fp, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    with open(fp, "wb") as f:
+        f.write(bytes(blob))
+    pred = AotPredictor(bdir)
+    prompt = np.zeros((1, 4), np.int64)
+    try:
+        pred.generate(prompt, max_new_tokens=4)
+        raise AssertionError("bit-flipped module served silently")
+    except CorruptBundleError as e:
+        return f"manifest refusal: {str(e)[:80]}"
+
+
+def drill_dead_elastic():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.native.tcp_store import TCPStore
+    from paddle_tpu.runtime.resilience import fault_injector
+    store = TCPStore(is_master=True, world_size=1)
+    survivor = ElasticManager(store, "fm-node0", np_range="1:2",
+                              heartbeat_s=0.1, ttl_s=0.6)
+    victim = ElasticManager(store, "fm-node1", np_range="1:2",
+                            heartbeat_s=0.1, ttl_s=0.6)
+    fault_injector.configure([{"kind": "dead_heartbeat",
+                               "node": "fm-node1", "after_beats": 3}])
+    try:
+        survivor.start()
+        victim.start()
+        deadline = time.monotonic() + 20
+        saw_both = False
+        while time.monotonic() < deadline:
+            m = survivor.members
+            if sorted(m) == ["fm-node0", "fm-node1"]:
+                saw_both = True
+            if saw_both and m == ["fm-node0"]:
+                return "dead member TTL-detected on the monotonic clock"
+            time.sleep(0.05)
+        raise AssertionError(
+            f"dead member not detected (saw_both={saw_both}, "
+            f"members={survivor.members})")
+    finally:
+        survivor.stop()
+        victim.stop()
+
+
+def main():
+    import tempfile
+
+    from paddle_tpu.flags import flags
+    from paddle_tpu.runtime.resilience import fault_injector
+    flags.set("resilience_backoff_s", 0.0)   # drills need no real sleeps
+    drills = [
+        ("transient_dispatch", drill_transient_dispatch, False),
+        ("spec_verify_dispatch", drill_spec_verify_dispatch, False),
+        ("torn_checkpoint", drill_torn_checkpoint, True),
+        ("corrupt_bundle", drill_corrupt_bundle, True),
+        ("dead_elastic", drill_dead_elastic, False),
+    ]
+    results = {}
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="fault_matrix_") as tmp:
+        for name, fn, needs_tmp in drills:
+            fault_injector.clear()
+            t0 = time.monotonic()
+            try:
+                detail = fn(tmp) if needs_tmp else fn()
+                results[name] = {"status": "pass", "detail": detail}
+            except Exception as e:
+                ok = False
+                traceback.print_exc(file=sys.stderr)
+                results[name] = {"status": "fail",
+                                 "detail": f"{type(e).__name__}: "
+                                           f"{str(e)[:200]}"}
+            finally:
+                fault_injector.clear()
+            r = results[name]
+            print(f"fault[{name}]: {r['status']} "
+                  f"({time.monotonic() - t0:.1f}s) {r['detail']}",
+                  file=sys.stderr)
+    print(json.dumps({"metric": "fault_matrix", "ok": ok,
+                      "classes": {k: v["status"]
+                                  for k, v in results.items()}}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

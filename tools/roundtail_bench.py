@@ -10,6 +10,10 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
   4. decode_modes (`bench.py --decode`): the fused-decode sweep incl.
      the speculative rows (tokens/s, dispatch counts, mean acceptance
      length) to be recorded into BASELINE.md
+  5. fault_matrix (tools/fault_matrix.py): every injectable fault class
+     against the decode + checkpoint + bundle + elastic paths — recover
+     bit-exact or fail typed; the round's robustness gate ON HARDWARE
+     (the same sweep runs on CPU in CI)
 
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
@@ -29,6 +33,7 @@ STEPS = [
     ("decode1b_served", [sys.executable, "bench.py", "--config",
                          "decode1b_served"]),
     ("decode_modes", [sys.executable, "bench.py", "--decode"]),
+    ("fault_matrix", [sys.executable, "tools/fault_matrix.py"]),
 ]
 
 
