@@ -847,6 +847,186 @@ def bench_decode_modes():
     return line
 
 
+def bench_serve(n_requests=None, slots=None, chunk=None):
+    """``--serve``: continuous batching vs static batching.
+
+    A Poisson-arrival, mixed-output-length workload served two ways over
+    the SAME decoder and wall clock: (a) the continuous-batching engine
+    (``paddle_tpu.serving.ServingEngine`` — slot admission between
+    chunked fused-decode dispatches), (b) static batching (assemble a
+    full batch in arrival order, run ONE fused generate to the longest
+    member's budget — rows that asked for less ride dead until it
+    finishes). Reports tokens/s (requested tokens only), mean slot
+    occupancy (useful-token fraction of slot-steps actually run),
+    p50/p99 per-request latency and dispatch counts; the
+    static-vs-continuous tokens/s ratio is the headline.
+
+    Contract checks (hard asserts): every continuous result is bit-exact
+    vs a solo greedy ``generate`` of the same request, and the dispatch
+    accounting is one admission prefill per request + one dispatch per
+    chunk — nothing hidden."""
+    import numpy as np
+
+    import jax
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        import jax.numpy as jnp
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        n_req = n_requests or 32
+        slots = slots or 8
+        chunk = chunk or 16   # big chunks: the tunnel RTT taxes dispatches
+        prompt_len, len_pool, mean_gap = 32, (8, 16, 32, 96), 0.02
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256)
+        n_req = n_requests or 24
+        slots = slots or 4
+        chunk = chunk or 8
+        prompt_len, len_pool, mean_gap = 8, (4, 8, 16, 96), 0.002
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
+    max_len = prompt_len + max(len_pool)
+    dec = LlamaDecoder(model, max_len=max_len)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+    lens = rng.choice(len_pool, n_req)
+    arrivals = np.cumsum(rng.exponential(mean_gap, n_req))
+    useful = int(lens.sum())
+
+    # warm every compiled program both serving modes will hit, so the
+    # timed windows measure steady-state serving (the BASELINE protocol)
+    warm = ServingEngine(dec, num_slots=slots, chunk_size=chunk)
+    for k in range(slots + 1):
+        warm.submit(prompts[k % n_req], int(len_pool[k % len(len_pool)]))
+    warm.drain()
+    for L in sorted(set(int(v) for v in len_pool)):
+        dec.generate(np.stack([prompts[0]] * slots), max_new_tokens=L)
+
+    # -- continuous ---------------------------------------------------------
+    eng = ServingEngine(dec, num_slots=slots, chunk_size=chunk)
+    d0 = dec.dispatch_count
+    finish = {}
+    submitted = 0
+    t0 = time.perf_counter()
+    while len(finish) < n_req:
+        now = time.perf_counter() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            eng.submit(prompts[submitted], int(lens[submitted]),
+                       seed=submitted)
+            submitted += 1
+        if (submitted < n_req and not len(eng.scheduler)
+                and not eng.scheduler.slots.occupied()):
+            time.sleep(max(0.0, arrivals[submitted]
+                           - (time.perf_counter() - t0)))
+            continue
+        for rid, res in eng.step():
+            finish[rid] = (time.perf_counter() - t0, res)
+    cont_wall = time.perf_counter() - t0
+    m = eng.metrics()
+    disp_cont = dec.dispatch_count - d0
+    lat = np.asarray([finish[i][0] - arrivals[i] for i in range(n_req)])
+    cont = {
+        "tokens_per_sec": round(useful / cont_wall, 1),
+        "wall_s": round(cont_wall, 3),
+        "occupancy_useful": round(useful / m["slot_steps_total"], 3),
+        "occupancy_slots_mean": round(m["occupancy_mean"], 3),
+        "latency_p50_s": round(float(np.percentile(lat, 50)), 4),
+        "latency_p99_s": round(float(np.percentile(lat, 99)), 4),
+        "queue_delay_p50_s": round(m["queue_delay_p50_s"], 4),
+        "dispatches": disp_cont,
+        "prefill_dispatches": m["prefill_dispatches"],
+        "chunk_dispatches": m["chunk_dispatches"],
+    }
+    # contract: per-request greedy outputs bit-exact vs solo generate,
+    # and the dispatch count is exactly prefills + chunks
+    assert m["prefill_dispatches"] == n_req, \
+        f"expected one admission prefill per request, got {m}"
+    assert disp_cont == (m["prefill_dispatches"] + m["chunk_dispatches"]
+                         + m["step_dispatches"]), \
+        f"hidden dispatches: {disp_cont} vs {m}"
+    for i in range(n_req):
+        solo = np.asarray(dec.generate(prompts[i][None], int(lens[i])))
+        got = np.asarray(finish[i][1])
+        assert np.array_equal(got, solo), \
+            f"request {i}: continuous output diverged from solo generate"
+
+    # -- static -------------------------------------------------------------
+    lat_s, batches = [], 0
+    slot_steps_static = 0
+    d0 = dec.dispatch_count
+    t0 = time.perf_counter()
+    i = 0
+    while i < n_req:
+        j = min(i + slots, n_req)
+        wait = arrivals[i:j].max() - (time.perf_counter() - t0)
+        if wait > 0:           # a static batch launches only when full
+            time.sleep(wait)
+        bp = [prompts[k] for k in range(i, j)]
+        while len(bp) < slots:
+            bp.append(prompts[i])          # pad rows; not counted
+        L = int(lens[i:j].max())           # everyone rides to the longest
+        dec.generate(np.stack(bp), max_new_tokens=L)
+        tend = time.perf_counter() - t0
+        lat_s.extend(tend - arrivals[k] for k in range(i, j))
+        slot_steps_static += slots * L
+        batches += 1
+        i = j
+    static_wall = time.perf_counter() - t0
+    lat_s = np.asarray(lat_s)
+    static = {
+        "tokens_per_sec": round(useful / static_wall, 1),
+        "wall_s": round(static_wall, 3),
+        "occupancy_useful": round(useful / slot_steps_static, 3),
+        "latency_p50_s": round(float(np.percentile(lat_s, 50)), 4),
+        "latency_p99_s": round(float(np.percentile(lat_s, 99)), 4),
+        "dispatches": dec.dispatch_count - d0,
+        "batches": batches,
+    }
+
+    speedup = cont["tokens_per_sec"] / static["tokens_per_sec"]
+    print(f"serve: continuous {cont['tokens_per_sec']:.0f} tok/s "
+          f"(occupancy {cont['occupancy_useful']:.2f}, "
+          f"p50 {cont['latency_p50_s']*1e3:.0f}ms, "
+          f"p99 {cont['latency_p99_s']*1e3:.0f}ms, "
+          f"{cont['dispatches']} dispatches) vs static "
+          f"{static['tokens_per_sec']:.0f} tok/s "
+          f"(occupancy {static['occupancy_useful']:.2f}, "
+          f"p50 {static['latency_p50_s']*1e3:.0f}ms, "
+          f"p99 {static['latency_p99_s']*1e3:.0f}ms, "
+          f"{static['dispatches']} dispatches): {speedup:.2f}x tokens/s, "
+          f"parity+dispatch contract checked on {n_req} requests",
+          file=sys.stderr)
+    line = _emit("serving_continuous_tokens_per_sec",
+                 cont["tokens_per_sec"], "tokens/sec")
+    line["serve"] = {
+        "config": "134M" if on_tpu else "tiny-cpu",
+        "requests": n_req, "slots": slots, "chunk_size": chunk,
+        "prompt_len": prompt_len, "output_len_pool": list(len_pool),
+        "poisson_mean_gap_s": mean_gap,
+        "continuous": cont, "static": static,
+        "speedup_tokens_per_sec": round(speedup, 3),
+        "continuous_beats_static": bool(
+            speedup > 1.0 and cont["occupancy_useful"]
+            > static["occupancy_useful"]),
+    }
+    # re-print the enriched record as the LAST stdout line (the driver
+    # parses the final json line; _emit already printed the bare metric)
+    print(json.dumps(line))
+    return line
+
+
 CONFIGS = {
     "moe": bench_moe,
     "llama": bench_llama,
@@ -858,6 +1038,7 @@ CONFIGS = {
     "decode_modes": bench_decode_modes,
     "decode1b": bench_decode_1b,
     "decode1b_served": bench_decode_1b_served,
+    "serve": bench_serve,
 }
 
 def _run_guarded(name, fn, attempts=3, base_delay=5.0, sleep=time.sleep):
@@ -887,17 +1068,55 @@ def _run_guarded(name, fn, attempts=3, base_delay=5.0, sleep=time.sleep):
     except SystemExit:
         raise
     except Exception as e:
-        transient = classify_error(e, phase="setup") == "transient"
-        import traceback
-        traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
-            "metric": name, "value": None, "unit": None,
-            "vs_baseline": None, "failed": True,
-            "failure_class": ("backend_unavailable" if transient
-                              else type(e).__name__),
-            "error": str(e)[:400], "attempts": retry_count[0] + 1,
-        }))
+        _emit_failure(name, e, attempts=retry_count[0] + 1)
         sys.exit(1)
+
+
+def _emit_failure(name, e, attempts=1):
+    """The parseable last-stdout-line BENCH failure record (never a raw
+    rc=1 traceback tail — the round-5 evidence-loss class): the metric
+    name, the resilient_call classifier's verdict and the error, with
+    the traceback on stderr."""
+    from paddle_tpu.runtime.resilience import classify_error
+    transient = classify_error(e, phase="setup") == "transient"
+    import traceback
+    traceback.print_exc(file=sys.stderr)
+    print(json.dumps({
+        "metric": name, "value": None, "unit": None,
+        "vs_baseline": None, "failed": True,
+        "failure_class": ("backend_unavailable" if transient
+                          else type(e).__name__),
+        "error": str(e)[:400], "attempts": attempts,
+    }))
+
+
+def _ensure_backend(devices_fn=None, to_cpu=None):
+    """Probe the accelerator backend BEFORE any config runs (BENCH_r05
+    failure class: the TPU plugin raised UNAVAILABLE inside the first
+    ``jax.devices()`` and the whole artifact became a raw rc=1
+    traceback with no parseable record). On a transient/unavailable
+    init error, fall back to the CPU platform and keep going — a CPU
+    record beats no record; if even that fails, the error propagates to
+    the structured-failure path. Returns "ok" or "cpu_fallback"."""
+    import jax
+
+    from paddle_tpu.runtime.resilience import classify_error
+    if devices_fn is None:
+        devices_fn = jax.devices
+    if to_cpu is None:
+        to_cpu = lambda: jax.config.update("jax_platforms", "cpu")  # noqa: E731
+    try:
+        devices_fn()
+        return "ok"
+    except Exception as e:
+        if classify_error(e, phase="setup") != "transient" and \
+                "Unable to initialize backend" not in str(e):
+            raise
+        print(f"bench: accelerator backend unavailable, falling back to "
+              f"the CPU platform: {str(e)[:200]}", file=sys.stderr)
+        to_cpu()
+        devices_fn()     # CPU also down -> propagate (guarded caller
+        return "cpu_fallback"  # emits the structured failure record)
 
 
 def main():
@@ -909,8 +1128,26 @@ def main():
                     help="fused-decode microbenchmark: tokens/s + dispatch "
                          "counts for greedy/greedy+eos/sampled at several "
                          "batch sizes")
+    ap.add_argument("--serve", action="store_true",
+                    help="continuous-vs-static batching serving benchmark "
+                         "(Poisson arrivals, mixed output lengths): "
+                         "tokens/s, slot occupancy, p50/p99 latency, "
+                         "dispatch counts")
+    ap.add_argument("--serve-requests", type=int, default=None)
+    ap.add_argument("--serve-slots", type=int, default=None)
+    ap.add_argument("--serve-chunk", type=int, default=None)
     args = ap.parse_args()
 
+    try:
+        _ensure_backend()
+    except Exception as e:
+        _emit_failure("backend_init", e)
+        sys.exit(1)
+    if args.serve:
+        _run_guarded("serve", lambda: bench_serve(
+            n_requests=args.serve_requests, slots=args.serve_slots,
+            chunk=args.serve_chunk))
+        return
     if args.decode:
         _run_guarded("decode_modes", bench_decode_modes)
         return

@@ -144,7 +144,8 @@ def export_decoder_bundle(decoder, out_dir: str,
                           top_p: Optional[float] = None,
                           draft_model=None,
                           num_speculative_tokens: Optional[int] = None,
-                          plain_fallback: bool = True) -> None:
+                          plain_fallback: bool = True,
+                          chunk_sizes: Sequence[int] = ()) -> None:
     """Export a ``LlamaDecoder`` as prefill + fused scan-decode AOT
     entries (the compiled-decode serving artifact the reference ships via
     its generation ops + AnalysisPredictor). One prefill module per
@@ -174,7 +175,20 @@ def export_decoder_bundle(decoder, out_dir: str,
     degradation ladder's lower rung: when the speculative entry keeps
     failing dispatch at serve time, AotPredictor steps down to the plain
     entry automatically (bit-exact for greedy bundles) instead of
-    failing the request."""
+    failing the request.
+
+    ``chunk_sizes`` additionally exports the CONTINUOUS-BATCHING serving
+    entries (``decode_mode.chunked``): per batch bucket, one
+    ``decode_chunk_b{B}_t{T}.aot`` running T steps of the re-enterable
+    fused loop — the chunk size is a compile-time static; the whole loop
+    carry (next-token logits, both cache buffers, per-row positions /
+    RNG keys / done mask / eos ids / temperatures) is runtime inputs and
+    outputs — plus one batch-1 ``admit_prefill_s{S}.aot`` per prompt
+    bucket (right-padded prompt + runtime true length, returning the
+    true last position's logits) for slot admission. A chunk size of 1
+    is always included as the serve-side degradation rung. Serve with
+    ``paddle_tpu.serving.ServingEngine(AotPredictor(dir), ...)`` — the
+    same scheduler as in-process serving, zero model Python."""
     import jax
     import jax.numpy as jnp
 
@@ -199,6 +213,9 @@ def export_decoder_bundle(decoder, out_dir: str,
     elif num_speculative_tokens is not None:
         raise ValueError("num_speculative_tokens requires a draft_model")
     prefills, dprefills, decodes = [], [], []
+    chunks, admits = [], []
+    csizes = sorted({int(t) for t in chunk_sizes} | {1}) if chunk_sizes \
+        else []
     caches, dcaches = {}, {}
     manifest = {}
 
@@ -305,6 +322,50 @@ def export_decoder_bundle(decoder, out_dir: str,
                         donate_argnums=(1, 2))
                     decodes.append({"file": ptag + ".aot",
                                     "batch": int(B), "steps": int(N) - 1})
+        for T in csizes:
+            # continuous-batching chunk entry: T loop steps per dispatch,
+            # whole carry in/out (ServingEngine re-enters it between
+            # admissions); T=1 doubles as the per-token degradation rung
+            def cdecode(logits, kc, vc, pos, keys, done, eos, temp,
+                        T=int(T)):
+                return decoder._chunk_decode(
+                    p, logits, kc, vc, pos, keys, done, eos, temp,
+                    steps=T, do_sample=bool(do_sample),
+                    top_k=None if top_k is None else int(top_k),
+                    top_p=None if top_p is None else float(top_p))
+
+            logits0 = jnp.zeros(logits_sds.shape, logits_sds.dtype)
+            ctag = f"decode_chunk_b{B}_t{T}"
+            manifest[ctag + ".aot"] = _save_exp(
+                cdecode,
+                (logits0, kc, vc,
+                 jnp.zeros((int(B),), jnp.int32),
+                 jnp.zeros((int(B), 2), jnp.uint32),
+                 jnp.zeros((int(B),), jnp.bool_),
+                 jnp.full((int(B),), -1, jnp.int32),
+                 jnp.ones((int(B),), jnp.float32)),
+                os.path.join(out_dir, ctag + ".aot"),
+                donate_argnums=(1, 2))
+            chunks.append({"file": ctag + ".aot", "batch": int(B),
+                           "chunk": int(T)})
+    if csizes:
+        # batch-1 admission prefills: right-padded prompt bucket + the
+        # runtime true length; the returned row state is what the engine
+        # scatters into a freed slot of the batch carry
+        kc1, vc1 = decoder._empty_cache(1)
+        caches["1"] = _cache_meta(kc1)
+        for S in prompt_lens:
+            def aprefill(ids, kc, vc, true_len):
+                return decoder._admit_prefill(p, ids, kc, vc, true_len)
+
+            atag = f"admit_prefill_s{S}"
+            manifest[atag + ".aot"] = _save_exp(
+                aprefill,
+                (jnp.zeros((1, int(S)), jnp.int32), kc1, vc1,
+                 jnp.asarray(1, jnp.int32)),
+                os.path.join(out_dir, atag + ".aot"))
+            admits.append({"file": atag + ".aot", "batch": 1,
+                           "seq": int(S)})
     # the fused-decode serving contract: key/done/eos/temperature are
     # runtime inputs; do_sample/top_k/top_p (and the speculation statics)
     # were baked at export
@@ -320,6 +381,14 @@ def export_decoder_bundle(decoder, out_dir: str,
                       else "model"),
             "draft_layers": eng["cfg"].num_hidden_layers,
         }
+    if csizes:
+        # continuous-batching contract: chunk size is a static (one
+        # entry per size); the loop carry — logits, caches, per-row
+        # pos/keys/done/eos/temperature — is runtime inputs AND outputs
+        mode["chunked"] = {"chunk_sizes": csizes,
+                           "state_inputs": ["logits", "kc", "vc", "pos",
+                                            "keys", "done", "eos",
+                                            "temp"]}
     meta = {
         "kind": "llama_decoder",
         "inputs": ["input_ids"],
@@ -342,6 +411,9 @@ def export_decoder_bundle(decoder, out_dir: str,
     if eng is not None:
         meta["draft_caches"] = dcaches
         meta["draft_prefill_buckets"] = dprefills
+    if csizes:
+        meta["chunk_buckets"] = chunks
+        meta["admit_prefill_buckets"] = admits
     _write_meta(out_dir, meta)
 
 

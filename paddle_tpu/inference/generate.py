@@ -28,8 +28,9 @@ dynamic-update-slice over the batch in that case).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +38,39 @@ import numpy as np
 
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, _rope_tables
 
-__all__ = ["LlamaDecoder"]
+__all__ = ["LlamaDecoder", "DecodeState"]
+
+
+@dataclasses.dataclass
+class DecodeState:
+    """The exported/re-enterable carry of the fused decode loop.
+
+    Everything the loop needs to resume is a plain array (exportable as
+    AOT entry inputs, scatter-updatable row by row by the serving
+    engine's admission path): next-token ``logits``, both KV-cache
+    buffers, PER-ROW cache positions, PER-ROW raw uint32 RNG keys (each
+    row's sample stream depends only on its own key — admitting a new
+    request into a neighbouring row can't shift it), the done mask and
+    per-row eos ids (``-1`` = no eos for that row) and temperatures.
+    ``decode_chunk`` advances the state by T tokens in ONE dispatch;
+    chaining chunks is bit-exact with run-to-completion for greedy.
+
+    The draft-cache / speculative-stats fields are reserved for chunked
+    speculative decode (ROADMAP): today a state never carries them and
+    ``decode_chunk`` serves the plain fused loop only.
+    """
+
+    logits: Any           # (B, V) f32 — logits the next pick samples from
+    kc: Any               # target KV caches (stacked array or per-layer
+    vc: Any               #   tuple; see _empty_cache)
+    pos: Any              # (B,) i32 — per-row next cache write position
+    keys: Any             # (B, 2) u32 — per-row RNG keys
+    done: Any             # (B,) bool — frozen rows (eos hit / slot free)
+    eos: Any              # (B,) i32 — per-row eos id, -1 = none
+    temp: Any             # (B,) f32 — per-row sampling temperature
+    dkc: Any = None       # reserved: draft caches (speculative chunks)
+    dvc: Any = None
+    steps_done: int = 0   # host-side: loop steps executed so far
 
 
 def _rope_at(x, pos, cfg, p):
@@ -467,12 +500,88 @@ class LlamaDecoder:
             return jnp.concatenate([jnp.moveaxis(toks, 0, 1),
                                     last[:, None]], axis=1)
 
+        def chunk_decode(p, logits0, kc, vc, pos0, keys0, done0, eos,
+                         temperature, steps: int, do_sample: bool,
+                         top_k, top_p):
+            """T steps of the fused token loop as ONE re-enterable
+            dispatch: the carry comes in and goes back out as plain
+            arrays (DecodeState), so a serving engine can admit new
+            requests into freed rows BETWEEN chunks instead of holding
+            dead slots until the slowest row finishes (Orca-style
+            iteration-level batching). Per-row everything: positions
+            (rows admitted at different times sit at different cache
+            offsets), eos ids (-1 = none), temperatures, and RNG keys —
+            each row splits its OWN key per step, so a row's sample
+            stream is invariant to its batch neighbours. Greedy chunks
+            chained over N steps are bit-exact with the run-to-completion
+            fused path (same pick-then-forward stream)."""
+            self.trace_count += 1
+
+            def pick(logits, keys, done):
+                if do_sample:
+                    kk = jax.vmap(jax.random.split)(keys)       # (B,2,2)
+                    keys, subs = kk[:, 0], kk[:, 1]
+                    flt = _filter_logits(logits, temperature[:, None],
+                                         top_k, top_p)
+                    tok = jax.vmap(jax.random.categorical)(
+                        subs, flt).astype(jnp.int32)
+                else:
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                tok = jnp.where(done, jnp.where(eos >= 0, eos, 0), tok)
+                done = jnp.logical_or(done, tok == eos)
+                return tok, keys, done
+
+            def body(carry, _):
+                logits, kc, vc, pos, keys, done = carry
+                tok, keys, done = pick(logits, keys, done)
+                logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
+                                                 vc, pos, max_len)
+                # rows past their budget keep stepping until the chunk
+                # boundary; clamping pins their (discarded) writes to the
+                # last cache slot instead of running off the buffer
+                pos = jnp.minimum(pos + 1, max_len - 1)
+                return (logits, kc, vc, pos, keys, done), tok
+
+            (logits, kc, vc, pos, keys, done), toks = jax.lax.scan(
+                body, (logits0, kc, vc, pos0, keys0, done0), None,
+                length=steps)
+            return (jnp.moveaxis(toks, 0, 1), logits, kc, vc, pos, keys,
+                    done)
+
+        def admit_prefill(p, ids, kc, vc, true_len):
+            """Length-bucketed admission prefill: ``ids`` is ONE request
+            right-padded to its prompt bucket (one compiled program per
+            bucket, not per distinct prompt length). Returns the logits
+            of position ``true_len - 1`` — causal masking makes the
+            padded tail invisible to it, and decode overwrites the tail's
+            cache rows before they could ever unmask — so the admitted
+            row decodes bit-exactly like an unpadded solo generate."""
+            self.trace_count += 1
+            logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc, 0,
+                                                 max_len, return_all=True)
+            logits = jax.lax.dynamic_index_in_dim(
+                logits_all, true_len - 1, axis=1, keepdims=False)
+            return logits, kc, vc
+
         self._prefill = self._counted(jax.jit(prefill), "decode.prefill")
         self._step = self._counted(jax.jit(step), "decode.step")
         self._fused_decode = self._counted(jax.jit(
             fused_decode,
             static_argnames=("steps", "do_sample", "use_eos", "top_k",
                              "top_p")), "decode.fused")
+        self._chunk_decode = self._counted(jax.jit(
+            chunk_decode,
+            static_argnames=("steps", "do_sample", "top_k", "top_p")),
+            "decode.chunk")
+        # the same trace fn jitted under its own fault site: the serving
+        # degradation ladder's per-token rung must stay dispatchable when
+        # a plan is killing "decode.chunk"
+        self._chunk_step = self._counted(jax.jit(
+            chunk_decode,
+            static_argnames=("steps", "do_sample", "top_k", "top_p")),
+            "decode.chunk_step")
+        self._admit_prefill = self._counted(jax.jit(admit_prefill),
+                                            "decode.admit_prefill")
 
     def _counted(self, jitted, site="decode.dispatch"):
         """Count dispatches AND guard each one: the fault-injection hook
@@ -514,6 +623,81 @@ class LlamaDecoder:
         zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
                               for _ in range(cfg.num_hidden_layers))
         return zeros(), zeros()
+
+    # -- chunked resumable decode -----------------------------------------
+    def init_decode_state(self, input_ids, eos_token_id=None,
+                          temperature: float = 1.0, seed: int = 0
+                          ) -> DecodeState:
+        """Prefill (one dispatch) and build the exportable loop carry for
+        ``decode_chunk``. Whole-batch entry: every row starts from the
+        same prompt tensor; the serving engine instead assembles mixed
+        states row by row via its admission path. Per-row keys are
+        ``split(PRNGKey(seed), B)`` — row i's sampled stream depends only
+        on ``keys[i]``, never on its neighbours."""
+        import jax.random as jrandom
+
+        ids = jnp.asarray(np.asarray(input_ids))
+        B, S = ids.shape
+        kc, vc = self._empty_cache(B)
+        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        eos_n = _normalize_eos(eos_token_id)
+        return DecodeState(
+            logits=logits, kc=kc, vc=vc,
+            pos=jnp.full((B,), S, jnp.int32),
+            keys=jnp.asarray(jrandom.split(jrandom.PRNGKey(seed), B),
+                             jnp.uint32),
+            done=jnp.zeros((B,), jnp.bool_),
+            eos=jnp.full((B,), -1 if eos_n is None else int(eos_n),
+                         jnp.int32),
+            temp=jnp.full((B,), float(temperature), jnp.float32))
+
+    def decode_chunk(self, state: DecodeState, num_tokens: int,
+                     do_sample: bool = False, top_k: Optional[int] = None,
+                     top_p: Optional[float] = None):
+        """Advance the loop carry by ``num_tokens`` steps in ONE device
+        dispatch; returns ``(tokens (B, num_tokens), new_state)``.
+        Chaining chunks totalling N steps emits the same greedy tokens,
+        bit-exactly, as one run-to-completion ``generate`` of N — the
+        property continuous batching rides on (a request's output can't
+        depend on how admission sliced its decode into dispatches)."""
+        if state.dkc is not None:
+            raise NotImplementedError(
+                "chunked decode does not carry draft caches yet "
+                "(speculative continuous batching is a ROADMAP item)")
+        toks, logits, kc, vc, pos, keys, done = self._chunk_decode(
+            self.params, state.logits, state.kc, state.vc, state.pos,
+            state.keys, state.done, state.eos, state.temp,
+            steps=int(num_tokens), do_sample=bool(do_sample),
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p))
+        return toks, dataclasses.replace(
+            state, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
+            done=done, steps_done=state.steps_done + int(num_tokens))
+
+    def _generate_chunked(self, ids, max_new, eos_norm, do_sample,
+                          temperature, top_k, top_p, seed, chunk_size):
+        """Chunked resumable decode: prefill + ceil(max_new/T) chunk
+        dispatches. Greedy is bit-exact with the one-dispatch fused path
+        (identical pick/forward stream); sampling draws from PER-ROW key
+        streams — distribution-preserving and row-independent (the
+        admission contract), but a different stream than the fused
+        path's single shared key. Retry/degradation events of EVERY
+        chunk dispatch accumulate into the one generate record."""
+        T = int(chunk_size)
+        if T < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {T}")
+        state = self.init_decode_state(ids, eos_token_id=eos_norm,
+                                       temperature=temperature, seed=seed)
+        out, got = [], 0
+        while got < max_new:
+            toks, state = self.decode_chunk(
+                state, min(T, max_new - got), do_sample=do_sample,
+                top_k=top_k, top_p=top_p)
+            out.append(np.asarray(toks))
+            got += out[-1].shape[1]
+            if eos_norm is not None and bool(np.asarray(state.done).all()):
+                break
+        return np.concatenate(out, axis=1)
 
     # -- speculative decoding ---------------------------------------------
     def _spec_engine(self, draft_model):
@@ -641,7 +825,8 @@ class LlamaDecoder:
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  seed: int = 0, draft_model=None,
-                 num_speculative_tokens: Optional[int] = None) -> np.ndarray:
+                 num_speculative_tokens: Optional[int] = None,
+                 chunk_size: Optional[int] = None) -> np.ndarray:
         """Decode. input_ids: (B, S) ints. Returns (B, S + new).
 
         Greedy by default; ``do_sample=True`` draws from the
@@ -661,9 +846,20 @@ class LlamaDecoder:
         (or per-speculative-round) host loop, which emits the same
         tokens for a fixed seed.
 
+        ``chunk_size=T`` runs the SAME fused loop as a chain of
+        re-enterable T-step dispatches (``init_decode_state`` /
+        ``decode_chunk`` — the continuous-batching serving substrate,
+        ``paddle_tpu/serving``): greedy output is bit-exact with the
+        one-dispatch path; sampling switches to per-row key streams
+        (``split(PRNGKey(seed), B)``) so each row's draw is independent
+        of its batch neighbours — distribution-preserving, different
+        stream. The resilience record accumulates the retry/degradation
+        events of every chunk dispatch of the call.
+
         Dispatch failures walk the degradation ladder automatically
         (``FLAGS_resilience_auto_degrade``): speculative falls back to
-        fused plain decode, fused to the per-token loop. Greedy levels
+        fused plain decode (chunked likewise), fused to the per-token
+        loop. Greedy levels
         are bit-exact with each other, so degraded greedy output ==
         the no-fault output; sampled levels preserve the distribution
         but consume the RNG stream differently. The returned array
@@ -713,6 +909,17 @@ class LlamaDecoder:
         elif num_speculative_tokens is not None:
             raise ValueError("num_speculative_tokens requires a "
                              "draft_model")
+        if chunk_size is not None:
+            if draft_model is not None:
+                raise ValueError(
+                    "chunk_size does not compose with draft_model yet: "
+                    "speculative decode commits a variable token count "
+                    "per round (chunked speculative decode is a ROADMAP "
+                    "item)")
+            if not fallback:
+                ladder.append(("chunked", lambda: self._generate_chunked(
+                    ids, max_new_tokens, eos_token_id, do_sample,
+                    temperature, top_k, top_p, seed, chunk_size)))
         if not fallback:
             ladder.append(("fused", lambda: self._generate_fused(
                 ids, max_new_tokens, eos_token_id, do_sample, temperature,
@@ -723,6 +930,12 @@ class LlamaDecoder:
 
         self._events = []
         self.last_resilience = None
+        # cleared BEFORE the ladder runs: a speculative rung that fails
+        # and degrades mid-request must not leave a previous generate's
+        # acceptance stats looking like this one's (and a non-speculative
+        # generate must never report any) — every dispatch of this call,
+        # however many chunks it takes, reports into this one record
+        self.last_spec_stats = None
         degradations = []
         toks, level = None, None
         for li, (name, run) in enumerate(ladder):

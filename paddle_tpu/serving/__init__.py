@@ -1,0 +1,23 @@
+"""paddle_tpu.serving — continuous-batching serving engine.
+
+Iteration-level (Orca-style) batching over the chunked resumable fused
+decode (``inference/generate.DecodeState`` / ``decode_chunk``): a slot
+table maps in-flight requests to batch rows, new requests are admitted
+into freed rows BETWEEN chunk dispatches via length-bucketed prefills,
+and the decode itself stays one device program per chunk — the
+TPU-mandatory single-program property — while slots turn over
+independently. Serves either an in-process ``LlamaDecoder`` or an AOT
+bundle exported with ``chunk_sizes=`` (``decode_mode.chunked``).
+"""
+
+from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
+from paddle_tpu.serving.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+    Slot,
+    SlotTable,
+    bucket_length,
+)
+
+__all__ = ["ServingEngine", "Request", "Scheduler", "Slot", "SlotTable",
+           "bucket_length"]

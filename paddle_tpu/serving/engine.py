@@ -1,0 +1,522 @@
+"""Continuous-batching serving engine over chunked resumable fused decode.
+
+``ServingEngine`` drives the Orca-style loop: admit queued requests into
+freed slots (one length-bucketed admission-prefill dispatch each, the
+row state scattered into the batch carry), run ONE ``decode_chunk``
+dispatch for T tokens across all slots, harvest finished rows on the
+host, repeat. The decode stays a single device program per chunk — the
+TPU requirement (Pope et al.) — while slots turn over independently, so
+under mixed-length traffic the batch stays full instead of idling on
+rows that already hit EOS.
+
+Dispatch accounting is part of the contract (asserted by tests and
+``bench.py --serve``): one admission prefill per admitted request plus
+one chunk dispatch per engine step that had live rows — nothing hidden.
+Admission scatters and row retirement are plain array updates outside
+the counted dispatch sites.
+
+Two backends serve the same scheduler:
+
+- ``LlamaDecoder`` (in-process): jitted ``_admit_prefill`` /
+  ``_chunk_decode`` entries;
+- ``AotPredictor`` over a bundle exported with ``chunk_sizes=``:
+  ``admit_prefill_s{S}.aot`` / ``decode_chunk_b{B}_t{T}.aot`` StableHLO
+  entries — zero model Python at serve time (``decode_mode.chunked``).
+
+Resilience: every dispatch retries transients (``resilient_call``
+inside the backend's counted entries); a chunk that still fails steps
+down to the per-token rung (T single-step dispatches on the SAME carry
+— no in-flight request is dropped, since a failed dispatch never
+consumed the state) with a typed ``DegradationEvent``, and the events
+land on each affected request's result record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = ["ServingEngine"]
+
+
+@jax.jit
+def _admit_row_jit(logits, kc, vc, pos, keys, done, eos, temp,
+                   logits1, kc1, vc1, slot, pos1, key1, eos1, temp1):
+    """Scatter one freshly prefilled request (batch-1 row state) into the
+    batch carry at ``slot``. ``slot`` is a traced scalar — one compiled
+    program serves every slot index. One fused update program instead of
+    eight eager scatters; NOT a counted dispatch site (the serving
+    dispatch contract counts prefills and chunks only)."""
+    def put_cache(b, r):
+        # batch axis: 1 for stacked (L, B, ...) buffers, 0 for per-layer
+        # (B, ...) buffers — both are ndim-4 offsets from the row layout
+        ax = b.ndim - 4
+        starts = tuple(slot if i == ax else 0 for i in range(b.ndim))
+        return jax.lax.dynamic_update_slice(b, r.astype(b.dtype), starts)
+
+    kc = jax.tree_util.tree_map(put_cache, kc, kc1)
+    vc = jax.tree_util.tree_map(put_cache, vc, vc1)
+    logits = logits.at[slot].set(logits1[0].astype(logits.dtype))
+    pos = pos.at[slot].set(pos1)
+    keys = keys.at[slot].set(key1)
+    done = done.at[slot].set(False)
+    eos = eos.at[slot].set(eos1)
+    temp = temp.at[slot].set(temp1)
+    return logits, kc, vc, pos, keys, done, eos, temp
+
+
+class _DecoderBackend:
+    """In-process backend: the jitted chunk/admission entries of a
+    ``LlamaDecoder``."""
+
+    def __init__(self, dec, num_slots, chunk_size, do_sample, top_k, top_p):
+        self.dec = dec
+        self.num_slots = int(num_slots)
+        self.max_len = dec.max_len
+        self.prompt_buckets = None          # any pow2 bucket compiles
+        self._kw = dict(
+            do_sample=bool(do_sample),
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p))
+
+    def event_count(self) -> int:
+        return len(self.dec._events)
+
+    def events_since(self, n: int) -> list:
+        return list(self.dec._events[n:])
+
+    def new_state(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.generate import DecodeState
+        B = self.num_slots
+        kc, vc = self.dec._empty_cache(B)
+        return DecodeState(
+            logits=jnp.zeros((B, self.dec.cfg.vocab_size), jnp.float32),
+            kc=kc, vc=vc,
+            pos=jnp.zeros((B,), jnp.int32),
+            keys=jnp.zeros((B, 2), jnp.uint32),
+            done=jnp.ones((B,), jnp.bool_),    # every slot starts free
+            eos=jnp.full((B,), -1, jnp.int32),
+            temp=jnp.ones((B,), jnp.float32))
+
+    def admit_prefill(self, ids, true_len):
+        import jax.numpy as jnp
+        kc1, vc1 = self.dec._empty_cache(1)
+        return self.dec._admit_prefill(
+            self.dec.params, jnp.asarray(np.asarray(ids), jnp.int32),
+            kc1, vc1, jnp.asarray(int(true_len), jnp.int32))
+
+    def _run(self, entry, st, steps):
+        toks, logits, kc, vc, pos, keys, done = entry(
+            self.dec.params, st.logits, st.kc, st.vc, st.pos, st.keys,
+            st.done, st.eos, st.temp, steps=int(steps), **self._kw)
+        return toks, dataclasses.replace(
+            st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
+            done=done, steps_done=st.steps_done + int(steps))
+
+    def decode_chunk(self, st, chunk_size):
+        return self._run(self.dec._chunk_decode, st, chunk_size)
+
+    def decode_step(self, st):
+        return self._run(self.dec._chunk_step, st, 1)
+
+    def has_step_rung(self) -> bool:
+        return True
+
+
+class _BundleBackend:
+    """AOT backend: the ``decode_chunk_b{B}_t{T}`` / ``admit_prefill_s{S}``
+    StableHLO entries of a bundle exported with ``chunk_sizes=`` — the
+    serving process runs no model Python (``decode_mode.chunked``)."""
+
+    def __init__(self, pred, num_slots, chunk_size, do_sample, top_k,
+                 top_p):
+        self.pred = pred
+        self.num_slots = int(num_slots)
+        meta = pred.meta
+        mode = meta.get("decode_mode") or {}
+        ch = mode.get("chunked")
+        if not ch:
+            raise ValueError(
+                "this bundle has no chunked decode entries; re-export it "
+                "with export_decoder_bundle(..., chunk_sizes=[...]) to "
+                "serve continuous batching")
+        for name, want in (("do_sample", bool(do_sample)),
+                           ("top_k", top_k), ("top_p", top_p)):
+            baked = mode.get(name)
+            if name == "do_sample":
+                baked = bool(baked)
+            if baked != want:
+                raise ValueError(
+                    f"bundle chunked entries were exported with "
+                    f"{name}={baked!r}; the engine asked for {want!r}")
+        self.max_len = meta["max_len"]
+        by_chunk = {b["chunk"]: b["file"] for b in meta["chunk_buckets"]
+                    if b["batch"] == self.num_slots}
+        if int(chunk_size) not in by_chunk:
+            have = [(b["batch"], b["chunk"])
+                    for b in meta["chunk_buckets"]]
+            raise ValueError(
+                f"no chunked decode bucket for batch={self.num_slots}, "
+                f"chunk={chunk_size}; exported (batch, chunk): {have}")
+        self._chunk_file = by_chunk[int(chunk_size)]
+        self._step_file = by_chunk.get(1)
+        self._admit = {b["seq"]: b["file"]
+                       for b in meta["admit_prefill_buckets"]}
+        self.prompt_buckets = sorted(self._admit)
+        self._logits_dtype = meta.get("logits_dtype", "float32")
+        self._vocab = meta["vocab_size"]
+
+    def event_count(self) -> int:
+        return len(self.pred._events)
+
+    def events_since(self, n: int) -> list:
+        return list(self.pred._events[n:])
+
+    def new_state(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.generate import DecodeState
+        B = self.num_slots
+        kc, vc = self.pred._make_cache(B)
+        return DecodeState(
+            logits=jnp.zeros((B, self._vocab),
+                             jnp.dtype(self._logits_dtype)),
+            kc=kc, vc=vc,
+            pos=jnp.zeros((B,), jnp.int32),
+            keys=jnp.zeros((B, 2), jnp.uint32),
+            done=jnp.ones((B,), jnp.bool_),
+            eos=jnp.full((B,), -1, jnp.int32),
+            temp=jnp.ones((B,), jnp.float32))
+
+    def admit_prefill(self, ids, true_len):
+        import jax.numpy as jnp
+        S = int(np.asarray(ids).shape[1])
+        if S not in self._admit:
+            raise ValueError(f"no admit_prefill bucket for prompt bucket "
+                             f"{S}; exported: {self.prompt_buckets}")
+        kc1, vc1 = self.pred._make_cache(1)
+        return self.pred._run_entry(
+            self._admit[S], "bundle.admit_prefill",
+            jnp.asarray(np.asarray(ids), jnp.int32), kc1, vc1,
+            jnp.asarray(int(true_len), jnp.int32))
+
+    def _run(self, fname, site, st):
+        toks, logits, kc, vc, pos, keys, done = self.pred._run_entry(
+            fname, site, st.logits, st.kc, st.vc, st.pos, st.keys,
+            st.done, st.eos, st.temp)
+        return toks, dataclasses.replace(
+            st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
+            done=done)
+
+    def decode_chunk(self, st, chunk_size):
+        return self._run(self._chunk_file, "bundle.chunk", st)
+
+    def decode_step(self, st):
+        return self._run(self._step_file, "bundle.chunk_step", st)
+
+    def has_step_rung(self) -> bool:
+        return self._step_file is not None
+
+
+def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p):
+    from paddle_tpu.inference.bundle import AotPredictor
+    from paddle_tpu.inference.generate import LlamaDecoder
+    if isinstance(backend, LlamaDecoder):
+        return _DecoderBackend(backend, num_slots, chunk_size, do_sample,
+                               top_k, top_p)
+    if isinstance(backend, AotPredictor):
+        return _BundleBackend(backend, num_slots, chunk_size, do_sample,
+                              top_k, top_p)
+    raise TypeError(
+        f"backend must be a LlamaDecoder or an AotPredictor, "
+        f"got {type(backend).__name__}")
+
+
+class ServingEngine:
+    """Slot-admission continuous-batching engine.
+
+    ``submit`` queues a request and returns its id; ``step`` runs one
+    admit-dispatch-harvest iteration and returns the requests it
+    finished; ``drain`` steps until queue and slots are empty. Results
+    are ``GenerateResult`` arrays (prompt + generated tokens, trimmed at
+    the request's eos / budget) whose ``.resilience`` record carries the
+    ladder level, retries, degradations and serving stats (queue delay,
+    chunks spanned, slot index) of that request's lifetime.
+
+    Greedy outputs are bit-exact with a solo ``LlamaDecoder.generate``
+    of the same request — admission, chunk slicing and batch neighbours
+    cannot change a request's tokens. Sampled outputs are bit-exact
+    across engine configurations (per-row key streams keyed only by the
+    request's ``seed``), and distribution-preserving vs the fused path.
+
+    ``do_sample`` / ``top_k`` / ``top_p`` are engine-wide statics (they
+    change the compiled chunk program); eos id, temperature and seed are
+    per-request runtime inputs.
+    """
+
+    def __init__(self, backend, num_slots: int = 4, chunk_size: int = 8,
+                 do_sample: bool = False, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, policy: str = "fifo",
+                 prompt_buckets: Optional[Sequence[int]] = None):
+        if chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        self.num_slots = int(num_slots)
+        self.chunk_size = int(chunk_size)
+        self._b = _make_backend(backend, num_slots, chunk_size, do_sample,
+                                top_k, top_p)
+        self.scheduler = Scheduler(
+            num_slots, policy=policy,
+            prompt_buckets=prompt_buckets or self._b.prompt_buckets)
+        self.state = self._b.new_state()
+        self.prefill_dispatches = 0
+        self.chunk_dispatches = 0
+        self.step_dispatches = 0      # per-token degradation rung only
+        self._next_id = 0
+        self._results: Dict[int, Any] = {}
+        self._occ: List[float] = []
+        self._queue_delays: List[float] = []
+        self._degradations: List[Any] = []
+        self._tokens_emitted = 0
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token_id: Optional[int] = None,
+               temperature: float = 1.0, seed: int = 0,
+               priority: int = 0) -> int:
+        """Queue one request; returns its id (results key)."""
+        from paddle_tpu.inference.generate import _normalize_eos
+        prompt = np.asarray(prompt)
+        if prompt.ndim == 2:
+            if prompt.shape[0] != 1:
+                raise ValueError(
+                    f"submit takes ONE request (a (S,) or (1, S) prompt), "
+                    f"got batch {prompt.shape[0]}; call submit per row")
+            prompt = prompt[0]
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be 1-D, got shape {prompt.shape}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        bucket = self.scheduler.bucket(len(prompt))
+        if max(bucket, len(prompt) + int(max_new_tokens)) > self._b.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} (bucket {bucket}) + "
+                f"{max_new_tokens} new tokens exceeds the backend's "
+                f"max_len {self._b.max_len}")
+        rid = self._next_id
+        self._next_id += 1
+        self.scheduler.push(Request(
+            id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            eos_token_id=_normalize_eos(eos_token_id),
+            temperature=float(temperature), seed=int(seed),
+            priority=int(priority), submit_time=time.monotonic()))
+        return rid
+
+    # -- the serving loop --------------------------------------------------
+    def step(self) -> List[Tuple[int, Any]]:
+        """One iteration: admit into free slots, run ONE chunk dispatch,
+        harvest finished rows. Returns ``[(request_id, result), ...]``
+        finished this step (also retrievable via ``result(id)``)."""
+        now = time.monotonic()
+        for slot_idx, req in self.scheduler.admissions():
+            self._admit(slot_idx, req, now)
+        occupied = self.scheduler.slots.occupied()
+        if not occupied:
+            return []
+        self._occ.append(len(occupied) / self.num_slots)
+        toks = self._dispatch_chunk(occupied)
+        finished, freed = [], []
+        for i, slot in occupied:
+            slot.chunks += 1
+            slot.tokens.append(toks[i])
+            req = slot.request
+            seq = np.concatenate(slot.tokens)
+            fin = False
+            if req.eos_token_id is not None:
+                hit = seq == req.eos_token_id
+                if hit.any():
+                    seq = seq[:int(np.argmax(hit)) + 1]
+                    fin = True
+            if len(seq) >= req.max_new_tokens:
+                seq = seq[:req.max_new_tokens]
+                fin = True
+            if not fin:
+                continue
+            res = self._finish(slot, seq, i)
+            self._results[req.id] = res
+            finished.append((req.id, res))
+            self.scheduler.slots.release(i)
+            freed.append(i)
+        if freed:
+            # freeze freed rows until re-admission: they keep riding the
+            # batched program, but pinned — their output is discarded.
+            # A fixed-shape (B,) mask OR, not a scatter: eager scatters
+            # recompile per freed-set shape (~ms each on the host path)
+            import jax.numpy as jnp
+            mask = np.zeros(self.num_slots, bool)
+            mask[freed] = True
+            self.state = dataclasses.replace(
+                self.state,
+                done=jnp.logical_or(self.state.done, jnp.asarray(mask)))
+        return finished
+
+    def drain(self, max_steps: Optional[int] = None) -> Dict[int, Any]:
+        """Step until the queue and every slot are empty; returns
+        ``{request_id: result}`` for everything finished while draining."""
+        out: Dict[int, Any] = {}
+        steps = 0
+        while len(self.scheduler) or self.scheduler.slots.occupied():
+            for rid, res in self.step():
+                out[rid] = res
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"drain did not converge within {max_steps} steps")
+        return out
+
+    def result(self, request_id: int):
+        return self._results.get(request_id)
+
+    # -- internals ---------------------------------------------------------
+    def _admit(self, slot_idx: int, req: Request, now: float) -> None:
+        import jax.numpy as jnp
+        import jax.random as jrandom
+
+        S = len(req.prompt)
+        bucket = self.scheduler.bucket(S)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :S] = req.prompt
+        ev0 = self._b.event_count()
+        logits1, kc1, vc1 = self._b.admit_prefill(ids, S)
+        self.prefill_dispatches += 1
+        # the SAME row-key rule as generate(chunk_size=) at B=1: the
+        # request's stream is keyed by its seed alone
+        key1 = jnp.asarray(jrandom.split(jrandom.PRNGKey(req.seed), 1)[0],
+                           jnp.uint32)
+        st = self.state
+        (logits, kc, vc, pos, keys, done, eos, temp) = _admit_row_jit(
+            st.logits, st.kc, st.vc, st.pos, st.keys, st.done, st.eos,
+            st.temp, logits1, kc1, vc1,
+            jnp.asarray(slot_idx, jnp.int32), jnp.asarray(S, jnp.int32),
+            key1,
+            jnp.asarray(-1 if req.eos_token_id is None
+                        else int(req.eos_token_id), jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32))
+        self.state = dataclasses.replace(
+            st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
+            done=done, eos=eos, temp=temp)
+        slot = self.scheduler.slots.entries[slot_idx]
+        slot.admitted_at = now
+        slot.events.extend(self._b.events_since(ev0))
+        self._queue_delays.append(now - req.submit_time)
+
+    def _dispatch_chunk(self, occupied) -> np.ndarray:
+        from paddle_tpu.flags import flags as _flags
+        from paddle_tpu.runtime.resilience import (
+            DecodeFailedError, DegradationEvent, classify_error,
+            record_event)
+
+        ev0 = self._b.event_count()
+        try:
+            toks, self.state = self._b.decode_chunk(self.state,
+                                                    self.chunk_size)
+            self.chunk_dispatches += 1
+            self._tokens_emitted += self.num_slots * self.chunk_size
+            self._note_events(occupied, ev0, [])
+            return np.asarray(toks)
+        except Exception as e:
+            if classify_error(e) != "transient":
+                raise
+            if (not _flags.resilience_auto_degrade
+                    or not self._b.has_step_rung()):
+                raise DecodeFailedError(
+                    f"serving chunk dispatch failed with no per-token "
+                    f"rung available: {str(e)[:300]}",
+                    events=self._b.events_since(ev0), last_error=e) from e
+            ev = DegradationEvent(
+                site="serve.chunk", from_level="chunked",
+                to_level="per_token", error_class=type(e).__name__,
+                error=str(e)[:300])
+            record_event(ev)
+            self._degradations.append(ev)
+        # per-token rung: T single-step dispatches on the SAME carry —
+        # the failed chunk never consumed it (faults fire before
+        # execution; the in-process chunk doesn't donate its inputs), so
+        # every admitted request rides through the degradation
+        parts = []
+        for _ in range(self.chunk_size):
+            toks1, self.state = self._b.decode_step(self.state)
+            self.step_dispatches += 1
+            parts.append(np.asarray(toks1))
+        self._tokens_emitted += self.num_slots * self.chunk_size
+        self._note_events(occupied, ev0, [ev])
+        return np.concatenate(parts, axis=1)
+
+    def _note_events(self, occupied, ev0: int, degradations) -> None:
+        """Attribute THIS dispatch's retry/degradation events to every
+        request that was riding it (and only those — a request admitted
+        after an earlier degradation never inherits it)."""
+        new = self._b.events_since(ev0) + list(degradations)
+        for _, slot in occupied:
+            slot.events.extend(new)
+
+    def _finish(self, slot, seq: np.ndarray, slot_idx: int):
+        from paddle_tpu.runtime.resilience import GenerateResult
+        req = slot.request
+        degr = [e for e in slot.events
+                if getattr(e, "kind", "") == "degradation"]
+        record = {
+            "level": "per_token" if degr else "chunked",
+            "requested_level": "chunked",
+            "retries": sum(1 for e in slot.events
+                           if getattr(e, "kind", "") == "retry"),
+            "degradations": [e.as_dict() for e in degr],
+            "events": [e.as_dict() for e in slot.events],
+            "serving": {
+                "queue_delay_s": slot.admitted_at - req.submit_time,
+                "chunks": slot.chunks,
+                "slot": slot_idx,
+            },
+        }
+        out = np.concatenate([req.prompt,
+                              seq.astype(req.prompt.dtype)])[None]
+        return GenerateResult.wrap(out, record)
+
+    # -- observability -----------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        """Serving counters: dispatch accounting (prefills = admitted
+        requests; chunks; per-token degradation steps), mean slot
+        occupancy over chunk dispatches, queue-delay stats, and the
+        useful-token fraction (requested tokens / slot-steps run)."""
+        qd = np.asarray(self._queue_delays) if self._queue_delays else None
+        return {
+            "num_slots": self.num_slots,
+            "chunk_size": self.chunk_size,
+            "requests_submitted": self._next_id,
+            "requests_completed": len(self._results),
+            "queued": len(self.scheduler),
+            "prefill_dispatches": self.prefill_dispatches,
+            "chunk_dispatches": self.chunk_dispatches,
+            "step_dispatches": self.step_dispatches,
+            "degradations": len(self._degradations),
+            "occupancy_mean": (float(np.mean(self._occ))
+                               if self._occ else 0.0),
+            "occupancy_samples": len(self._occ),
+            # ALL rows compute every chunk step, occupied or not — the
+            # honest denominator for useful-token occupancy comparisons
+            "slot_steps_total": self._tokens_emitted,
+            "queue_delay_mean_s": (float(qd.mean())
+                                   if qd is not None else 0.0),
+            "queue_delay_p50_s": (float(np.percentile(qd, 50))
+                                  if qd is not None else 0.0),
+            "queue_delay_p99_s": (float(np.percentile(qd, 99))
+                                  if qd is not None else 0.0),
+        }

@@ -1,0 +1,151 @@
+"""Slot-admission scheduling for continuous batching.
+
+Iteration-level batching (Orca — Yu et al., OSDI 2022; PAPERS.md): the
+decode batch is a table of SLOTS, each owning one row of the fused
+loop's carry (``inference/generate.DecodeState``). Between chunk
+dispatches, rows whose request finished are released and the admission
+policy refills them from the queue — one length-bucketed prefill
+dispatch per admitted request — so the chip never idles on dead rows
+while the single-program decode property (Pope et al., 2211.05102)
+stays intact: the batch still runs as ONE device program per chunk.
+
+This module is pure host-side bookkeeping: the request queue (FIFO or
+priority), the slot table, and prompt length bucketing. The device-side
+state assembly lives in ``serving/engine.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Request", "Slot", "SlotTable", "Scheduler", "bucket_length"]
+
+
+def bucket_length(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest admission-prefill bucket that fits an ``n``-token prompt:
+    the next power of two (floor 8) by default, or the smallest entry of
+    an explicit bucket list — ONE compiled prefill program per bucket
+    instead of one per distinct prompt length, bounding recompiles under
+    arbitrary traffic."""
+    if n < 1:
+        raise ValueError(f"prompt must have at least 1 token, got {n}")
+    if buckets:
+        fits = [int(b) for b in buckets if int(b) >= n]
+        if not fits:
+            raise ValueError(
+                f"prompt length {n} exceeds the largest prefill bucket "
+                f"{max(int(b) for b in buckets)}")
+        return min(fits)
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued generate ask. ``eos_token_id`` is already normalized
+    (None = decode to the full budget); ``seed`` keys the row's private
+    RNG stream; ``priority`` orders admission under the 'priority'
+    policy (lower = sooner), ties broken FIFO."""
+    id: int
+    prompt: np.ndarray            # (S,) token ids
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    temperature: float = 1.0
+    seed: int = 0
+    priority: int = 0
+    submit_time: float = 0.0
+
+
+@dataclasses.dataclass
+class Slot:
+    """One occupied batch row: the request it serves plus the host-side
+    reassembly buffer (per-chunk token pieces) and the per-request
+    observability record (queue delay, chunks spanned, resilience events
+    that fired while it was in flight)."""
+    request: Request
+    admitted_at: float = 0.0
+    chunks: int = 0
+    tokens: List[np.ndarray] = dataclasses.field(default_factory=list)
+    events: List[Any] = dataclasses.field(default_factory=list)
+
+
+class SlotTable:
+    """Which batch row belongs to which in-flight request."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"need at least 1 slot, got {num_slots}")
+        self.entries: List[Optional[Slot]] = [None] * num_slots
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def free_slots(self) -> List[int]:
+        return [i for i, e in enumerate(self.entries) if e is None]
+
+    def occupied(self) -> List[Tuple[int, Slot]]:
+        return [(i, e) for i, e in enumerate(self.entries) if e is not None]
+
+    def occupancy(self) -> float:
+        return len(self.occupied()) / len(self.entries)
+
+    def occupy(self, request: Request) -> int:
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot to occupy")
+        i = free[0]
+        self.entries[i] = Slot(request=request)
+        return i
+
+    def release(self, i: int) -> None:
+        if self.entries[i] is None:
+            raise RuntimeError(f"slot {i} is already free")
+        self.entries[i] = None
+
+
+class Scheduler:
+    """Admission queue + slot table.
+
+    ``policy='fifo'`` admits strictly in submit order; ``'priority'``
+    admits by ``Request.priority`` (lower first, FIFO within a class).
+    ``admissions()`` implements the between-chunk policy: pop one queued
+    request per free slot and occupy it — the engine then prefills each
+    admitted request and scatters its row into the decode carry."""
+
+    def __init__(self, num_slots: int, policy: str = "fifo",
+                 prompt_buckets: Optional[Sequence[int]] = None):
+        if policy not in ("fifo", "priority"):
+            raise ValueError(f"policy must be 'fifo' or 'priority', "
+                             f"got {policy!r}")
+        self.policy = policy
+        self.prompt_buckets = (sorted(int(b) for b in prompt_buckets)
+                               if prompt_buckets else None)
+        self.slots = SlotTable(num_slots)
+        self._heap: list = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def bucket(self, prompt_len: int) -> int:
+        return bucket_length(prompt_len, self.prompt_buckets)
+
+    def push(self, request: Request) -> None:
+        pr = request.priority if self.policy == "priority" else 0
+        heapq.heappush(self._heap, (pr, next(self._seq), request))
+
+    def admissions(self) -> List[Tuple[int, Request]]:
+        """Fill every free slot from the queue; returns the
+        ``(slot_index, request)`` pairs admitted this round."""
+        out = []
+        while self._heap and self.slots.free_slots():
+            _, _, req = heapq.heappop(self._heap)
+            out.append((self.slots.occupy(req), req))
+        return out

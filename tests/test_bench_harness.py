@@ -60,6 +60,71 @@ def test_run_guarded_nontransient_fails_fast_with_class(capsys):
     assert "vocab mismatch" in rec["error"]
 
 
+def test_ensure_backend_ok_leaves_platform_alone():
+    switched = []
+    out = bench._ensure_backend(devices_fn=lambda: ["dev0"],
+                                to_cpu=lambda: switched.append(1))
+    assert out == "ok"
+    assert switched == []
+
+
+def test_ensure_backend_falls_back_to_cpu_on_unavailable():
+    """The BENCH_r05 failure class: backend init raises UNAVAILABLE
+    inside the first jax.devices() — the bench must fall back to the CPU
+    platform instead of dying with a raw rc=1 traceback."""
+    calls = {"n": 0}
+
+    def devices():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+                "backend setup/compile error (Unavailable).")
+        return ["cpu0"]
+
+    switched = []
+    out = bench._ensure_backend(devices_fn=devices,
+                                to_cpu=lambda: switched.append(1))
+    assert out == "cpu_fallback"
+    assert switched == [1]
+    assert calls["n"] == 2
+
+
+def test_ensure_backend_fatal_init_error_propagates():
+    switched = []
+    with pytest.raises(ValueError, match="not a backend problem"):
+        bench._ensure_backend(
+            devices_fn=lambda: (_ for _ in ()).throw(
+                ValueError("not a backend problem")),
+            to_cpu=lambda: switched.append(1))
+    assert switched == []                # no pointless platform switch
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_bench_serve_contract():
+    """`python bench.py --serve` (the small CPU profile): rc=0, the LAST
+    stdout line is a parseable record whose continuous-vs-static
+    comparison carries tokens/s, occupancy, p50/p99 latency and dispatch
+    counts — and continuous batching beats static batching on tokens/s
+    and useful-token occupancy (the engine itself hard-asserts
+    per-request greedy parity and the dispatch accounting)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run([sys.executable, "bench.py", "--serve"],
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    s = rec["serve"]
+    for side in ("continuous", "static"):
+        for k in ("tokens_per_sec", "occupancy_useful", "latency_p50_s",
+                  "latency_p99_s", "dispatches"):
+            assert s[side][k] is not None, (side, k)
+    assert s["continuous"]["prefill_dispatches"] == s["requests"]
+    assert s["continuous_beats_static"] is True, s
+
+
 @pytest.mark.slow
 def test_bench_decode_emits_modes_breakdown():
     """`python bench.py --decode` contract: final stdout json carries

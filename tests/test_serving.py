@@ -1,0 +1,378 @@
+"""Continuous batching: chunked resumable fused decode + slot-admission
+serving engine (Orca-style iteration-level batching).
+
+The load-bearing properties:
+- chunked decode chained over N steps is BIT-EXACT with run-to-completion
+  for greedy (chunk slicing can't change tokens);
+- a request served by the engine is bit-exact vs a solo ``generate`` of
+  the same request (admission parity: batch neighbours, slot reuse and
+  length-bucketed prefill are invisible);
+- sampled rows draw from per-row key streams — output depends only on
+  the request's seed, not on engine shape (distribution-preserving);
+- dispatch accounting: one admission prefill per request + one dispatch
+  per chunk, nothing hidden;
+- a chunk dispatch that keeps failing degrades to the per-token rung
+  without dropping any in-flight request (``faults`` drill).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.generate import LlamaDecoder
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Request, Scheduler, ServingEngine, \
+    bucket_length
+
+pytestmark = pytest.mark.serving
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, max_position_embeddings=64)
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+@pytest.fixture(scope="module")
+def dec():
+    return LlamaDecoder(_model(), max_len=64)
+
+
+def _mixed_requests(rng, n, eos_every=None, dec=None):
+    """n requests with mixed prompt lengths and budgets; every
+    ``eos_every``-th one gets a reachable eos id (its solo greedy
+    mid-stream token)."""
+    reqs = []
+    for i in range(n):
+        p = rng.integers(0, 64, (int(rng.integers(2, 12)),))
+        nt = int(rng.integers(2, 12))
+        eos = None
+        if eos_every and i % eos_every == 0 and nt >= 4:
+            ref = np.asarray(dec.generate(p[None], nt))
+            eos = int(ref[0, len(p) + nt // 2])
+        reqs.append((p, nt, eos))
+    return reqs
+
+
+# -- chunked resumable decode ----------------------------------------------
+
+@pytest.mark.parametrize("T", [1, 3, 8, 16])
+def test_chunked_generate_bitexact_greedy(dec, T):
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 64, (2, 5))
+    ref = np.asarray(dec.generate(prompt, max_new_tokens=12))
+    out = np.asarray(dec.generate(prompt, max_new_tokens=12, chunk_size=T))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_chunked_generate_bitexact_greedy_eos(dec):
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 64, (2, 4))
+    eos = int(np.asarray(dec.generate(prompt, 12))[0, 9])
+    ref = np.asarray(dec.generate(prompt, 12, eos_token_id=eos))
+    out = np.asarray(dec.generate(prompt, 12, eos_token_id=eos,
+                                  chunk_size=5))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_decode_state_resume_matches_run_to_completion(dec):
+    """The exported carry re-enters: two chunks (4 + 8) == one 12-token
+    generate, bit-exact."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 64, (3, 6))
+    ref = np.asarray(dec.generate(prompt, 12))
+    st = dec.init_decode_state(prompt)
+    t1, st = dec.decode_chunk(st, 4)
+    assert st.steps_done == 4
+    t2, st = dec.decode_chunk(st, 8)
+    got = np.concatenate([prompt, np.asarray(t1), np.asarray(t2)], axis=1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_chunked_dispatch_count_and_record(dec):
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 64, (1, 4))
+    d0 = dec.dispatch_count
+    res = dec.generate(prompt, max_new_tokens=12, chunk_size=5)
+    # one prefill + ceil(12/5) chunk dispatches
+    assert dec.dispatch_count - d0 == 1 + 3
+    assert res.resilience["level"] == "chunked"
+    assert dec.last_spec_stats is None
+
+
+def test_chunk_size_validation(dec):
+    prompt = np.array([[1, 2, 3]])
+    with pytest.raises(ValueError, match="chunk_size"):
+        dec.generate(prompt, 4, chunk_size=0)
+    with pytest.raises(ValueError, match="draft_model"):
+        dec.generate(prompt, 4, chunk_size=4, draft_model="skip:1")
+
+
+# -- scheduler -------------------------------------------------------------
+
+def test_bucket_length():
+    assert bucket_length(1) == 8
+    assert bucket_length(8) == 8
+    assert bucket_length(9) == 16
+    assert bucket_length(100) == 128
+    assert bucket_length(5, buckets=[4, 16]) == 16
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_length(33, buckets=[16, 32])
+
+
+def test_scheduler_fifo_and_priority():
+    sch = Scheduler(num_slots=1, policy="priority")
+    for rid, pr in ((0, 5), (1, 1), (2, 5)):
+        sch.push(Request(id=rid, prompt=np.arange(3), max_new_tokens=2,
+                         priority=pr))
+    order = []
+    while len(sch):
+        [(slot, req)] = sch.admissions()
+        order.append(req.id)
+        sch.slots.release(slot)
+    assert order == [1, 0, 2]      # lowest priority first, FIFO in class
+
+    sch = Scheduler(num_slots=1, policy="fifo")
+    for rid, pr in ((0, 5), (1, 1)):
+        sch.push(Request(id=rid, prompt=np.arange(3), max_new_tokens=2,
+                         priority=pr))
+    [(slot, req)] = sch.admissions()
+    assert req.id == 0             # fifo ignores priority
+
+
+# -- engine ----------------------------------------------------------------
+
+def test_engine_admission_parity_greedy(dec):
+    """Each request's tokens bit-exact vs a solo generate — across mixed
+    prompt lengths (bucketed prefill), mixed budgets, eos early-stops and
+    slot reuse — with the exact dispatch accounting."""
+    rng = np.random.default_rng(4)
+    reqs = _mixed_requests(rng, 8, eos_every=3, dec=dec)
+    solo = [np.asarray(dec.generate(p[None], n, eos_token_id=e))
+            for p, n, e in reqs]
+    eng = ServingEngine(dec, num_slots=3, chunk_size=4)
+    d0 = dec.dispatch_count
+    ids = [eng.submit(p, n, eos_token_id=e) for p, n, e in reqs]
+    res = eng.drain()
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(res[rid]), solo[i])
+    m = eng.metrics()
+    assert m["prefill_dispatches"] == len(reqs)
+    assert m["step_dispatches"] == 0
+    assert dec.dispatch_count - d0 == \
+        m["prefill_dispatches"] + m["chunk_dispatches"]
+    rec = res[ids[0]].resilience
+    assert rec["level"] == "chunked"
+    assert rec["serving"]["queue_delay_s"] >= 0.0
+    assert rec["serving"]["chunks"] >= 1
+
+
+def test_engine_priority_order(dec):
+    eng = ServingEngine(dec, num_slots=1, chunk_size=4, policy="priority")
+    p = np.arange(4) % 64
+    low = eng.submit(p, 3, priority=9)
+    high = eng.submit(p + 1, 3, priority=0)
+    finished = []
+    while len(finished) < 2:
+        finished.extend(rid for rid, _ in eng.step())
+    assert finished == [high, low]
+
+
+def test_engine_sampled_fixed_keys_row_independent(dec):
+    """Sampled outputs are keyed by the request's seed alone: a 3-slot
+    T=3 engine and a 1-slot T=7 engine produce IDENTICAL tokens for the
+    same submissions — batch neighbours, slot assignment and chunk
+    slicing cannot shift any row's stream."""
+    rng = np.random.default_rng(5)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 8)),)),
+             int(rng.integers(3, 9)), i, 0.7 + 0.2 * (i % 3))
+            for i in range(6)]
+    outs = []
+    for slots, T in ((3, 3), (1, 7)):
+        eng = ServingEngine(dec, num_slots=slots, chunk_size=T,
+                            do_sample=True, top_k=8)
+        ids = [eng.submit(p, n, seed=s, temperature=t)
+               for p, n, s, t in reqs]
+        res = eng.drain()
+        outs.append([np.asarray(res[r]) for r in ids])
+    for a, b in zip(*outs):
+        np.testing.assert_array_equal(a, b)
+    # and generate(chunk_size=) at B=1 is the same stream
+    p, n, s, t = reqs[0]
+    g = np.asarray(dec.generate(p[None], n, do_sample=True, top_k=8,
+                                seed=s, temperature=t, chunk_size=4))
+    np.testing.assert_array_equal(g, outs[0][0])
+
+
+def test_engine_occupancy_accounting(dec):
+    eng = ServingEngine(dec, num_slots=4, chunk_size=4)
+    p = np.arange(5) % 64
+    eng.submit(p, 8)
+    eng.drain()
+    m = eng.metrics()
+    assert m["occupancy_samples"] == 2          # ceil(8/4) chunks
+    assert m["occupancy_mean"] == pytest.approx(0.25)   # 1 of 4 slots
+    assert m["slot_steps_total"] == 2 * 4 * 4   # ALL rows ride each chunk
+    assert m["requests_completed"] == 1
+    assert m["queue_delay_mean_s"] >= 0.0
+
+    eng2 = ServingEngine(dec, num_slots=2, chunk_size=4)
+    for i in range(2):
+        eng2.submit(p, 4, seed=i)
+    eng2.drain()
+    assert eng2.metrics()["occupancy_mean"] == pytest.approx(1.0)
+
+
+def test_engine_submit_validation(dec):
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(np.arange(8), 100)           # 8 + 100 > 64
+    with pytest.raises(ValueError, match="ONE request"):
+        eng.submit(np.zeros((2, 4), np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.arange(4), 0)
+
+
+# -- AOT bundle serving ----------------------------------------------------
+
+def test_bundle_chunked_serving_parity(dec, tmp_path):
+    """The same scheduler over exported StableHLO entries
+    (decode_mode.chunked): greedy parity vs the in-process decoder."""
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+    export_decoder_bundle(dec, str(tmp_path), prompt_lens=[8],
+                          decode_steps=[8], batch_sizes=[2],
+                          chunk_sizes=[4])
+    pred = AotPredictor(str(tmp_path))
+    mode = pred.meta["decode_mode"]["chunked"]
+    assert mode["chunk_sizes"] == [1, 4]        # T=1 rung always exported
+    assert {b["chunk"] for b in pred.meta["chunk_buckets"]} == {1, 4}
+    assert pred.meta["admit_prefill_buckets"] == [
+        {"file": "admit_prefill_s8.aot", "batch": 1, "seq": 8}]
+
+    rng = np.random.default_rng(6)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 9)),)),
+             int(rng.integers(3, 9))) for _ in range(5)]
+    solo = [np.asarray(dec.generate(p[None], n)) for p, n in reqs]
+    eng = ServingEngine(pred, num_slots=2, chunk_size=4)
+    # prompt buckets come from the bundle's exported admit entries
+    assert eng.scheduler.prompt_buckets == [8]
+    ids = [eng.submit(p, n) for p, n in reqs]
+    res = eng.drain()
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(res[rid]), solo[i])
+    assert eng.metrics()["prefill_dispatches"] == len(reqs)
+
+
+def test_bundle_without_chunked_entries_refuses(dec, tmp_path):
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+    export_decoder_bundle(dec, str(tmp_path), prompt_lens=[8],
+                          decode_steps=[8], batch_sizes=[2])
+    with pytest.raises(ValueError, match="chunk_sizes"):
+        ServingEngine(AotPredictor(str(tmp_path)), num_slots=2,
+                      chunk_size=4)
+
+
+# -- resilience ------------------------------------------------------------
+
+@pytest.mark.faults
+def test_chunk_failure_degrades_without_dropping_requests(dec):
+    """The drill of the ISSUE: a plan kills every 'decode.chunk' dispatch
+    mid-serve; the engine steps down to the per-token rung on the SAME
+    carry — every in-flight request completes, greedy outputs stay
+    bit-exact, and the degradation is on each affected record."""
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.runtime.resilience import fault_injector
+
+    rng = np.random.default_rng(7)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 8)),)),
+             int(rng.integers(3, 9))) for _ in range(5)]
+    solo = [np.asarray(dec.generate(p[None], n)) for p, n in reqs]
+    set_flags({"resilience_backoff_s": 0.0})
+    fault_injector.configure([{"kind": "dispatch_error",
+                               "site": "decode.chunk",
+                               "call": 2, "times": 1000}])
+    try:
+        eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+        ids = [eng.submit(p, n) for p, n in reqs]
+        res = eng.drain()
+        for i, rid in enumerate(ids):
+            np.testing.assert_array_equal(np.asarray(res[rid]), solo[i])
+        m = eng.metrics()
+        assert m["degradations"] >= 1
+        assert m["step_dispatches"] >= eng.chunk_size
+        rec = res[ids[-1]].resilience
+        assert rec["level"] == "per_token"
+        assert rec["degradations"]
+    finally:
+        fault_injector.clear()
+        set_flags({"resilience_backoff_s": 0.5})
+
+
+@pytest.mark.faults
+def test_chunked_generate_resilience_across_dispatches(dec):
+    """GenerateResult.resilience spans EVERY chunk dispatch of one
+    generate: a transient absorbed on chunk 2 of 3 lands in the one
+    record; a permanently failing chunk rung degrades to fused with no
+    stale state (bit-exact output) and no stale spec stats."""
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.runtime.resilience import fault_injector
+
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 64, (1, 4))
+    ref = np.asarray(dec.generate(prompt, 9))
+    # seed stale speculative stats from a previous generate
+    dec.generate(prompt, 6, draft_model="skip:1")
+    assert dec.last_spec_stats is not None
+    set_flags({"resilience_backoff_s": 0.0})
+    try:
+        fault_injector.configure([{"kind": "dispatch_error",
+                                   "site": "decode.chunk", "call": 2}])
+        res = dec.generate(prompt, 9, chunk_size=3)
+        np.testing.assert_array_equal(np.asarray(res), ref)
+        assert res.resilience["level"] == "chunked"
+        assert res.resilience["retries"] == 1       # absorbed mid-request
+        assert dec.last_spec_stats is None          # stale stats cleared
+
+        fault_injector.configure([{"kind": "dispatch_error",
+                                   "site": "decode.chunk",
+                                   "call": 2, "times": 1000}])
+        res = dec.generate(prompt, 9, chunk_size=3)
+        np.testing.assert_array_equal(np.asarray(res), ref)
+        assert res.resilience["level"] == "fused"   # rung changed...
+        assert res.resilience["degradations"]       # ...mid-request
+        assert dec.last_spec_stats is None
+    finally:
+        fault_injector.clear()
+        set_flags({"resilience_backoff_s": 0.5})
+
+
+# -- the slow sweep --------------------------------------------------------
+
+@pytest.mark.slow
+def test_chunk_size_sweep(dec):
+    """Chunk-size sweep: greedy and greedy+eos parity for every T, and
+    engine parity at several (slots, T) shapes."""
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 64, (3, 7))
+    ref = np.asarray(dec.generate(prompt, 20))
+    eos = int(ref[1, 12])
+    ref_eos = np.asarray(dec.generate(prompt, 20, eos_token_id=eos))
+    for T in (1, 2, 3, 5, 7, 16, 20, 32):
+        np.testing.assert_array_equal(
+            np.asarray(dec.generate(prompt, 20, chunk_size=T)), ref)
+        np.testing.assert_array_equal(
+            np.asarray(dec.generate(prompt, 20, eos_token_id=eos,
+                                    chunk_size=T)), ref_eos)
+    reqs = _mixed_requests(rng, 10, eos_every=4, dec=dec)
+    solo = [np.asarray(dec.generate(p[None], n, eos_token_id=e))
+            for p, n, e in reqs]
+    for slots, T in ((1, 5), (2, 3), (4, 8), (5, 2)):
+        eng = ServingEngine(dec, num_slots=slots, chunk_size=T)
+        ids = [eng.submit(p, n, eos_token_id=e) for p, n, e in reqs]
+        res = eng.drain()
+        for i, rid in enumerate(ids):
+            np.testing.assert_array_equal(np.asarray(res[rid]), solo[i],
+                                          err_msg=f"slots={slots} T={T}")

@@ -10,7 +10,11 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
   4. decode_modes (`bench.py --decode`): the fused-decode sweep incl.
      the speculative rows (tokens/s, dispatch counts, mean acceptance
      length) to be recorded into BASELINE.md
-  5. fault_matrix (tools/fault_matrix.py): every injectable fault class
+  5. serve (`bench.py --serve`, small profile): continuous-vs-static
+     batching under Poisson arrivals — tokens/s, slot occupancy,
+     p50/p99 latency, dispatch counts; per-request greedy parity and
+     the dispatch accounting are hard-asserted inside the bench
+  6. fault_matrix (tools/fault_matrix.py): every injectable fault class
      against the decode + checkpoint + bundle + elastic paths — recover
      bit-exact or fail typed; the round's robustness gate ON HARDWARE
      (the same sweep runs on CPU in CI)
@@ -33,6 +37,7 @@ STEPS = [
     ("decode1b_served", [sys.executable, "bench.py", "--config",
                          "decode1b_served"]),
     ("decode_modes", [sys.executable, "bench.py", "--decode"]),
+    ("serve", [sys.executable, "bench.py", "--serve"]),
     ("fault_matrix", [sys.executable, "tools/fault_matrix.py"]),
 ]
 

@@ -36,6 +36,11 @@ TIERS = {
         "tests/test_pipeline_zb.py",
         "tests/test_ring_attention.py",
         "tests/test_aot_bundle.py",
+        # serving: the --runslow chunk-size / engine-shape sweep and the
+        # bench.py --serve subprocess contract ride tier A so no slow
+        # serving test exists outside a recorded gate
+        "tests/test_serving.py",
+        "tests/test_bench_harness.py",
     ],
     "b": [
         "tests/test_op_sweep.py",
