@@ -141,6 +141,49 @@ def _trace_profile(trainer, arrays, steps: int, config_name: str) -> dict:
     return rows
 
 
+def _obs_mark():
+    """Start an obs evidence window (None when obs is off): spans
+    admitted after the returned mark belong to the timed section."""
+    import paddle_tpu.obs as obs
+    return obs.tracer.mark() if obs.enabled() else None
+
+
+def _obs_window(mark, wall_s=None):
+    """Summarize one obs window: per-site dispatch-span counts (error
+    spans excluded — a failed dispatch never ran), the per-dispatch
+    FLOPs each site's compiled program costs (XLA cost_analysis via
+    obs.cost), and the window's model-FLOPs-utilisation when a wall
+    time is given."""
+    import paddle_tpu.obs as obs
+    counts = obs.tracer.counts(mark)
+    costs = obs.site_costs()
+    flops = {s: costs[s]["flops"] for s in counts
+             if s in costs and "flops" in costs[s]}
+    total = sum(counts[s] * f for s, f in flops.items())
+    out = {"dispatch_spans": counts, "flops_per_dispatch": flops,
+           "total_flops": total}
+    if wall_s and total:
+        out["mfu"] = round(obs.mfu(total, wall_s), 6)
+    return out
+
+
+def _obs_finish(mark, trace_name, **extra):
+    """Close an obs evidence block: export the window's spans as a
+    chrome-trace-loadable file and bundle the metrics snapshot +
+    per-site cost records. Returns the bench record's ``obs`` block."""
+    import paddle_tpu.obs as obs
+    if mark is None:
+        return {"enabled": False}
+    path = obs.tracer.export_chrome_trace(trace_name, since=mark)
+    block = {"enabled": True, "trace_path": path,
+             "spans_dropped": obs.tracer.dropped,
+             "metrics": obs.metrics.snapshot(),
+             "site_costs": obs.site_costs(),
+             "peak_flops_per_sec": obs.device_peak_flops()}
+    block.update(extra)
+    return block
+
+
 def _emit(metric: str, value: float, unit: str) -> dict:
     vs = None
     try:
@@ -753,7 +796,7 @@ def bench_moe():
     return _emit("moe_lm_train_tokens_per_sec", tps, "tokens/sec")
 
 
-def bench_decode_modes():
+def bench_decode_modes(steps=None):
     """``--decode``: the fused one-dispatch decode microbenchmark.
 
     Measures tokens/s AND device-dispatch count per generate call for
@@ -764,7 +807,15 @@ def bench_decode_modes():
     rows additionally report the mean accepted-draft count per verify
     step (``acceptance_len_mean``); every row carries
     ``tokens_per_dispatch``. The full breakdown rides in the emitted
-    BENCH json line under "decode"."""
+    BENCH json line under "decode". ``steps`` overrides the per-mode
+    repetition count (``--steps``).
+
+    With obs enabled (PADDLE_TPU_OBS=1) each mode's timed window is also
+    an obs evidence window: per-site dispatch-SPAN counts are asserted
+    to equal the decoder's dispatch accounting exactly (fused generate =
+    prefill + 1), per-dispatch FLOPs and window MFU ride in each row's
+    ``obs`` entry, and the whole run exports a chrome-trace-loadable
+    ``obs_trace_decode.json`` recorded in the top-level ``obs`` block."""
     import numpy as np
 
     import jax
@@ -780,6 +831,8 @@ def bench_decode_modes():
                           max_position_embeddings=1024, dtype="bfloat16")
         batches, prompt_len, n_new, reps = (1, 8, 32), 128, 96, 3
         spec_draft, spec_k = "skip:3", 4
+        if steps:
+            reps = int(steps)
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_hidden_layers=2,
@@ -787,6 +840,8 @@ def bench_decode_modes():
                           max_position_embeddings=256)
         batches, prompt_len, n_new, reps = (1, 2), 8, 8, 2
         spec_draft, spec_k = "skip:1", 2
+        if steps:
+            reps = int(steps)
     model = LlamaForCausalLM(cfg)
     if on_tpu:
         for p in model.parameters():
@@ -806,12 +861,14 @@ def bench_decode_modes():
              ("spec_greedy", dict(spec_kw)),
              ("spec_sampled", {"do_sample": True, "temperature": 0.8,
                                "top_k": 40, "seed": 0, **spec_kw})]
+    run_mark = _obs_mark()        # the whole-run trace export window
     rows = {}
     for B in batches:
         prompt = rng.integers(0, cfg.vocab_size, (B, prompt_len))
         for name, kw in modes:
             dec.generate(prompt, max_new_tokens=n_new, **kw)  # compile+warm
             d0 = dec.dispatch_count
+            wm = _obs_mark()      # per-mode span/dispatch evidence window
             t0 = time.perf_counter()
             for _ in range(reps):
                 dec.generate(prompt, max_new_tokens=n_new, **kw)
@@ -823,6 +880,22 @@ def bench_decode_modes():
                 "dispatches_per_generate": disp,
                 "tokens_per_dispatch": round(n_new / disp, 2),
             }
+            if wm is not None:
+                w = _obs_window(wm, wall_s=dt)
+                spans = sum(w["dispatch_spans"].values())
+                # the acceptance contract: trace spans ARE the dispatch
+                # accounting (fused generate = prefill + 1, speculative
+                # adds the draft prefill) — nothing hidden either way
+                assert spans == disp * reps, \
+                    f"span/dispatch mismatch [{name} B={B}]: " \
+                    f"{w['dispatch_spans']} vs {disp}x{reps}"
+                row["obs"] = {
+                    "spans_per_generate": {
+                        s: c // reps
+                        for s, c in sorted(w["dispatch_spans"].items())},
+                    "flops_per_dispatch": w["flops_per_dispatch"],
+                    "mfu": w.get("mfu"),
+                }
             if name.startswith("spec_"):
                 row["acceptance_len_mean"] = round(
                     dec.last_spec_stats["acceptance_len_mean"], 3)
@@ -841,6 +914,7 @@ def bench_decode_modes():
                       "new_tokens": n_new, "reps": reps,
                       "speculative": {"draft": spec_draft, "k": spec_k},
                       "modes": rows}
+    line["obs"] = _obs_finish(run_mark, "obs_trace_decode.json")
     # re-print the enriched record as the LAST stdout line (the driver
     # parses the final json line; _emit already printed the bare metric)
     print(json.dumps(line))
@@ -864,7 +938,13 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
     Contract checks (hard asserts): every continuous result is bit-exact
     vs a solo greedy ``generate`` of the same request, and the dispatch
     accounting is one admission prefill per request + one dispatch per
-    chunk — nothing hidden."""
+    chunk — nothing hidden. With PADDLE_TPU_OBS=1 the continuous section
+    is an obs evidence window: the exported ``obs_trace_serve.json``
+    must show exactly one ``decode.admit_prefill`` span per admitted
+    request, one ``decode.chunk`` span per chunk dispatch and one
+    ``serving.request`` timeline span per request (asserted), plus the
+    engine's Prometheus snapshot and per-dispatch FLOPs in the record's
+    ``obs`` block."""
     import numpy as np
 
     import jax
@@ -917,6 +997,7 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
     # -- continuous ---------------------------------------------------------
     eng = ServingEngine(dec, num_slots=slots, chunk_size=chunk)
     d0 = dec.dispatch_count
+    wm = _obs_mark()    # obs window covers EXACTLY the continuous section
     finish = {}
     submitted = 0
     t0 = time.perf_counter()
@@ -956,6 +1037,28 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
     assert disp_cont == (m["prefill_dispatches"] + m["chunk_dispatches"]
                          + m["step_dispatches"]), \
         f"hidden dispatches: {disp_cont} vs {m}"
+    # obs evidence (PADDLE_TPU_OBS=1): the exported trace's dispatch-span
+    # counts must equal the engine's asserted accounting — one prefill
+    # span per admitted request, one chunk span per chunk dispatch.
+    # Captured BEFORE the parity solo generates below add their own
+    # spans; the trace export closes the window here too.
+    obs_block = {"enabled": False}
+    if wm is not None:
+        w = _obs_window(wm, wall_s=cont_wall)
+        sp = w["dispatch_spans"]
+        assert sp.get("decode.admit_prefill", 0) == \
+            m["prefill_dispatches"], f"prefill spans vs accounting: {sp}"
+        assert sp.get("decode.chunk", 0) == m["chunk_dispatches"], \
+            f"chunk spans vs accounting: {sp}"
+        assert sp.get("serving.request", 0) == n_req, \
+            f"request timeline spans vs requests: {sp}"
+        obs_block = _obs_finish(wm, "obs_trace_serve.json",
+                                window=w,
+                                engine_metrics_prometheus=eng.registry
+                                .to_prometheus())
+    cont["request_latency_p50_s"] = round(m["request_latency_p50_s"], 4)
+    cont["request_latency_p99_s"] = round(m["request_latency_p99_s"], 4)
+    cont["queue_depth_peak"] = m["queue_depth_peak"]
     for i in range(n_req):
         solo = np.asarray(dec.generate(prompts[i][None], int(lens[i])))
         got = np.asarray(finish[i][1])
@@ -1021,6 +1124,7 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
             speedup > 1.0 and cont["occupancy_useful"]
             > static["occupancy_useful"]),
     }
+    line["obs"] = obs_block
     # re-print the enriched record as the LAST stdout line (the driver
     # parses the final json line; _emit already printed the bare metric)
     print(json.dumps(line))
@@ -1076,18 +1180,36 @@ def _emit_failure(name, e, attempts=1):
     """The parseable last-stdout-line BENCH failure record (never a raw
     rc=1 traceback tail — the round-5 evidence-loss class): the metric
     name, the resilient_call classifier's verdict and the error, with
-    the traceback on stderr."""
+    the traceback on stderr. Carries the probed-backend record (did the
+    run fall back to CPU before failing?) and, when obs is on, the
+    metrics snapshot accumulated up to the failure — so an
+    UNAVAILABLE-fallback run is attributable after the fact instead of
+    a bare error string."""
     from paddle_tpu.runtime.resilience import classify_error
     transient = classify_error(e, phase="setup") == "transient"
     import traceback
     traceback.print_exc(file=sys.stderr)
-    print(json.dumps({
+    record = {
         "metric": name, "value": None, "unit": None,
         "vs_baseline": None, "failed": True,
         "failure_class": ("backend_unavailable" if transient
                           else type(e).__name__),
         "error": str(e)[:400], "attempts": attempts,
-    }))
+        "backend": dict(_BACKEND),
+    }
+    try:
+        import paddle_tpu.obs as obs
+        record["obs"] = (obs.metrics.snapshot() if obs.enabled()
+                         else {"enabled": False})
+    except Exception:
+        record["obs"] = None
+    print(json.dumps(record))
+
+
+# the probed-backend record every BENCH line's failure path carries:
+# which platform actually served the run, and whether the accelerator
+# probe fell back (the "why is this number a CPU number?" attribution)
+_BACKEND = {"status": "unprobed", "platform": None}
 
 
 def _ensure_backend(devices_fn=None, to_cpu=None):
@@ -1106,7 +1228,10 @@ def _ensure_backend(devices_fn=None, to_cpu=None):
     if to_cpu is None:
         to_cpu = lambda: jax.config.update("jax_platforms", "cpu")  # noqa: E731
     try:
-        devices_fn()
+        devs = devices_fn()
+        _BACKEND.update(status="ok",
+                        platform=getattr(devs[0], "platform", None)
+                        if devs else None)
         return "ok"
     except Exception as e:
         if classify_error(e, phase="setup") != "transient" and \
@@ -1115,8 +1240,12 @@ def _ensure_backend(devices_fn=None, to_cpu=None):
         print(f"bench: accelerator backend unavailable, falling back to "
               f"the CPU platform: {str(e)[:200]}", file=sys.stderr)
         to_cpu()
-        devices_fn()     # CPU also down -> propagate (guarded caller
-        return "cpu_fallback"  # emits the structured failure record)
+        devs = devices_fn()  # CPU also down -> propagate (guarded caller
+        #                      emits the structured failure record)
+        _BACKEND.update(status="cpu_fallback",
+                        platform=getattr(devs[0], "platform", None)
+                        if devs else None, probe_error=str(e)[:200])
+        return "cpu_fallback"
 
 
 def main():
@@ -1136,6 +1265,11 @@ def main():
     ap.add_argument("--serve-requests", type=int, default=None)
     ap.add_argument("--serve-slots", type=int, default=None)
     ap.add_argument("--serve-chunk", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override the --decode per-mode repetition "
+                         "count (the obs smoke pass in "
+                         "tools/roundtail_bench.py runs --decode "
+                         "--steps 2 with PADDLE_TPU_OBS=1)")
     args = ap.parse_args()
 
     try:
@@ -1149,7 +1283,8 @@ def main():
             chunk=args.serve_chunk))
         return
     if args.decode:
-        _run_guarded("decode_modes", bench_decode_modes)
+        _run_guarded("decode_modes",
+                     lambda: bench_decode_modes(steps=args.steps))
         return
     if args.all:
         for name in ("resnet50", "bert", "unet", "ernie"):

@@ -226,6 +226,21 @@ define_flag("to_static_max_guard_elems", 64,
             "largest concretized array (elements) that may be baked into "
             "a guard-specialized program; larger concretizations make "
             "the function permanently eager")
+define_flag("obs_enabled", False,
+            "master switch for the unified observability spine "
+            "(paddle_tpu/obs): span tracing at decode/serving/bundle "
+            "dispatch sites, obs metrics counters, compiled-program "
+            "cost telemetry. The PADDLE_TPU_OBS=1 environment variable "
+            "is an equivalent switch; off (default) the instrumented "
+            "paths pay one boolean check per call")
+define_flag("obs_buffer_size", 8192,
+            "ring-buffer capacity (spans) of the global obs tracer; the "
+            "newest spans win and Tracer.dropped counts evictions")
+define_flag("obs_cost_analysis", True,
+            "attach XLA cost_analysis/memory_analysis records "
+            "(FLOPs, bytes, peak bytes) to dispatch spans; derived once "
+            "per (site, input signature) via an AOT lower+compile — "
+            "turn off to trace timing only")
 define_flag("default_dtype", "float32", "default floating point dtype")
 define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
 define_flag("profiler_dir", "", "directory for profiler trace output")

@@ -485,13 +485,28 @@ class AotPredictor:
         """Execute one exported module under the resilience contract:
         the fault-injection hook fires first, then transient backend
         errors retry with backoff; retry events accumulate on the
-        in-flight generate/run record."""
+        in-flight generate/run record.
+
+        With obs enabled (paddle_tpu/obs) each executed entry records a
+        dispatch span named after its fault site (the entry file in the
+        attrs) and bumps ``dispatches.<site>`` — timing only: a
+        jax.export-deserialized module exposes no cost_analysis hooks,
+        so bundle spans carry no FLOPs record (the in-process decoder's
+        spans do)."""
+        import paddle_tpu.obs as obs
         from paddle_tpu.runtime.resilience import (fault_injector,
                                                    resilient_call)
 
         def attempt():
             fault_injector.on_call(site)
-            return self._entry(fname)(*args)
+            if not obs.enabled():
+                return self._entry(fname)(*args)
+            with obs.span(site, kind="dispatch", entry=fname):
+                out = self._entry(fname)(*args)
+            obs.metrics.counter(
+                "dispatches." + site,
+                "bundle entries executed at this site").inc()
+            return out
 
         return resilient_call(attempt, site=site,
                               on_event=self._events.append)

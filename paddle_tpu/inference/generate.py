@@ -589,14 +589,37 @@ class LlamaDecoder:
         counters stay parity-comparable with the no-fault run), then the
         execution retries transient backend errors with backoff
         (resilient_call; FLAGS_resilience_retries/backoff_s). Retry
-        events land in the in-flight generate's record."""
+        events land in the in-flight generate's record.
+
+        Observability (paddle_tpu/obs, FLAGS_obs_enabled): each executed
+        dispatch records a span named after its fault site with the
+        compiled program's cost_analysis/memory_analysis attached (one
+        AOT lower+compile per site/signature, cached), and bumps the
+        ``dispatches.<site>`` obs counter — so a trace's per-site span
+        count is directly comparable with ``dispatch_count`` and the
+        serving engine's asserted accounting. A dispatch that raises
+        records an error span, which the accounting comparison excludes
+        (the failed attempt never ran). Disabled: one boolean check."""
+        import paddle_tpu.obs as obs
+        from paddle_tpu.flags import flags as _flags
         from paddle_tpu.runtime.resilience import (fault_injector,
                                                    resilient_call)
 
         def attempt(args, kwargs):
             fault_injector.on_call(site)
             self.dispatch_count += 1
-            return jitted(*args, **kwargs)
+            if not obs.enabled():
+                return jitted(*args, **kwargs)
+            with obs.span(site, kind="dispatch") as sp:
+                out = jitted(*args, **kwargs)
+                if _flags.obs_cost_analysis:
+                    cost = obs.dispatch_cost(site, jitted, args, kwargs)
+                    if cost:
+                        sp.annotate(**cost)
+            obs.metrics.counter(
+                "dispatches." + site,
+                "device dispatches executed at this site").inc()
+            return out
 
         def call(*args, **kwargs):
             return resilient_call(attempt, args, kwargs, site=site,

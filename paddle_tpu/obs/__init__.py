@@ -1,0 +1,43 @@
+"""paddle_tpu.obs — unified observability spine.
+
+One telemetry surface shared by decode, serving, resilience, checkpoint
+IO and bench:
+
+- :mod:`~paddle_tpu.obs.trace` — thread-safe structured span tracer
+  (nested spans, monotonic clocks, bounded ring buffer) with Chrome
+  trace and JSONL exporters;
+- :mod:`~paddle_tpu.obs.metrics` — typed metrics registry (counters /
+  gauges / explicit-bucket histograms) with snapshot + Prometheus text
+  export;
+- :mod:`~paddle_tpu.obs.cost` — compiled-program cost telemetry:
+  ``cost_analysis()`` FLOPs/bytes and ``memory_analysis()`` peak bytes
+  attached to the owning dispatch span, so every bench can report
+  tokens/s AND MFU per dispatch (Pope et al., 2211.05102 discipline).
+
+Disabled by default: enable with ``FLAGS_obs_enabled=1`` /
+``set_flags({"obs_enabled": True})`` / ``PADDLE_TPU_OBS=1``. The
+disabled path is a single enabled check per instrumented call (guarded
+by an overhead test). ``tools/trace_report.py`` renders an exported
+trace into per-phase / per-request summary tables.
+"""
+
+from paddle_tpu.obs.trace import (  # noqa: F401
+    Span, Tracer, obs_enabled, span, tracer,
+)
+from paddle_tpu.obs.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, metrics,
+)
+from paddle_tpu.obs.cost import (  # noqa: F401
+    clear_cost_cache, device_peak_flops, dispatch_cost, mfu, site_costs,
+)
+
+__all__ = [
+    "Span", "Tracer", "tracer", "span", "obs_enabled",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
+    "dispatch_cost", "site_costs", "clear_cost_cache",
+    "device_peak_flops", "mfu",
+    "enabled",
+]
+
+# the short form call sites use: ``if obs.enabled():``
+enabled = obs_enabled

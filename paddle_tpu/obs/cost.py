@@ -1,0 +1,138 @@
+"""Compiled-program cost telemetry.
+
+The accounting discipline of Pope et al. (2022, "Efficiently Scaling
+Transformer Inference"): a serving number without its FLOPs/bytes
+denominator is not evidence. XLA already knows both for every compiled
+program — ``compiled.cost_analysis()`` (model FLOPs, bytes accessed)
+and ``compiled.memory_analysis()`` (argument/output/temp bytes) — so
+the dispatch wrappers attach them to the owning span and every bench
+record can report tokens/s AND model-FLOPs-utilisation per dispatch.
+
+The analysis is derived ONCE per (site, input-signature) via
+``jitted.lower(...).compile()`` and cached here: the AOT lowering path
+may recompile the program (it does not always share the jit dispatch
+cache), so this is strictly obs-gated, amortized to one extra compile
+per site, and any failure degrades to "no cost attached" — telemetry
+never breaks the dispatch it measures. jax.export-deserialized bundle
+entries expose no analysis hooks; bundle dispatch spans carry timing
+only (documented in README).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["dispatch_cost", "site_costs", "clear_cost_cache",
+           "device_peak_flops", "mfu"]
+
+_CACHE: Dict[Tuple, Optional[dict]] = {}
+_BY_SITE: Dict[str, dict] = {}      # latest successful analysis per site
+_LOCK = threading.Lock()
+
+
+def _sig(args, kwargs) -> Tuple:
+    """Hashable shape/dtype signature of a dispatch's inputs — static
+    kwargs (ints/strs/bools/None) hash as themselves."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return (tuple(x.shape), str(x.dtype))
+        return x
+    flat, _ = jax.tree_util.tree_flatten((args, kwargs))
+    return tuple(leaf(x) for x in flat)
+
+
+def dispatch_cost(site: str, jitted, args=(), kwargs=None
+                  ) -> Optional[dict]:
+    """FLOPs/bytes/peak-bytes record for the program ``jitted`` compiles
+    at these arguments, or ``None`` when the backend can't say. Cached
+    per (site, signature); safe to call per dispatch once obs is on."""
+    kwargs = kwargs or {}
+    try:
+        key = (site, _sig(args, kwargs))
+    except Exception:
+        return None
+    with _LOCK:
+        if key in _CACHE:
+            return _CACHE[key]
+    out: Optional[dict] = None
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        cost = cost or {}
+        out = {}
+        if cost.get("flops", -1) and float(cost.get("flops", -1)) > 0:
+            out["flops"] = float(cost["flops"])
+        ba = cost.get("bytes accessed", cost.get("bytes_accessed"))
+        if ba is not None and float(ba) > 0:
+            out["bytes_accessed"] = float(ba)
+        try:
+            mem = compiled.memory_analysis()
+            for field, k in (("temp_size_in_bytes", "temp_bytes"),
+                             ("argument_size_in_bytes", "argument_bytes"),
+                             ("output_size_in_bytes", "output_bytes")):
+                v = getattr(mem, field, None)
+                if v is not None:
+                    out[k] = int(v)
+            if "temp_bytes" in out:
+                out["peak_bytes"] = (out["temp_bytes"]
+                                     + out.get("output_bytes", 0))
+        except Exception:
+            pass
+        if not out:
+            out = None
+    except Exception:
+        out = None
+    with _LOCK:
+        _CACHE[key] = out
+        if out is not None:
+            _BY_SITE[site] = dict(out)
+    return out
+
+
+def site_costs() -> Dict[str, dict]:
+    """Latest successful cost record per dispatch site — the bench
+    ``obs`` block's per-dispatch FLOPs source."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _BY_SITE.items()}
+
+
+def clear_cost_cache() -> None:
+    with _LOCK:
+        _CACHE.clear()
+        _BY_SITE.clear()
+
+
+def device_peak_flops() -> float:
+    """bf16 peak FLOP/s of device 0 (the BASELINE.md MFU denominators;
+    CPU gets a nominal 1 TF so MFU stays a defined, comparable ratio on
+    the harness)."""
+    import jax
+    try:
+        kind = str(jax.devices()[0].device_kind).lower()
+        platform = jax.devices()[0].platform
+    except Exception:
+        return 1e12
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if platform == "tpu":
+        return 197e12
+    return 1e12
+
+
+def mfu(flops: float, seconds: float,
+        peak: Optional[float] = None) -> float:
+    """Model-FLOPs-utilisation fraction for ``flops`` of work done in
+    ``seconds`` of wall time."""
+    if seconds <= 0 or flops <= 0:
+        return 0.0
+    return flops / seconds / (peak if peak is not None
+                              else device_peak_flops())

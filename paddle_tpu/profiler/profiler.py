@@ -1,4 +1,15 @@
-"""Profiler core (python/paddle/profiler/profiler.py:346 analog)."""
+"""Profiler core (python/paddle/profiler/profiler.py:346 analog).
+
+Since the obs round this module is a THIN FACADE over the unified
+observability spine: ``RecordEvent`` intervals land in a
+``paddle_tpu.obs.Tracer`` (the same thread-safe, monotonic-clock,
+bounded-ring span recorder the decode/serving dispatch sites use)
+instead of a private ring buffer, and additionally mirror into the
+GLOBAL obs tracer whenever ``FLAGS_obs_enabled`` is on — so legacy
+``RecordEvent("train_step")`` scopes show up in the same exported trace
+as dispatch spans and serving timelines. The Profiler lifecycle
+(scheduler states, chrome-trace export, summary tables, XLA device
+trace) is unchanged."""
 
 from __future__ import annotations
 
@@ -29,26 +40,31 @@ class ProfilerTarget(Enum):
 
 
 class _HostEventRecorder:
-    """Ring buffer of host events (host_event_recorder.h analog)."""
+    """Host-event recorder (host_event_recorder.h analog) — a facade
+    over an ``obs.Tracer`` ring buffer gated on the profiler's own
+    recording state, with an obs-gated mirror into the global tracer."""
 
     def __init__(self):
-        self.events: List[dict] = []
-        self._lock = threading.Lock()
         self.enabled = False
+        from paddle_tpu.obs import Tracer
+        self._tracer = Tracer(capacity=65536,
+                              enabled=lambda: self.enabled)
 
     def record(self, name: str, start_ns: int, end_ns: int, tid: int):
         if not self.enabled:
             return
-        with self._lock:
-            self.events.append({"name": name, "ts": start_ns / 1000.0,
-                                "dur": (end_ns - start_ns) / 1000.0,
-                                "ph": "X", "pid": os.getpid(), "tid": tid,
-                                "cat": "host"})
+        self._tracer.add_span(name, start_ns, end_ns)
+        from paddle_tpu.obs import tracer as _global
+        _global.add_span(name, start_ns, end_ns,
+                         source="profiler")   # no-op unless obs is on
 
     def drain(self) -> List[dict]:
-        with self._lock:
-            ev, self.events = self.events, []
-        return ev
+        out = []
+        for s in self._tracer.drain():
+            ev = s.as_chrome()
+            ev["cat"] = "host"
+            out.append(ev)
+        return out
 
 
 _RECORDER = _HostEventRecorder()
@@ -63,11 +79,13 @@ class RecordEvent:
         self._start = None
 
     def begin(self):
-        self._start = time.perf_counter_ns()
+        # monotonic_ns: the obs clock discipline — RecordEvent scopes and
+        # obs dispatch spans share one time axis in a merged trace
+        self._start = time.monotonic_ns()
 
     def end(self):
         if self._start is not None:
-            _RECORDER.record(self.name, self._start, time.perf_counter_ns(),
+            _RECORDER.record(self.name, self._start, time.monotonic_ns(),
                              threading.get_ident() & 0xFFFF)
             self._start = None
 

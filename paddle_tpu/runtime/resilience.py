@@ -109,10 +109,38 @@ _EVENTS: "collections.deque" = collections.deque(maxlen=512)
 _EVENTS_LOCK = threading.Lock()
 
 
+_EVENT_COUNTERS = {"retry": "resilience.retries",
+                   "degradation": "resilience.degradations",
+                   "fault": "resilience.faults_injected"}
+
+
 def record_event(ev) -> None:
-    """Append a typed event to the bounded process-wide resilience log."""
+    """Append a typed event to the bounded process-wide resilience log.
+
+    With obs enabled (paddle_tpu/obs) the event also mirrors into the
+    global metrics registry (``resilience.retries`` /
+    ``resilience.degradations`` / ``resilience.faults_injected``) and
+    lands as an instant event on the trace timeline — ONE wiring point
+    covering every emitter (decode ladder, serving chunk degradation,
+    bundle retries, elastic heartbeats). Telemetry must never break the
+    resilience spine: any obs failure is swallowed here."""
     with _EVENTS_LOCK:
         _EVENTS.append(ev)
+    try:
+        import paddle_tpu.obs as obs
+        if obs.enabled():
+            kind = getattr(ev, "kind", "event")
+            obs.metrics.counter(
+                _EVENT_COUNTERS.get(kind, f"resilience.{kind}"),
+                "typed resilience events by kind").inc()
+            obs.tracer.event(f"resilience.{kind}",
+                             site=getattr(ev, "site", ""),
+                             **{k: v for k, v in ev.as_dict().items()
+                                if k in ("from_level", "to_level",
+                                         "attempt", "error_class",
+                                         "fault")})
+    except Exception:
+        pass
 
 
 def drain_events() -> List[Any]:

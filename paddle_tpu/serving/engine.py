@@ -40,6 +40,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+import paddle_tpu.obs as obs
+from paddle_tpu.obs.metrics import MetricsRegistry
 from paddle_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine"]
@@ -276,15 +278,57 @@ class ServingEngine:
             num_slots, policy=policy,
             prompt_buckets=prompt_buckets or self._b.prompt_buckets)
         self.state = self._b.new_state()
-        self.prefill_dispatches = 0
-        self.chunk_dispatches = 0
-        self.step_dispatches = 0      # per-token degradation rung only
         self._next_id = 0
         self._results: Dict[int, Any] = {}
-        self._occ: List[float] = []
-        self._queue_delays: List[float] = []
-        self._degradations: List[Any] = []
-        self._tokens_emitted = 0
+        # the engine's own always-on metrics registry (paddle_tpu/obs):
+        # replaces the ad-hoc counter ints / delay-and-occupancy lists of
+        # round 9 — same bookkeeping cost, but one typed store feeding
+        # metrics(), the Prometheus export and the bench obs block.
+        # Timeline SPANS (per-request queued->admitted->finished) go to
+        # the global tracer and stay obs-gated.
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self._c_prefill = r.counter(
+            "serving.prefill_dispatches",
+            "admission prefills (exactly one per admitted request)")
+        self._c_chunk = r.counter(
+            "serving.chunk_dispatches",
+            "fused decode_chunk dispatches (one per step with live rows)")
+        self._c_step = r.counter(
+            "serving.step_dispatches",
+            "per-token degradation-rung dispatches")
+        self._c_degr = r.counter("serving.degradations",
+                                 "chunk->per_token degradations")
+        self._c_slot_steps = r.counter(
+            "serving.slot_steps",
+            "slot-steps run (ALL rows compute every chunk step — the "
+            "honest useful-token-occupancy denominator)")
+        self._c_done = r.counter("serving.requests_completed", "")
+        self._h_qdelay = r.histogram(
+            "serving.queue_delay_s", "submit -> admission wait")
+        self._h_latency = r.histogram(
+            "serving.request_latency_s", "submit -> finished")
+        self._h_occ = r.histogram(
+            "serving.occupancy", "occupied-slot fraction per chunk "
+            "dispatch", buckets=[i / 8 for i in range(1, 9)])
+        self._h_qdepth = r.histogram(
+            "serving.queue_depth", "queued requests observed per step",
+            buckets=[0, 1, 2, 4, 8, 16, 32, 64, 128])
+        self._g_qdepth = r.gauge("serving.queue_depth_now", "")
+
+    # legacy counter attributes, now views over the registry (pre-obs
+    # callers and the bench dispatch-accounting asserts read these)
+    @property
+    def prefill_dispatches(self) -> int:
+        return int(self._c_prefill.value)
+
+    @property
+    def chunk_dispatches(self) -> int:
+        return int(self._c_chunk.value)
+
+    @property
+    def step_dispatches(self) -> int:
+        return int(self._c_step.value)
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
@@ -318,6 +362,10 @@ class ServingEngine:
             eos_token_id=_normalize_eos(eos_token_id),
             temperature=float(temperature), seed=int(seed),
             priority=int(priority), submit_time=time.monotonic()))
+        self._g_qdepth.set(len(self.scheduler))
+        obs.tracer.event("serving.request.queued", request=rid,
+                         prompt_len=len(prompt),
+                         max_new_tokens=int(max_new_tokens))
         return rid
 
     # -- the serving loop --------------------------------------------------
@@ -326,12 +374,14 @@ class ServingEngine:
         harvest finished rows. Returns ``[(request_id, result), ...]``
         finished this step (also retrievable via ``result(id)``)."""
         now = time.monotonic()
+        self._h_qdepth.observe(len(self.scheduler))
         for slot_idx, req in self.scheduler.admissions():
             self._admit(slot_idx, req, now)
+        self._g_qdepth.set(len(self.scheduler))
         occupied = self.scheduler.slots.occupied()
         if not occupied:
             return []
-        self._occ.append(len(occupied) / self.num_slots)
+        self._h_occ.observe(len(occupied) / self.num_slots)
         toks = self._dispatch_chunk(occupied)
         finished, freed = [], []
         for i, slot in occupied:
@@ -396,7 +446,7 @@ class ServingEngine:
         ids[0, :S] = req.prompt
         ev0 = self._b.event_count()
         logits1, kc1, vc1 = self._b.admit_prefill(ids, S)
-        self.prefill_dispatches += 1
+        self._c_prefill.inc()
         # the SAME row-key rule as generate(chunk_size=) at B=1: the
         # request's stream is keyed by its seed alone
         key1 = jnp.asarray(jrandom.split(jrandom.PRNGKey(req.seed), 1)[0],
@@ -416,7 +466,10 @@ class ServingEngine:
         slot = self.scheduler.slots.entries[slot_idx]
         slot.admitted_at = now
         slot.events.extend(self._b.events_since(ev0))
-        self._queue_delays.append(now - req.submit_time)
+        self._h_qdelay.observe(now - req.submit_time)
+        obs.tracer.event("serving.request.admitted", request=req.id,
+                         slot=slot_idx,
+                         queue_delay_s=round(now - req.submit_time, 6))
 
     def _dispatch_chunk(self, occupied) -> np.ndarray:
         from paddle_tpu.flags import flags as _flags
@@ -428,8 +481,8 @@ class ServingEngine:
         try:
             toks, self.state = self._b.decode_chunk(self.state,
                                                     self.chunk_size)
-            self.chunk_dispatches += 1
-            self._tokens_emitted += self.num_slots * self.chunk_size
+            self._c_chunk.inc()
+            self._c_slot_steps.inc(self.num_slots * self.chunk_size)
             self._note_events(occupied, ev0, [])
             return np.asarray(toks)
         except Exception as e:
@@ -446,7 +499,7 @@ class ServingEngine:
                 to_level="per_token", error_class=type(e).__name__,
                 error=str(e)[:300])
             record_event(ev)
-            self._degradations.append(ev)
+            self._c_degr.inc()
         # per-token rung: T single-step dispatches on the SAME carry —
         # the failed chunk never consumed it (faults fire before
         # execution; the in-process chunk doesn't donate its inputs), so
@@ -454,9 +507,9 @@ class ServingEngine:
         parts = []
         for _ in range(self.chunk_size):
             toks1, self.state = self._b.decode_step(self.state)
-            self.step_dispatches += 1
+            self._c_step.inc()
             parts.append(np.asarray(toks1))
-        self._tokens_emitted += self.num_slots * self.chunk_size
+        self._c_slot_steps.inc(self.num_slots * self.chunk_size)
         self._note_events(occupied, ev0, [ev])
         return np.concatenate(parts, axis=1)
 
@@ -471,6 +524,10 @@ class ServingEngine:
     def _finish(self, slot, seq: np.ndarray, slot_idx: int):
         from paddle_tpu.runtime.resilience import GenerateResult
         req = slot.request
+        fin = time.monotonic()       # same clock as submit/admit stamps
+        latency = fin - req.submit_time
+        self._h_latency.observe(latency)
+        self._c_done.inc()
         degr = [e for e in slot.events
                 if getattr(e, "kind", "") == "degradation"]
         record = {
@@ -482,21 +539,38 @@ class ServingEngine:
             "events": [e.as_dict() for e in slot.events],
             "serving": {
                 "queue_delay_s": slot.admitted_at - req.submit_time,
+                "latency_s": latency,
                 "chunks": slot.chunks,
                 "slot": slot_idx,
             },
         }
+        # the request's lifetime span (submit -> finished) on the same
+        # monotonic axis as the dispatch spans it contains
+        obs.tracer.add_span(
+            "serving.request", int(req.submit_time * 1e9),
+            int(fin * 1e9), request=req.id, slot=slot_idx,
+            chunks=slot.chunks, tokens=int(seq.shape[0]),
+            queue_delay_s=round(record["serving"]["queue_delay_s"], 6),
+            level=record["level"])
+        obs.tracer.event("serving.request.finished", request=req.id,
+                         latency_s=round(latency, 6))
         out = np.concatenate([req.prompt,
                               seq.astype(req.prompt.dtype)])[None]
         return GenerateResult.wrap(out, record)
 
     # -- observability -----------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
-        """Serving counters: dispatch accounting (prefills = admitted
-        requests; chunks; per-token degradation steps), mean slot
-        occupancy over chunk dispatches, queue-delay stats, and the
-        useful-token fraction (requested tokens / slot-steps run)."""
-        qd = np.asarray(self._queue_delays) if self._queue_delays else None
+        """Serving metrics snapshot, derived from the engine's typed
+        registry (``self.registry`` — counters/histograms a Prometheus
+        endpoint could scrape via ``registry.to_prometheus()``).
+
+        Every pre-obs key is preserved verbatim (dispatch accounting —
+        prefills = admitted requests, chunks, per-token degradation
+        steps; mean slot occupancy over chunk dispatches; queue-delay
+        stats; the slot-steps useful-token denominator). New on top:
+        p50/p99/mean REQUEST latency (submit -> finished, monotonic
+        end-to-end) and queue-depth now/mean/peak snapshots."""
+        qd, lat = self._h_qdelay, self._h_latency
         return {
             "num_slots": self.num_slots,
             "chunk_size": self.chunk_size,
@@ -506,17 +580,19 @@ class ServingEngine:
             "prefill_dispatches": self.prefill_dispatches,
             "chunk_dispatches": self.chunk_dispatches,
             "step_dispatches": self.step_dispatches,
-            "degradations": len(self._degradations),
-            "occupancy_mean": (float(np.mean(self._occ))
-                               if self._occ else 0.0),
-            "occupancy_samples": len(self._occ),
+            "degradations": int(self._c_degr.value),
+            "occupancy_mean": self._h_occ.mean,
+            "occupancy_samples": self._h_occ.count,
             # ALL rows compute every chunk step, occupied or not — the
             # honest denominator for useful-token occupancy comparisons
-            "slot_steps_total": self._tokens_emitted,
-            "queue_delay_mean_s": (float(qd.mean())
-                                   if qd is not None else 0.0),
-            "queue_delay_p50_s": (float(np.percentile(qd, 50))
-                                  if qd is not None else 0.0),
-            "queue_delay_p99_s": (float(np.percentile(qd, 99))
-                                  if qd is not None else 0.0),
+            "slot_steps_total": int(self._c_slot_steps.value),
+            "queue_delay_mean_s": qd.mean,
+            "queue_delay_p50_s": qd.percentile(50),
+            "queue_delay_p99_s": qd.percentile(99),
+            "request_latency_mean_s": lat.mean,
+            "request_latency_p50_s": lat.percentile(50),
+            "request_latency_p99_s": lat.percentile(99),
+            "queue_depth_now": int(self._g_qdepth.value),
+            "queue_depth_peak": int(self._g_qdepth.max),
+            "queue_depth_mean": self._h_qdepth.mean,
         }

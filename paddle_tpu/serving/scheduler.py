@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
+import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -52,7 +53,11 @@ class Request:
     """One queued generate ask. ``eos_token_id`` is already normalized
     (None = decode to the full budget); ``seed`` keys the row's private
     RNG stream; ``priority`` orders admission under the 'priority'
-    policy (lower = sooner), ties broken FIFO."""
+    policy (lower = sooner), ties broken FIFO. ``submit_time`` is a
+    ``time.monotonic()`` stamp — the clock every downstream latency
+    subtraction uses (the same discipline ``distributed/elastic.py``
+    moved to: wall clocks step under NTP and turn latency math into
+    noise); ``Scheduler.push`` stamps it when the caller didn't."""
     id: int
     prompt: np.ndarray            # (S,) token ids
     max_new_tokens: int
@@ -60,7 +65,7 @@ class Request:
     temperature: float = 1.0
     seed: int = 0
     priority: int = 0
-    submit_time: float = 0.0
+    submit_time: float = 0.0      # time.monotonic(); 0.0 = unset
 
 
 @dataclasses.dataclass
@@ -138,6 +143,12 @@ class Scheduler:
         return bucket_length(prompt_len, self.prompt_buckets)
 
     def push(self, request: Request) -> None:
+        if not request.submit_time:
+            # stamp here, on the monotonic clock, so queue-delay math is
+            # sane even for requests built without going through
+            # ServingEngine.submit (a 0.0 default subtracted from a
+            # monotonic 'now' reported hours of queue delay)
+            request.submit_time = time.monotonic()
         pr = request.priority if self.policy == "priority" else 0
         heapq.heappush(self._heap, (pr, next(self._seq), request))
 

@@ -1,0 +1,348 @@
+"""Unified observability spine (paddle_tpu/obs).
+
+The load-bearing properties:
+- span nesting, attrs and both exporters round-trip (Chrome JSON loads
+  back with the right events; JSONL lines rebuild the spans);
+- the metrics registry snapshot + Prometheus text have the contracted
+  shape (cumulative buckets, sum/count, get-or-create identity);
+- serving timeline completeness: EVERY submitted request shows
+  queued -> admitted -> finished events plus a lifetime span, and the
+  trace's dispatch-span counts equal the engine's asserted accounting;
+- compiled-program cost telemetry attaches FLOPs/bytes to the owning
+  jitted-dispatch span (cached per site/signature);
+- the DISABLED path adds no measurable per-call work (the near-zero
+  overhead contract that lets the instrumentation live on hot paths);
+- serving latency math is time.monotonic end-to-end (a scheduler-level
+  push stamps the submit time itself).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.obs as obs
+from paddle_tpu.flags import set_flags
+from paddle_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, max_position_embeddings=64)
+
+
+@pytest.fixture()
+def obs_on():
+    set_flags({"obs_enabled": True})
+    mark = obs.tracer.mark()
+    try:
+        yield mark
+    finally:
+        set_flags({"obs_enabled": False})
+
+
+@pytest.fixture(scope="module")
+def dec():
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    return LlamaDecoder(LlamaForCausalLM(LlamaConfig(**CFG)), max_len=64)
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_span_nesting_and_export_roundtrip(obs_on, tmp_path):
+    m0 = obs_on
+    with obs.span("outer", site="t"):
+        with obs.span("inner") as sp:
+            sp.annotate(flops=42.0)
+            time.sleep(0.002)
+    obs.tracer.event("phase.mark", request=7)
+    spans = {s.name: s for s in obs.tracer.spans_since(m0)}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["inner"].attrs["flops"] == 42.0
+    assert spans["inner"].dur_ms >= 2.0
+    assert spans["outer"].dur_ms >= spans["inner"].dur_ms
+    assert spans["inner"].start_ns >= spans["outer"].start_ns
+
+    chrome = tmp_path / "t.json"
+    obs.tracer.export_chrome_trace(str(chrome), since=m0)
+    data = json.loads(chrome.read_text())
+    by_name = {e["name"]: e for e in data["traceEvents"]}
+    assert by_name["inner"]["ph"] == "X"
+    assert by_name["inner"]["args"]["flops"] == 42.0
+    assert by_name["phase.mark"]["ph"] == "i"
+    assert by_name["inner"]["dur"] == pytest.approx(
+        spans["inner"].dur_ms * 1e3)
+
+    jsonl = tmp_path / "t.jsonl"
+    obs.tracer.export_jsonl(str(jsonl), since=m0)
+    lines = [json.loads(x) for x in jsonl.read_text().splitlines()]
+    assert {d["name"] for d in lines} == {"outer", "inner", "phase.mark"}
+    inner = next(d for d in lines if d["name"] == "inner")
+    assert inner["attrs"]["flops"] == 42.0
+    assert inner["parent_id"] == spans["outer"].span_id
+
+
+def test_span_error_excluded_from_ok_counts(obs_on):
+    m0 = obs_on
+    with pytest.raises(RuntimeError):
+        with obs.span("boom"):
+            raise RuntimeError("UNAVAILABLE: nope")
+    [sp] = obs.tracer.spans_since(m0)
+    assert not sp.ok() and "UNAVAILABLE" in sp.attrs["error"]
+    assert obs.tracer.counts(m0) == {}
+    assert obs.tracer.counts(m0, ok_only=False) == {"boom": 1}
+
+
+def test_tracer_ring_buffer_bounds(obs_on):
+    t = obs.Tracer(capacity=8, enabled=lambda: True)
+    for i in range(20):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.spans()) == 8
+    assert t.dropped == 12
+    assert [s.name for s in t.spans()][-1] == "s19"
+
+
+def test_disabled_path_near_zero_overhead():
+    """The contract that lets span() live inside dispatch wrappers: obs
+    off, a span call is one enabled check + a shared no-op context —
+    bounded per-call cost, no recording, no allocation growth."""
+    set_flags({"obs_enabled": False})
+    assert not obs.enabled()
+    n = 20000
+    # warm both paths
+    for _ in range(100):
+        with obs.span("x"):
+            pass
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pass
+    base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs.span("x"):
+            pass
+    spent = time.perf_counter() - t0
+    per_call = (spent - base) / n
+    assert per_call < 20e-6, f"disabled span() costs {per_call*1e6:.2f}µs"
+    assert obs.tracer.spans() is not None  # and recorded nothing new
+    m = obs.tracer.mark()
+    with obs.span("x"):
+        pass
+    assert obs.tracer.spans_since(m) == []
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_metrics_registry_shapes_and_prometheus():
+    r = MetricsRegistry()
+    c = r.counter("decode.dispatches", "help text")
+    c.inc()
+    c.inc(2)
+    assert r.counter("decode.dispatches") is c     # get-or-create
+    with pytest.raises(TypeError):
+        r.gauge("decode.dispatches")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("queue.depth")
+    g.set(5)
+    g.set(2)
+    h = r.histogram("lat_s", buckets=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.05, 0.5, 2.0):
+        h.observe(v)
+
+    snap = r.snapshot()
+    assert snap["decode.dispatches"] == {"type": "counter", "value": 3}
+    assert snap["queue.depth"]["value"] == 2 and \
+        snap["queue.depth"]["max"] == 5
+    hs = snap["lat_s"]
+    assert hs["count"] == 4 and hs["sum"] == pytest.approx(2.555)
+    # cumulative prometheus buckets + +Inf tail
+    assert hs["buckets"] == {"0.01": 1, "0.1": 2, "1.0": 3, "+Inf": 4}
+    assert hs["p50"] == pytest.approx(h.percentile(50))
+
+    txt = r.to_prometheus()
+    assert "# TYPE decode_dispatches counter" in txt
+    assert "decode_dispatches 3" in txt
+    assert "# HELP decode_dispatches help text" in txt
+    assert '# TYPE lat_s histogram' in txt
+    assert 'lat_s_bucket{le="+Inf"} 4' in txt
+    assert "lat_s_count 4" in txt
+    assert "lat_s_sum 2.555" in txt
+
+
+def test_histogram_percentiles():
+    h = MetricsRegistry().histogram("h", buckets=[1.0])
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(50) == pytest.approx(50.5)
+    assert h.percentile(99) == pytest.approx(99.01)
+    assert h.mean == pytest.approx(50.5)
+
+
+# -- cost telemetry ----------------------------------------------------------
+
+def test_cost_analysis_attaches_to_jitted_dispatch(obs_on, dec):
+    """A generate under obs: the prefill/fused dispatch spans carry the
+    compiled program's FLOPs (cost_analysis) — the per-dispatch MFU
+    numerator — and the obs dispatch counters match dispatch_count."""
+    m0 = obs.tracer.mark()
+    d0 = dec.dispatch_count
+    c0 = {name: obs.metrics.counter(name).value
+          for name in ("dispatches.decode.prefill",
+                       "dispatches.decode.fused")}
+    prompt = np.arange(4)[None] % 64
+    dec.generate(prompt, max_new_tokens=6)
+    counts = obs.tracer.counts(m0)
+    assert counts == {"decode.prefill": 1, "decode.fused": 1}
+    assert dec.dispatch_count - d0 == 2           # fused generate = prefill+1
+    for name in c0:
+        assert obs.metrics.counter(name).value - c0[name] == 1
+    spans = {s.name: s for s in obs.tracer.spans_since(m0)}
+    cost = obs.site_costs()
+    if "decode.fused" not in cost:      # backend without cost_analysis
+        pytest.skip("cost_analysis unavailable on this backend")
+    assert spans["decode.fused"].attrs["flops"] > 0
+    assert spans["decode.prefill"].attrs["flops"] > 0
+    assert cost["decode.fused"]["flops"] == \
+        spans["decode.fused"].attrs["flops"]
+    assert obs.mfu(cost["decode.fused"]["flops"], 0.001, peak=1e12) > 0
+
+
+def test_dispatch_cost_cached_per_signature():
+    import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x @ x)
+    a = jnp.ones((16, 16))
+    c1 = obs.dispatch_cost("t.sig", f, (a,), {})
+    if c1 is None:
+        pytest.skip("cost_analysis unavailable on this backend")
+    assert c1["flops"] > 0
+    assert obs.dispatch_cost("t.sig", f, (a,), {}) == c1   # cache hit
+    c2 = obs.dispatch_cost("t.sig", f, (jnp.ones((32, 32)),), {})
+    assert c2["flops"] > c1["flops"]               # new signature, new entry
+
+
+# -- serving timeline --------------------------------------------------------
+
+def test_serving_timeline_complete_and_accounted(obs_on, dec):
+    """Every submitted request has queued -> admitted -> finished events
+    and a lifetime span; dispatch-span counts equal the engine's
+    asserted accounting (one prefill per admitted request + one span per
+    chunk); metrics() grows the p50/p99 latency + queue-depth keys while
+    keeping every legacy key."""
+    from paddle_tpu.serving import ServingEngine
+    m0 = obs.tracer.mark()
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    rng = np.random.default_rng(11)
+    ids = [eng.submit(rng.integers(0, 64, (int(rng.integers(2, 8)),)),
+                      int(rng.integers(2, 9)), seed=i) for i in range(5)]
+    res = eng.drain()
+    assert sorted(res) == ids
+    m = eng.metrics()
+    counts = obs.tracer.counts(m0)
+    assert counts["decode.admit_prefill"] == m["prefill_dispatches"] \
+        == len(ids)
+    assert counts["decode.chunk"] == m["chunk_dispatches"]
+    assert counts["serving.request"] == len(ids)
+    events = [s for s in obs.tracer.spans_since(m0) if s.kind == "event"]
+    for rid in ids:
+        for phase in ("queued", "admitted", "finished"):
+            assert any(e.name == f"serving.request.{phase}"
+                       and e.attrs.get("request") == rid
+                       for e in events), (rid, phase)
+    # lifetime spans carry the serving attrs trace_report tabulates
+    req_spans = [s for s in obs.tracer.spans_since(m0)
+                 if s.name == "serving.request"]
+    assert {s.attrs["request"] for s in req_spans} == set(ids)
+    assert all(s.attrs["chunks"] >= 1 and s.attrs["queue_delay_s"] >= 0
+               for s in req_spans)
+
+    legacy = {"num_slots", "chunk_size", "requests_submitted",
+              "requests_completed", "queued", "prefill_dispatches",
+              "chunk_dispatches", "step_dispatches", "degradations",
+              "occupancy_mean", "occupancy_samples", "slot_steps_total",
+              "queue_delay_mean_s", "queue_delay_p50_s",
+              "queue_delay_p99_s"}
+    assert legacy <= set(m)                      # compatibility shim
+    assert m["request_latency_p50_s"] > 0
+    assert m["request_latency_p99_s"] >= m["request_latency_p50_s"]
+    assert m["request_latency_mean_s"] > 0
+    assert m["queue_depth_peak"] >= 0 and m["queue_depth_now"] == 0
+    for rid in ids:
+        rec = res[rid].resilience["serving"]
+        assert rec["latency_s"] >= rec["queue_delay_s"] >= 0.0
+        assert rec["latency_s"] < 600.0          # monotonic, not epoch math
+    # the engine's registry speaks Prometheus
+    txt = eng.registry.to_prometheus()
+    assert f"serving_prefill_dispatches {len(ids)}" in txt
+    assert "serving_request_latency_s_count 5" in txt
+
+
+def test_trace_report_renders_serving_trace(obs_on, dec, tmp_path):
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    from paddle_tpu.serving import ServingEngine
+    m0 = obs.tracer.mark()
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    for i in range(3):
+        eng.submit(np.arange(3 + i) % 64, 4, seed=i)
+    eng.drain()
+    path = tmp_path / "trace.json"
+    obs.tracer.export_chrome_trace(str(path), since=m0)
+    assert trace_report.main([str(path)]) == 0
+    spans, events = trace_report._load(str(path))
+    rows, completeness = trace_report.request_table(spans, events)
+    assert len(rows) == 3 and completeness["incomplete"] == []
+    phases = {r["phase"] for r in trace_report.phase_table(spans)}
+    assert {"decode.admit_prefill", "decode.chunk",
+            "serving.request"} <= phases
+    assert trace_report.main([str(tmp_path / "missing.json")]) == 1
+
+
+# -- resilience mirror -------------------------------------------------------
+
+def test_resilience_events_mirror_into_obs_counters(obs_on, dec):
+    from paddle_tpu.runtime.resilience import fault_injector
+    r0 = obs.metrics.counter("resilience.retries").value
+    set_flags({"resilience_backoff_s": 0.0})
+    fault_injector.configure([{"kind": "dispatch_error",
+                               "site": "decode.fused", "call": 1}])
+    try:
+        dec.generate(np.arange(4)[None] % 64, max_new_tokens=4)
+    finally:
+        fault_injector.clear()
+        set_flags({"resilience_backoff_s": 0.5})
+    assert obs.metrics.counter("resilience.retries").value == r0 + 1
+    ev = [s for s in obs.tracer.spans()
+          if s.kind == "event" and s.name == "resilience.retry"]
+    assert ev and ev[-1].attrs["site"] == "decode.fused"
+
+
+# -- monotonic accounting (the scheduler-level satellite) --------------------
+
+def test_scheduler_push_stamps_monotonic_submit_time():
+    from paddle_tpu.serving import Request, Scheduler
+    sch = Scheduler(num_slots=1)
+    t0 = time.monotonic()
+    sch.push(Request(id=0, prompt=np.arange(3), max_new_tokens=2))
+    [(slot, req)] = sch.admissions()
+    # stamped at push, on the monotonic clock: a queue delay computed
+    # against monotonic 'now' is microseconds, not hours
+    assert t0 <= req.submit_time <= time.monotonic()
+    sch.slots.release(slot)
+    explicit = Request(id=1, prompt=np.arange(3), max_new_tokens=2,
+                       submit_time=12345.0)
+    sch.push(explicit)
+    [(_, req2)] = sch.admissions()
+    assert req2.submit_time == 12345.0            # caller stamp respected

@@ -18,6 +18,13 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      against the decode + checkpoint + bundle + elastic paths — recover
      bit-exact or fail typed; the round's robustness gate ON HARDWARE
      (the same sweep runs on CPU in CI)
+  7. decode_obs (`PADDLE_TPU_OBS=1 bench.py --decode --steps 2`): the
+     observability smoke pass — dispatch-span counts asserted against
+     the dispatch accounting inside the bench, per-dispatch FLOPs/MFU
+     in the record's obs block, obs_trace_decode.json exported
+  8. trace_report (tools/trace_report.py obs_trace_decode.json): renders
+     step 7's trace into per-phase tables; rc=1 on an empty/unloadable
+     trace, so a silently-broken exporter fails the roundtail
 
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
@@ -32,24 +39,30 @@ import time
 
 STEPS = [
     ("unet_profile", [sys.executable, "bench.py", "--config", "unet",
-                      "--profile"]),
-    ("llama", [sys.executable, "bench.py"]),
+                      "--profile"], None),
+    ("llama", [sys.executable, "bench.py"], None),
     ("decode1b_served", [sys.executable, "bench.py", "--config",
-                         "decode1b_served"]),
-    ("decode_modes", [sys.executable, "bench.py", "--decode"]),
-    ("serve", [sys.executable, "bench.py", "--serve"]),
-    ("fault_matrix", [sys.executable, "tools/fault_matrix.py"]),
+                         "decode1b_served"], None),
+    ("decode_modes", [sys.executable, "bench.py", "--decode"], None),
+    ("serve", [sys.executable, "bench.py", "--serve"], None),
+    ("fault_matrix", [sys.executable, "tools/fault_matrix.py"], None),
+    ("decode_obs", [sys.executable, "bench.py", "--decode", "--steps",
+                    "2"], {"PADDLE_TPU_OBS": "1"}),
+    ("trace_report", [sys.executable, "tools/trace_report.py",
+                      "obs_trace_decode.json", "--json"], None),
 ]
 
 
 def main():
     os.makedirs("/tmp/roundtail", exist_ok=True)
     results = {}
-    for name, cmd in STEPS:
+    for name, cmd, env_extra in STEPS:
         t0 = time.time()
         log = f"/tmp/roundtail/{name}.log"
+        env = dict(os.environ, **env_extra) if env_extra else None
         with open(log, "w") as f:
-            rc = subprocess.call(cmd, stdout=f, stderr=subprocess.STDOUT)
+            rc = subprocess.call(cmd, stdout=f, stderr=subprocess.STDOUT,
+                                 env=env)
         results[name] = (rc, round(time.time() - t0, 1))
         tail = open(log).read().strip().splitlines()[-3:]
         print(f"== {name}: rc={rc} {results[name][1]}s")
