@@ -184,6 +184,40 @@ def _obs_finish(mark, trace_name, **extra):
     return block
 
 
+def _obs_device_session():
+    """Start a device-time attribution capture (jax.profiler merged
+    trace, obs/device.py) when BOTH obs and the device-trace evidence
+    mode (PADDLE_TPU_OBS_DEVICE=1 / FLAGS_obs_device_trace) are on;
+    None otherwise. Call ``.stop()`` BEFORE _obs_finish so the exported
+    trace's spans carry the merged device_ms attrs."""
+    import paddle_tpu.obs as obs
+    if not (obs.enabled() and obs.device_trace_enabled()):
+        return None
+    sess = obs.DeviceTraceSession().start()
+    return sess if sess.active else None
+
+
+def _obs_device_block(summary):
+    """The bench record's ``obs.device`` block: the session summary
+    (per-site measured device_ms + the attribution-coverage check) with
+    MEASURED MFU per site — the site's cost-model FLOPs over its
+    measured device seconds — next to the host-wall cost-model MFU the
+    records already carry."""
+    import paddle_tpu.obs as obs
+    if not summary or not summary.get("active"):
+        return summary
+    costs = obs.site_costs()
+    peak = obs.device_peak_flops()
+    for site, agg in summary.get("by_site", {}).items():
+        c = costs.get(site)
+        if c and c.get("flops") and agg["device_ms"] > 0:
+            agg["flops_per_dispatch"] = c["flops"]
+            agg["mfu_measured"] = round(obs.mfu(
+                c["flops"] * agg["spans"], agg["device_ms"] / 1e3,
+                peak=peak), 6)
+    return summary
+
+
 def _emit(metric: str, value: float, unit: str) -> dict:
     vs = None
     try:
@@ -862,6 +896,7 @@ def bench_decode_modes(steps=None):
              ("spec_sampled", {"do_sample": True, "temperature": 0.8,
                                "top_k": 40, "seed": 0, **spec_kw})]
     run_mark = _obs_mark()        # the whole-run trace export window
+    dev_sess = _obs_device_session()   # PADDLE_TPU_OBS_DEVICE=1 evidence
     rows = {}
     for B in batches:
         prompt = rng.integers(0, cfg.vocab_size, (B, prompt_len))
@@ -914,7 +949,12 @@ def bench_decode_modes(steps=None):
                       "new_tokens": n_new, "reps": reps,
                       "speculative": {"draft": spec_draft, "k": spec_k},
                       "modes": rows}
+    # merge measured device time onto the spans BEFORE the export, so
+    # the trace artifact (and trace_report's device columns) carry it
+    dev_summary = dev_sess.stop() if dev_sess is not None else None
     line["obs"] = _obs_finish(run_mark, "obs_trace_decode.json")
+    if dev_summary is not None:
+        line["obs"]["device"] = _obs_device_block(dev_summary)
     # re-print the enriched record as the LAST stdout line (the driver
     # parses the final json line; _emit already printed the bare metric)
     print(json.dumps(line))
@@ -948,9 +988,21 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
     import numpy as np
 
     import jax
+    import paddle_tpu.obs as obs
     from paddle_tpu.inference.generate import LlamaDecoder
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.serving import ServingEngine
+
+    # live telemetry plane (FLAGS_obs_export_port / PADDLE_TPU_OBS_PORT):
+    # started BEFORE the model build so a prober can scrape /metrics and
+    # /statusz through the whole run, warmup included; the continuous
+    # engine attaches once it exists
+    exporter = None
+    if obs.resolve_export_port():
+        exporter = obs.ObsExporter()
+        exporter.start()
+        print(f"serve: obs exporter on 127.0.0.1:{exporter.port} "
+              f"(/metrics /statusz /tracez)", file=sys.stderr)
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
@@ -996,8 +1048,11 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
 
     # -- continuous ---------------------------------------------------------
     eng = ServingEngine(dec, num_slots=slots, chunk_size=chunk)
+    if exporter is not None:
+        exporter.add_engine(eng)
     d0 = dec.dispatch_count
     wm = _obs_mark()    # obs window covers EXACTLY the continuous section
+    dev_sess = _obs_device_session()   # device-time attribution capture
     finish = {}
     submitted = 0
     t0 = time.perf_counter()
@@ -1015,6 +1070,9 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
         for rid, res in eng.step():
             finish[rid] = (time.perf_counter() - t0, res)
     cont_wall = time.perf_counter() - t0
+    # stop + merge BEFORE the trace export below, so the exported spans
+    # carry device_ms and the record can report measured MFU
+    dev_summary = dev_sess.stop() if dev_sess is not None else None
     m = eng.metrics()
     disp_cont = dec.dispatch_count - d0
     lat = np.asarray([finish[i][0] - arrivals[i] for i in range(n_req)])
@@ -1056,9 +1114,14 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
                                 window=w,
                                 engine_metrics_prometheus=eng.registry
                                 .to_prometheus())
+        if dev_summary is not None:
+            obs_block["device"] = _obs_device_block(dev_summary)
     cont["request_latency_p50_s"] = round(m["request_latency_p50_s"], 4)
     cont["request_latency_p99_s"] = round(m["request_latency_p99_s"], 4)
     cont["queue_depth_peak"] = m["queue_depth_peak"]
+    cont["ttft_p50_s"] = round(m["ttft_p50_s"], 4)
+    cont["ttft_p99_s"] = round(m["ttft_p99_s"], 4)
+    cont["tpot_mean_s"] = round(m["tpot_mean_s"], 5)
     for i in range(n_req):
         solo = np.asarray(dec.generate(prompts[i][None], int(lens[i])))
         got = np.asarray(finish[i][1])
@@ -1125,9 +1188,13 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
             > static["occupancy_useful"]),
     }
     line["obs"] = obs_block
+    if exporter is not None:
+        line["obs_export_port"] = exporter.port
     # re-print the enriched record as the LAST stdout line (the driver
     # parses the final json line; _emit already printed the bare metric)
     print(json.dumps(line))
+    if exporter is not None:
+        exporter.stop()          # release the port before returning
     return line
 
 

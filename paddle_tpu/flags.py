@@ -236,6 +236,33 @@ define_flag("obs_enabled", False,
 define_flag("obs_buffer_size", 8192,
             "ring-buffer capacity (spans) of the global obs tracer; the "
             "newest spans win and Tracer.dropped counts evictions")
+define_flag("obs_export_port", 0,
+            "TCP port for the live telemetry exporter (obs/exporter.py): "
+            "/metrics (Prometheus text), /statusz (JSON status), /tracez "
+            "(recent completed spans). 0 (default) = no exporter; the "
+            "PADDLE_TPU_OBS_PORT environment variable is an equivalent "
+            "switch. ServingEngine.start_exporter() and bench.py --serve "
+            "honor it")
+define_flag("obs_device_trace", False,
+            "wrap obs evidence windows in a jax.profiler trace capture "
+            "and merge measured device-op durations back onto the owning "
+            "dispatch spans (device_ms / device_occupancy attrs, "
+            "measured MFU next to the cost-model MFU in bench records); "
+            "the PADDLE_TPU_OBS_DEVICE=1 environment variable is an "
+            "equivalent switch. Costs one profiler session per window — "
+            "strictly an evidence mode, never on the default hot path")
+define_flag("obs_flight_recorder", True,
+            "on DecodeFailedError / an exhausted degradation ladder, "
+            "atomically dump the last FLAGS_obs_flight_spans spans + the "
+            "resilience timeline + a metrics snapshot to a postmortem "
+            "JSON (obs/flight.py) so a dead run stays debuggable; only "
+            "active while obs is enabled")
+define_flag("obs_flight_spans", 256,
+            "how many of the newest tracer spans a flight-recorder "
+            "postmortem dump carries")
+define_flag("obs_flight_dir", "",
+            "directory for flight-recorder postmortem dumps (empty = "
+            "current working directory)")
 define_flag("obs_cost_analysis", True,
             "attach XLA cost_analysis/memory_analysis records "
             "(FLOPs, bytes, peak bytes) to dispatch spans; derived once "

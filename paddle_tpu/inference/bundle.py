@@ -840,6 +840,12 @@ class AotPredictor:
                     raise
                 if (li == len(ladder) - 1
                         or not _flags.resilience_auto_degrade):
+                    import paddle_tpu.obs as obs
+                    obs.record_crash(
+                        "bundle.ladder_exhausted", error=e,
+                        extra={"site": "bundle.generate",
+                               "failed_level": name,
+                               "bundle_dir": self._dir})
                     raise DecodeFailedError(
                         f"bundle decode failed at ladder level {name!r} "
                         f"with no further fallback: {str(e)[:300]}",

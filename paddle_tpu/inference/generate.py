@@ -971,6 +971,16 @@ class LlamaDecoder:
                     raise     # fatal (programming/capacity error): as-is
                 if (li == len(ladder) - 1
                         or not _flags.resilience_auto_degrade):
+                    # the ladder is exhausted and the caller may die on
+                    # this: dump the crash flight recorder (last spans +
+                    # resilience timeline + metrics) BEFORE raising
+                    import paddle_tpu.obs as obs
+                    obs.record_crash(
+                        "decode.ladder_exhausted", error=e,
+                        extra={"site": "decode.generate",
+                               "failed_level": name,
+                               "degradations": [d.as_dict()
+                                                for d in degradations]})
                     raise DecodeFailedError(
                         f"decode failed at ladder level {name!r} with no "
                         f"further fallback: {str(e)[:300]}",

@@ -12,7 +12,18 @@ IO and bench:
 - :mod:`~paddle_tpu.obs.cost` — compiled-program cost telemetry:
   ``cost_analysis()`` FLOPs/bytes and ``memory_analysis()`` peak bytes
   attached to the owning dispatch span, so every bench can report
-  tokens/s AND MFU per dispatch (Pope et al., 2211.05102 discipline).
+  tokens/s AND MFU per dispatch (Pope et al., 2211.05102 discipline);
+- :mod:`~paddle_tpu.obs.device` — device-time attribution: a
+  ``jax.profiler`` capture merged back onto the owning spans
+  (``device_ms`` / ``device_occupancy`` attrs, measured MFU, an
+  attribution-coverage check);
+- :mod:`~paddle_tpu.obs.exporter` — the live telemetry plane:
+  ``/metrics`` (Prometheus), ``/statusz`` (JSON status), ``/tracez``
+  (recent spans) on a stdlib HTTP thread
+  (``FLAGS_obs_export_port`` / ``PADDLE_TPU_OBS_PORT``);
+- :mod:`~paddle_tpu.obs.flight` — the crash flight recorder: last-N
+  spans + resilience timeline + metrics snapshot dumped to a
+  postmortem JSON when the decode ladder exhausts.
 
 Disabled by default: enable with ``FLAGS_obs_enabled=1`` /
 ``set_flags({"obs_enabled": True})`` / ``PADDLE_TPU_OBS=1``. The
@@ -22,7 +33,7 @@ trace into per-phase / per-request summary tables.
 """
 
 from paddle_tpu.obs.trace import (  # noqa: F401
-    Span, Tracer, obs_enabled, span, tracer,
+    Span, Tracer, obs_enabled, set_span_hook, span, tracer,
 )
 from paddle_tpu.obs.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, metrics,
@@ -30,12 +41,24 @@ from paddle_tpu.obs.metrics import (  # noqa: F401
 from paddle_tpu.obs.cost import (  # noqa: F401
     clear_cost_cache, device_peak_flops, dispatch_cost, mfu, site_costs,
 )
+from paddle_tpu.obs.device import (  # noqa: F401
+    DeviceTraceSession, device_trace_enabled,
+)
+from paddle_tpu.obs.exporter import (  # noqa: F401
+    ObsExporter, resolve_export_port,
+)
+from paddle_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder, flight_recorder, record_crash,
+)
 
 __all__ = [
-    "Span", "Tracer", "tracer", "span", "obs_enabled",
+    "Span", "Tracer", "tracer", "span", "obs_enabled", "set_span_hook",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
     "dispatch_cost", "site_costs", "clear_cost_cache",
     "device_peak_flops", "mfu",
+    "DeviceTraceSession", "device_trace_enabled",
+    "ObsExporter", "resolve_export_port",
+    "FlightRecorder", "flight_recorder", "record_crash",
     "enabled",
 ]
 

@@ -1,0 +1,273 @@
+"""Live telemetry plane: /metrics, /statusz, /tracez over stdlib HTTP.
+
+The obs spine's pull surface — what turns the tracer ring and metrics
+registries from post-hoc trace files into something a running serving
+process exposes, the deferred ROADMAP rung ("the /metrics endpoint over
+MetricsRegistry.to_prometheus()"):
+
+- ``/metrics`` — Prometheus text exposition 0.0.4: the process-global
+  obs registry plus every attached registry (a ServingEngine's private
+  registry attaches under its name). Telemetry saturation is exported
+  first-class: the tracer's ring-buffer drop count syncs into the
+  ``obs.tracer.dropped_spans`` gauge on every scrape, and histograms
+  carry ``_samples_dropped`` lines — silent span/sample loss is a
+  metric, not a mystery.
+- ``/statusz`` — one JSON document: process/build info, backend, obs
+  switches, and every attached status provider (the engine contributes
+  its slot table, occupancy, queue depth, in-flight requests and
+  resilience-ladder rung). NaN/Inf are sanitized to null — strict JSON
+  for dashboards.
+- ``/tracez`` — the newest completed spans from the tracer ring as JSON
+  (``?limit=N``, default 256, plus the drop count), the "what just
+  happened" debugging view.
+
+One daemon ``ThreadingHTTPServer`` thread; ``start()`` binds (port 0 =
+ephemeral, the test mode) and returns the actual port, ``stop()`` shuts
+the server down and releases it. Wired from ``ServingEngine.start_exporter``
+and ``bench.py --serve`` via ``FLAGS_obs_export_port`` /
+``PADDLE_TPU_OBS_PORT``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from paddle_tpu.obs.metrics import metrics as _global_metrics
+from paddle_tpu.obs.trace import obs_enabled as _obs_enabled
+from paddle_tpu.obs.trace import tracer as _tracer
+
+__all__ = ["ObsExporter", "resolve_export_port", "json_safe"]
+
+_START_MONOTONIC = time.monotonic()
+
+
+def resolve_export_port() -> int:
+    """The configured exporter port: ``FLAGS_obs_export_port``, else the
+    ``PADDLE_TPU_OBS_PORT`` environment variable, else 0 (= no
+    exporter)."""
+    try:
+        from paddle_tpu.flags import flags
+        p = int(flags.obs_export_port)
+        if p:
+            return p
+    except Exception:
+        pass
+    try:
+        return int(os.environ.get("PADDLE_TPU_OBS_PORT", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def json_safe(obj: Any) -> Any:
+    """Recursively replace NaN/Inf floats with None: /statusz and
+    /tracez promise STRICT JSON (Python's json.dumps would happily emit
+    the non-standard ``NaN`` literal and break consumers)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+def _backend_info() -> dict:
+    try:
+        import jax
+        devs = jax.devices()
+        return {"platform": devs[0].platform if devs else None,
+                "device_kind": str(devs[0].device_kind) if devs else None,
+                "device_count": len(devs)}
+    except Exception as e:
+        return {"platform": None, "error": str(e)[:200]}
+
+
+class ObsExporter:
+    """The start/stoppable telemetry endpoint bundle."""
+
+    def __init__(self, port: Optional[int] = None,
+                 host: str = "127.0.0.1"):
+        self._port = resolve_export_port() if port is None else int(port)
+        self._host = host
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._registries: Dict[str, Any] = {}
+        self._status: Dict[str, Callable[[], dict]] = {}
+
+    # -- composition --------------------------------------------------------
+    def add_registry(self, name: str, registry) -> "ObsExporter":
+        """Attach a MetricsRegistry whose instruments join the /metrics
+        scrape (after the process-global registry)."""
+        with self._lock:
+            self._registries[name] = registry
+        return self
+
+    def add_status_provider(self, name: str,
+                            fn: Callable[[], dict]) -> "ObsExporter":
+        """Attach a callable whose dict lands under ``name`` in
+        /statusz. Provider errors are reported in-band, never a 500."""
+        with self._lock:
+            self._status[name] = fn
+        return self
+
+    def add_engine(self, engine, name: str = "serving") -> "ObsExporter":
+        """Attach a ServingEngine: its private registry joins /metrics
+        and its live status (slot table, queue, occupancy, ladder rung)
+        joins /statusz. Held by weakref — an exporter never keeps a
+        dead engine (and its device carry) alive."""
+        ref = weakref.ref(engine)
+        self.add_registry(name, engine.registry)
+
+        def status():
+            eng = ref()
+            if eng is None:
+                return {"gone": True}
+            return eng.status()
+        return self.add_status_provider(name, status)
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def running(self) -> bool:
+        return self._server is not None
+
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the actual port
+        (meaningful with port 0). Idempotent while running."""
+        if self._server is not None:
+            return self._port
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet: telemetry, not access logs
+                pass
+
+            def do_GET(self):
+                try:
+                    exporter._handle(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    try:
+                        self.send_error(500, str(e)[:200])
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((self._host, self._port),
+                                           Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="obs-exporter",
+            daemon=True)
+        self._thread.start()
+        return self._port
+
+    def stop(self) -> None:
+        """Shut down and release the port (join bounded — stop() must
+        never hang a drain path)."""
+        server, thread = self._server, self._thread
+        self._server = self._thread = None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- request handling ---------------------------------------------------
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        url = urlparse(req.path)
+        if url.path == "/metrics":
+            body = self.metrics_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif url.path == "/statusz":
+            body = json.dumps(json_safe(self.statusz()), indent=1,
+                              default=str).encode()
+            ctype = "application/json"
+        elif url.path == "/tracez":
+            q = parse_qs(url.query)
+            try:
+                limit = int(q.get("limit", ["256"])[0])
+            except ValueError:
+                limit = 256
+            body = json.dumps(json_safe(self.tracez(limit)),
+                              default=str).encode()
+            ctype = "application/json"
+        else:
+            req.send_error(
+                404, "unknown path (serving /metrics /statusz /tracez)")
+            return
+        req.send_response(200)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    # -- payload builders (public: tests and bench reuse them) --------------
+    def metrics_text(self) -> str:
+        # saturation sync: the ring's drop counter becomes a scrapeable
+        # gauge the moment anyone looks
+        _global_metrics.gauge(
+            "obs.tracer.dropped_spans",
+            "spans evicted from the tracer ring buffer (telemetry "
+            "saturation — raise FLAGS_obs_buffer_size if nonzero)"
+        ).set(_tracer.dropped)
+        parts = [_global_metrics.to_prometheus()]
+        with self._lock:
+            regs = list(self._registries.items())
+        for _, reg in regs:
+            try:
+                parts.append(reg.to_prometheus())
+            except Exception:
+                pass
+        return "".join(p for p in parts if p)
+
+    def statusz(self) -> dict:
+        out = {
+            "pid": os.getpid(),
+            "time_unix": time.time(),
+            "uptime_s": round(time.monotonic() - _START_MONOTONIC, 3),
+            "backend": _backend_info(),
+            "obs": {
+                "enabled": _obs_enabled(),
+                "tracer_spans": len(_tracer.spans()),
+                "tracer_dropped_spans": _tracer.dropped,
+            },
+            "flags": self._flag_block(),
+        }
+        with self._lock:
+            providers = list(self._status.items())
+        for name, fn in providers:
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: "
+                                      f"{str(e)[:200]}"}
+        return out
+
+    def tracez(self, limit: int = 256) -> dict:
+        spans = _tracer.spans()
+        limit = max(1, min(int(limit), 4096))
+        return {"count": len(spans),
+                "dropped": _tracer.dropped,
+                "spans": [s.as_dict() for s in spans[-limit:]]}
+
+    @staticmethod
+    def _flag_block() -> dict:
+        try:
+            from paddle_tpu.flags import flags
+            return {n: flags.get(n) for n in flags.names()
+                    if n.startswith(("obs_", "resilience_", "decode_"))}
+        except Exception:
+            return {}

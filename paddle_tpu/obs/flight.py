@@ -1,0 +1,154 @@
+"""Crash flight recorder: the last N spans + resilience timeline +
+metrics, dumped atomically when decode dies.
+
+A failed run's most valuable telemetry is the part that never got
+exported: the trace ring and metrics registries live in the process
+that just raised. The flight recorder turns an exhausted degradation
+ladder / ``DecodeFailedError`` into a bounded postmortem JSON on disk —
+written BEFORE the exception propagates, so the evidence survives the
+process — with:
+
+- the newest ``FLAGS_obs_flight_spans`` spans from the tracer ring
+  (plus the ring's drop count — saturation is part of the record),
+- the typed resilience event timeline (retries, degradations, injected
+  faults — ``runtime/resilience.recent_events``),
+- the process-global metrics snapshot and every attached registry
+  (ServingEngines attach theirs, by weakref),
+- the crash reason, error class/message and site.
+
+Dumps are atomic (private tmp+rename — deliberately NOT
+``atomic_write_bytes``: the fault injector hooks that path, and a
+torn-write fault plan must never be able to tear the postmortem that
+documents it). Active only while obs is enabled and
+``FLAGS_obs_flight_recorder`` is on; every failure inside the recorder
+is swallowed — a crash dump must never mask the crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.obs.metrics import metrics as _global_metrics
+from paddle_tpu.obs.trace import obs_enabled as _obs_enabled
+from paddle_tpu.obs.trace import tracer as _tracer
+
+__all__ = ["FlightRecorder", "flight_recorder", "record_crash"]
+
+
+def _flag(name: str, default):
+    try:
+        from paddle_tpu.flags import flags
+        return flags.get(name)
+    except Exception:
+        return default
+
+
+class FlightRecorder:
+    """Bounded postmortem dumper. One process-global instance
+    (:data:`flight_recorder`) serves every crash site; engines attach
+    their private registries via :meth:`add_registry` (weakref — the
+    recorder never extends an engine's lifetime)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registries: List[tuple] = []     # (name, weakref)
+        self._seq = 0
+        self.last_path: Optional[str] = None
+
+    def add_registry(self, name: str, registry) -> None:
+        with self._lock:
+            self._registries = [
+                (n, r) for n, r in self._registries if r() is not None]
+            self._registries.append((name, weakref.ref(registry)))
+
+    def enabled(self) -> bool:
+        return _obs_enabled() and bool(
+            _flag("obs_flight_recorder", True))
+
+    def dump(self, reason: str, error: Optional[BaseException] = None,
+             extra: Optional[dict] = None,
+             path: Optional[str] = None) -> Optional[str]:
+        """Write the postmortem; returns its path, or None when the
+        recorder is disabled. Never raises."""
+        try:
+            if path is None and not self.enabled():
+                return None
+            n_spans = max(1, int(_flag("obs_flight_spans", 256)))
+            spans = _tracer.spans()
+            from paddle_tpu.runtime.resilience import recent_events
+            record: Dict[str, Any] = {
+                "kind": "paddle_tpu.postmortem",
+                "reason": reason,
+                "error": None if error is None else {
+                    "class": type(error).__name__,
+                    "message": str(error)[:2000],
+                },
+                "pid": os.getpid(),
+                "time_unix": time.time(),
+                "monotonic_ns": time.monotonic_ns(),
+                "spans": [s.as_dict() for s in spans[-n_spans:]],
+                "spans_in_ring": len(spans),
+                "spans_dropped": _tracer.dropped,
+                "resilience_events": [
+                    e.as_dict() if hasattr(e, "as_dict") else str(e)
+                    for e in recent_events()],
+                "metrics": _global_metrics.snapshot(),
+            }
+            with self._lock:
+                regs = list(self._registries)
+            registries = {}
+            for name, ref in regs:
+                reg = ref()
+                if reg is not None:
+                    try:
+                        registries[name] = reg.snapshot()
+                    except Exception:
+                        pass
+            record["registries"] = registries
+            if extra:
+                record["extra"] = extra
+            if path is None:
+                with self._lock:
+                    self._seq += 1
+                    seq = self._seq
+                d = str(_flag("obs_flight_dir", "")) or "."
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"postmortem_{os.getpid()}_{seq}.json")
+            # NaN-safe strict JSON (histogram quantiles may be None
+            # already; allow_nan=False catches anything else)
+            from paddle_tpu.obs.exporter import json_safe
+            data = json.dumps(json_safe(record), indent=1,
+                              default=str, allow_nan=False).encode()
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self.last_path = path
+            return path
+        except Exception:
+            return None
+
+
+flight_recorder = FlightRecorder()
+
+
+def record_crash(reason: str, error: Optional[BaseException] = None,
+                 extra: Optional[dict] = None) -> Optional[str]:
+    """The one-line hook the decode ladder / serving chunk path calls
+    right before raising ``DecodeFailedError``. Never raises; returns
+    the postmortem path (None when disabled) and stderr-notes it so an
+    operator tailing a dead run sees where the evidence went."""
+    path = flight_recorder.dump(reason, error=error, extra=extra)
+    if path is not None:
+        import sys
+        print(f"flight recorder: postmortem -> {path} ({reason})",
+              file=sys.stderr)
+    return path

@@ -161,10 +161,13 @@ class Histogram:
         return self._sum / self._count if self._count else 0.0
 
     def percentile(self, q: float) -> float:
-        """q in [0, 100] over the raw-sample reservoir; 0.0 when empty."""
+        """q in [0, 100] over the raw-sample reservoir. An EMPTY
+        reservoir answers NaN, never 0.0 — a dashboard must be able to
+        tell "no data" from "genuinely 0 ms" (the silent-zero p99 was a
+        real misread class)."""
         with self._lock:
             if not self._samples:
-                return 0.0
+                return float("nan")
             s = sorted(self._samples)
         k = (len(s) - 1) * (q / 100.0)
         lo, hi = int(k), min(int(k) + 1, len(s) - 1)
@@ -176,9 +179,11 @@ class Histogram:
             for c in self._counts:
                 acc += c
                 cum.append(acc)
+        empty = self._count == 0
         return {"type": "histogram", "count": self._count,
-                "sum": self._sum, "mean": self.mean,
-                "p50": self.percentile(50), "p99": self.percentile(99),
+                "sum": self._sum, "mean": None if empty else self.mean,
+                "p50": None if empty else self.percentile(50),
+                "p99": None if empty else self.percentile(99),
                 "buckets": {("+Inf" if i == len(self.buckets)
                              else repr(self.buckets[i])): cum[i]
                             for i in range(len(cum))},
@@ -257,6 +262,16 @@ class MetricsRegistry:
                     lines.append(f'{pn}_bucket{{le="{le}"}} {c}')
                 lines.append(f"{pn}_sum {snap['sum']:g}")
                 lines.append(f"{pn}_count {snap['count']}")
+                # reservoir quantiles ride as plain gauges — and are
+                # OMITTED for an empty histogram, so a scrape can never
+                # read "no data yet" as "0 ms p99"
+                if snap["count"]:
+                    lines.append(f"{pn}_p50 {snap['p50']:g}")
+                    lines.append(f"{pn}_p99 {snap['p99']:g}")
+                # telemetry saturation is itself telemetry: a clipped
+                # reservoir means the quantiles above are best-effort
+                lines.append(
+                    f"{pn}_samples_dropped {snap['samples_dropped']}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

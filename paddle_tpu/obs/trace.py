@@ -33,7 +33,23 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-__all__ = ["Span", "Tracer", "tracer", "span", "obs_enabled"]
+__all__ = ["Span", "Tracer", "tracer", "span", "obs_enabled",
+           "set_span_hook"]
+
+# Optional per-span hook: a callable ``(name, span_id) -> context
+# manager or None`` entered for the lifetime of every ACTIVE span.
+# obs/device.py plugs a jax.profiler.TraceAnnotation factory in here for
+# the duration of a device-trace capture, so the profiler timeline
+# carries one ``obs#<span_id>`` region per obs span and device-op
+# durations can be merged back onto the owning span. None (the default)
+# costs one global read per enabled span; the disabled span path never
+# consults it.
+_SPAN_HOOK: Optional[Callable[[str, int], Any]] = None
+
+
+def set_span_hook(hook: Optional[Callable[[str, int], Any]]) -> None:
+    global _SPAN_HOOK
+    _SPAN_HOOK = hook
 
 
 def obs_enabled() -> bool:
@@ -104,7 +120,7 @@ class _ActiveSpan:
     telemetry hook)."""
 
     __slots__ = ("_tracer", "name", "attrs", "_start", "_parent",
-                 "span_id")
+                 "span_id", "_hook_cm")
 
     def __init__(self, tracer_, name, attrs):
         self._tracer = tracer_
@@ -120,10 +136,27 @@ class _ActiveSpan:
         stack = t._stack()
         self._parent = stack[-1] if stack else None
         stack.append(self.span_id)
+        self._hook_cm = None
+        hook = _SPAN_HOOK
+        if hook is not None:
+            # telemetry must never break the spanned body
+            try:
+                cm = hook(self.name, self.span_id)
+                if cm is not None:
+                    cm.__enter__()
+                    self._hook_cm = cm
+            except Exception:
+                self._hook_cm = None
         self._start = time.monotonic_ns()
         return self
 
     def __exit__(self, etype, exc, tb):
+        if self._hook_cm is not None:
+            try:
+                self._hook_cm.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._hook_cm = None
         end = time.monotonic_ns()
         stack = self._tracer._stack()
         if stack and stack[-1] == self.span_id:

@@ -262,12 +262,22 @@ class ServingEngine:
     ``do_sample`` / ``top_k`` / ``top_p`` are engine-wide statics (they
     change the compiled chunk program); eos id, temperature and seed are
     per-request runtime inputs.
+
+    ``slo_targets`` maps a latency class to its default SLO targets,
+    e.g. ``{"interactive": {"ttft_s": 0.2, "latency_s": 2.0}}`` —
+    per-request ``slo_ttft_s``/``slo_latency_s`` override them. Every
+    finished request observes the per-class TTFT (admission -> first
+    token) and TPOT (inter-token) histograms; a request that misses a
+    target bumps the per-class ``serving.slo.<class>.*_violations``
+    counters (the control signal SLO-aware admission will read).
     """
 
     def __init__(self, backend, num_slots: int = 4, chunk_size: int = 8,
                  do_sample: bool = False, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, policy: str = "fifo",
-                 prompt_buckets: Optional[Sequence[int]] = None):
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 slo_targets: Optional[Dict[str, Dict[str, float]]]
+                 = None):
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
@@ -315,6 +325,22 @@ class ServingEngine:
             "serving.queue_depth", "queued requests observed per step",
             buckets=[0, 1, 2, 4, 8, 16, 32, 64, 128])
         self._g_qdepth = r.gauge("serving.queue_depth_now", "")
+        # SLO instruments: TTFT is admission -> the end of the first
+        # chunk dispatch the request rode (its first tokens exist on the
+        # host then); TPOT is (finish - first token) / (tokens - 1) per
+        # request — chunked execution quantizes both to chunk boundaries
+        self._h_ttft = r.histogram(
+            "serving.ttft_s", "time to first token (admission -> first "
+            "chunk completion)")
+        self._h_tpot = r.histogram(
+            "serving.tpot_s", "per-request mean inter-token time after "
+            "the first token")
+        self.slo_targets = {k: dict(v)
+                            for k, v in (slo_targets or {}).items()}
+        self._exporter = None
+        # crash evidence: a ladder exhaustion's postmortem carries this
+        # engine's registry snapshot (weakref — no lifetime extension)
+        obs.flight_recorder.add_registry("serving", self.registry)
 
     # legacy counter attributes, now views over the registry (pre-obs
     # callers and the bench dispatch-accounting asserts read these)
@@ -334,8 +360,12 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int,
                eos_token_id: Optional[int] = None,
                temperature: float = 1.0, seed: int = 0,
-               priority: int = 0) -> int:
-        """Queue one request; returns its id (results key)."""
+               priority: int = 0, latency_class: str = "default",
+               slo_ttft_s: Optional[float] = None,
+               slo_latency_s: Optional[float] = None) -> int:
+        """Queue one request; returns its id (results key).
+        ``latency_class`` + optional per-request SLO targets feed the
+        per-class TTFT/latency violation counters."""
         from paddle_tpu.inference.generate import _normalize_eos
         prompt = np.asarray(prompt)
         if prompt.ndim == 2:
@@ -361,7 +391,9 @@ class ServingEngine:
             id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_token_id=_normalize_eos(eos_token_id),
             temperature=float(temperature), seed=int(seed),
-            priority=int(priority), submit_time=time.monotonic()))
+            priority=int(priority), submit_time=time.monotonic(),
+            latency_class=str(latency_class),
+            slo_ttft_s=slo_ttft_s, slo_latency_s=slo_latency_s))
         self._g_qdepth.set(len(self.scheduler))
         obs.tracer.event("serving.request.queued", request=rid,
                          prompt_len=len(prompt),
@@ -383,10 +415,16 @@ class ServingEngine:
             return []
         self._h_occ.observe(len(occupied) / self.num_slots)
         toks = self._dispatch_chunk(occupied)
+        t_chunk_done = time.monotonic()
         finished, freed = [], []
         for i, slot in occupied:
             slot.chunks += 1
             slot.tokens.append(toks[i])
+            if slot.first_token_at is None:
+                # the slot's first tokens reached the host with THIS
+                # dispatch: admission -> here is the request's TTFT
+                slot.first_token_at = t_chunk_done
+                self._h_ttft.observe(t_chunk_done - slot.admitted_at)
             req = slot.request
             seq = np.concatenate(slot.tokens)
             fin = False
@@ -490,10 +528,18 @@ class ServingEngine:
                 raise
             if (not _flags.resilience_auto_degrade
                     or not self._b.has_step_rung()):
-                raise DecodeFailedError(
+                err = DecodeFailedError(
                     f"serving chunk dispatch failed with no per-token "
                     f"rung available: {str(e)[:300]}",
-                    events=self._b.events_since(ev0), last_error=e) from e
+                    events=self._b.events_since(ev0), last_error=e)
+                # the process may die on this: dump the flight recorder
+                # (last spans + resilience timeline + registries) first
+                obs.record_crash(
+                    "serving.chunk_failed_no_rung", error=e,
+                    extra={"site": "serve.chunk",
+                           "in_flight": [s.request.id
+                                         for _, s in occupied]})
+                raise err from e
             ev = DegradationEvent(
                 site="serve.chunk", from_level="chunked",
                 to_level="per_token", error_class=type(e).__name__,
@@ -528,6 +574,14 @@ class ServingEngine:
         latency = fin - req.submit_time
         self._h_latency.observe(latency)
         self._c_done.inc()
+        ttft = (slot.first_token_at - slot.admitted_at
+                if slot.first_token_at is not None else None)
+        n_tok = int(seq.shape[0])
+        tpot = None
+        if slot.first_token_at is not None and n_tok > 1:
+            tpot = max(0.0, fin - slot.first_token_at) / (n_tok - 1)
+            self._h_tpot.observe(tpot)
+        slo = self._check_slo(req, ttft, latency)
         degr = [e for e in slot.events
                 if getattr(e, "kind", "") == "degradation"]
         record = {
@@ -540,8 +594,12 @@ class ServingEngine:
             "serving": {
                 "queue_delay_s": slot.admitted_at - req.submit_time,
                 "latency_s": latency,
+                "ttft_s": ttft,
+                "tpot_s": tpot,
                 "chunks": slot.chunks,
                 "slot": slot_idx,
+                "latency_class": req.latency_class,
+                "slo": slo,
             },
         }
         # the request's lifetime span (submit -> finished) on the same
@@ -558,7 +616,111 @@ class ServingEngine:
                               seq.astype(req.prompt.dtype)])[None]
         return GenerateResult.wrap(out, record)
 
+    def _check_slo(self, req: Request, ttft: Optional[float],
+                   latency: float) -> Optional[dict]:
+        """Evaluate the request against its SLO targets (per-request
+        override, else the engine's per-class defaults). Bumps the
+        per-class request/violation counters; returns the record block
+        (None when the class has no targets at all)."""
+        cls = req.latency_class
+        defaults = self.slo_targets.get(cls, {})
+        t_ttft = (req.slo_ttft_s if req.slo_ttft_s is not None
+                  else defaults.get("ttft_s"))
+        t_lat = (req.slo_latency_s if req.slo_latency_s is not None
+                 else defaults.get("latency_s"))
+        if t_ttft is None and t_lat is None:
+            return None
+        r = self.registry
+        r.counter(f"serving.slo.{cls}.requests",
+                  "requests finished in this latency class").inc()
+        out = {"class": cls, "violated": False}
+        if t_ttft is not None:
+            out["ttft_target_s"] = t_ttft
+            # a request that never produced a token has no TTFT: that IS
+            # a violation, not a pass
+            if ttft is None or ttft > t_ttft:
+                out["violated"] = True
+                out["ttft_violated"] = True
+                r.counter(f"serving.slo.{cls}.ttft_violations",
+                          "TTFT above the class/request target").inc()
+        if t_lat is not None:
+            out["latency_target_s"] = t_lat
+            if latency > t_lat:
+                out["violated"] = True
+                out["latency_violated"] = True
+                r.counter(f"serving.slo.{cls}.latency_violations",
+                          "end-to-end latency above the class/request "
+                          "target").inc()
+        return out
+
     # -- observability -----------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        """Live /statusz block: slot table (who is in which batch row,
+        how far along), queue depth, in-flight requests, occupancy and
+        the resilience-ladder rung — the "what is the engine doing RIGHT
+        NOW" view, distinct from the cumulative metrics()."""
+        slots = []
+        for i, e in enumerate(self.scheduler.slots.entries):
+            if e is None:
+                slots.append({"slot": i, "state": "free"})
+                continue
+            produced = int(sum(len(t) for t in e.tokens))
+            slots.append({
+                "slot": i, "state": "occupied",
+                "request": e.request.id,
+                "latency_class": e.request.latency_class,
+                "prompt_len": int(len(e.request.prompt)),
+                "max_new_tokens": e.request.max_new_tokens,
+                "tokens_produced": produced,
+                "chunks": e.chunks,
+                "age_s": round(time.monotonic() - e.admitted_at, 4),
+            })
+        occupied = self.scheduler.slots.occupied()
+        degraded = int(self._c_degr.value)
+        return {
+            "num_slots": self.num_slots,
+            "chunk_size": self.chunk_size,
+            "slots": slots,
+            "occupancy_now": len(occupied) / self.num_slots,
+            "queue_depth": len(self.scheduler),
+            "in_flight": [s.request.id for _, s in occupied],
+            "requests_submitted": self._next_id,
+            "requests_completed": len(self._results),
+            # the ladder rung the engine is effectively on: any chunk
+            # degradation this lifetime means the per-token rung has
+            # been exercised (per-request rungs ride each result record)
+            "resilience": {
+                "ladder_rung": "per_token" if degraded else "chunked",
+                "degradations": degraded,
+                "step_dispatches": self.step_dispatches,
+            },
+            "slo_targets": self.slo_targets,
+        }
+
+    def start_exporter(self, port: Optional[int] = None) -> int:
+        """Start the live telemetry plane (obs/exporter.py) over this
+        engine: /metrics scrapes the global obs registry + this engine's
+        registry, /statusz carries :meth:`status`, /tracez the recent
+        spans. ``port=None`` reads ``FLAGS_obs_export_port`` /
+        ``PADDLE_TPU_OBS_PORT`` (0 there = don't start, returns 0).
+        Returns the bound port. Idempotent while running."""
+        if self._exporter is not None:
+            return self._exporter.port
+        from paddle_tpu.obs.exporter import ObsExporter, \
+            resolve_export_port
+        p = resolve_export_port() if port is None else int(port)
+        if port is None and p == 0:
+            return 0
+        self._exporter = ObsExporter(port=p).add_engine(self)
+        return self._exporter.start()
+
+    def stop_exporter(self) -> None:
+        """Stop the exporter and release its port (no-op when not
+        running)."""
+        exp, self._exporter = self._exporter, None
+        if exp is not None:
+            exp.stop()
+
     def metrics(self) -> Dict[str, Any]:
         """Serving metrics snapshot, derived from the engine's typed
         registry (``self.registry`` — counters/histograms a Prometheus
@@ -595,4 +757,15 @@ class ServingEngine:
             "queue_depth_now": int(self._g_qdepth.value),
             "queue_depth_peak": int(self._g_qdepth.max),
             "queue_depth_mean": self._h_qdepth.mean,
+            # SLO instruments (NaN until the first sample — empty
+            # reservoirs answer NaN, never a fake-fast 0.0)
+            "ttft_mean_s": self._h_ttft.mean,
+            "ttft_p50_s": self._h_ttft.percentile(50),
+            "ttft_p99_s": self._h_ttft.percentile(99),
+            "tpot_mean_s": self._h_tpot.mean,
+            "tpot_p50_s": self._h_tpot.percentile(50),
+            "slo_violations": int(sum(
+                self.registry.get(n).value
+                for n in self.registry.names()
+                if ".slo." in n and n.endswith("_violations"))),
         }

@@ -66,6 +66,12 @@ class Request:
     seed: int = 0
     priority: int = 0
     submit_time: float = 0.0      # time.monotonic(); 0.0 = unset
+    # SLO bucket + targets (None = engine-default for the class, or no
+    # target): per-class TTFT / latency violation counters in the
+    # engine registry are the groundwork for SLO-aware scheduling
+    latency_class: str = "default"
+    slo_ttft_s: Optional[float] = None
+    slo_latency_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -79,6 +85,9 @@ class Slot:
     chunks: int = 0
     tokens: List[np.ndarray] = dataclasses.field(default_factory=list)
     events: List[Any] = dataclasses.field(default_factory=list)
+    # stamped after the first chunk dispatch the slot rode: the
+    # admission->first-token interval is the TTFT instrument's sample
+    first_token_at: Optional[float] = None
 
 
 class SlotTable:

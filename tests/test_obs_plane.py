@@ -1,0 +1,358 @@
+"""Live telemetry plane + device-time attribution (PR 6 obs rungs).
+
+The load-bearing properties:
+- the exporter serves /metrics (Prometheus text incl. every attached
+  registry + the tracer-saturation gauge), /statusz (strict JSON with
+  the engine's slot table / queue / ladder rung) and /tracez (recent
+  spans), binds an ephemeral port and RELEASES it on stop;
+- the device-trace merge attributes jax.profiler device-op durations
+  back onto the owning dispatch spans on the CPU backend (device_ms /
+  device_occupancy attrs, nonzero coverage);
+- TTFT/TPOT histograms and per-class SLO violation counters are
+  correct on a deterministic serve run;
+- the flight recorder dumps a postmortem JSON (spans + resilience
+  timeline + metrics + attached registries) when the decode ladder
+  exhausts under fault injection;
+- empty histograms report NaN percentiles / null snapshot quantiles
+  and OMIT the p50/p99 lines from Prometheus exposition (dashboards
+  must never read "no data" as "0 ms p99"), while samples_dropped is
+  exported first-class.
+"""
+
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.obs as obs
+from paddle_tpu.flags import set_flags
+from paddle_tpu.obs.device import merge_device_events
+from paddle_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, max_position_embeddings=64)
+
+
+@pytest.fixture()
+def obs_on():
+    set_flags({"obs_enabled": True})
+    mark = obs.tracer.mark()
+    try:
+        yield mark
+    finally:
+        set_flags({"obs_enabled": False})
+
+
+@pytest.fixture(scope="module")
+def dec():
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    return LlamaDecoder(LlamaForCausalLM(LlamaConfig(**CFG)), max_len=64)
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5).read()
+
+
+# -- exporter ----------------------------------------------------------------
+
+def test_exporter_endpoints_and_port_release(obs_on, dec):
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    for i in range(3):
+        eng.submit(np.arange(3 + i) % 64, 4, seed=i)
+    eng.drain()
+    port = eng.start_exporter(port=0)
+    assert port > 0
+    assert eng.start_exporter(port=0) == port       # idempotent
+    try:
+        # /metrics: Prometheus shape, engine registry included, tracer
+        # saturation exported first-class
+        txt = _get(port, "/metrics").decode()
+        assert "# TYPE obs_tracer_dropped_spans gauge" in txt
+        assert "serving_prefill_dispatches 3" in txt
+        assert "serving_request_latency_s_count 3" in txt
+        # /statusz: strict JSON (no NaN literals survive), schema
+        raw = _get(port, "/statusz").decode()
+        st = json.loads(raw)
+        assert "NaN" not in raw
+        assert st["pid"] == os.getpid()
+        assert st["obs"]["enabled"] is True
+        assert st["backend"]["device_count"] >= 1
+        sv = st["serving"]
+        assert sv["num_slots"] == 2 and sv["queue_depth"] == 0
+        assert len(sv["slots"]) == 2
+        assert all(s["state"] == "free" for s in sv["slots"])
+        assert sv["resilience"]["ladder_rung"] == "chunked"
+        # /tracez: recent spans with the dispatch sites, limit honored
+        tz = json.loads(_get(port, "/tracez?limit=500"))
+        names = {s["name"] for s in tz["spans"]}
+        assert "decode.admit_prefill" in names
+        assert "decode.chunk" in names
+        one = json.loads(_get(port, "/tracez?limit=1"))
+        assert len(one["spans"]) == 1
+        with pytest.raises(urllib.error.HTTPError):
+            _get(port, "/nope")
+    finally:
+        eng.stop_exporter()
+    # stopped: the socket no longer accepts, and the port can be
+    # re-bound by a fresh exporter (SO_REUSEADDR server semantics)
+    with pytest.raises(OSError):
+        _get(port, "/metrics")
+    exp2 = obs.ObsExporter(port=port)
+    assert exp2.start() == port
+    exp2.stop()
+
+
+def test_exporter_status_provider_errors_stay_in_band(obs_on):
+    exp = obs.ObsExporter(port=0)
+    exp.add_status_provider("boomy", lambda: 1 / 0)
+    port = exp.start()
+    try:
+        st = json.loads(_get(port, "/statusz"))
+        assert "ZeroDivisionError" in st["boomy"]["error"]
+    finally:
+        exp.stop()
+
+
+# -- device-time attribution -------------------------------------------------
+
+def test_device_trace_merge_on_cpu(obs_on, dec):
+    """A generate inside a DeviceTraceSession: the profiler's device-op
+    durations merge back onto the prefill/fused dispatch spans, and the
+    session's attribution coverage is nonzero — the CPU-backend proof
+    of the jax.profiler merge path."""
+    prompt = np.arange(4)[None] % 64
+    dec.generate(prompt, max_new_tokens=6)      # compile outside capture
+    m0 = obs.tracer.mark()
+    sess = obs.DeviceTraceSession().start()
+    if not sess.active:
+        pytest.skip("jax.profiler unavailable on this backend")
+    dec.generate(prompt, max_new_tokens=6)
+    summary = sess.stop()
+    if summary.get("device_ops", 0) == 0:
+        pytest.skip("profiler captured no device ops on this backend")
+    assert summary["active"] and summary["merged_spans"] >= 2
+    assert 0.0 < summary["coverage"] <= 1.0
+    assert summary["attributed_ms"] > 0
+    by_site = summary["by_site"]
+    assert by_site["decode.prefill"]["spans"] == 1
+    assert by_site["decode.fused"]["spans"] == 1
+    spans = {s.name: s for s in obs.tracer.spans_since(m0)}
+    for site in ("decode.prefill", "decode.fused"):
+        assert spans[site].attrs["device_ms"] > 0
+        assert spans[site].attrs["device_occupancy"] > 0
+
+
+def test_device_merge_attribution_rules():
+    """Pure-merge unit: ops attribute to the window they overlap most
+    (innermost on ties), unattributed ops count against coverage."""
+    ann = [{"name": "obs#1", "ts": 0.0, "dur": 100.0},
+           {"name": "obs#2", "ts": 200.0, "dur": 50.0},
+           {"name": "obs#3", "ts": 10.0, "dur": 20.0}]   # nested in #1
+    ops = [{"name": "dot", "ts": 5.0, "dur": 4.0, "args": {"hlo_op": "dot"}},
+           {"name": "mul", "ts": 12.0, "dur": 10.0,
+            "args": {"hlo_op": "mul"}},                  # innermost -> #3
+           {"name": "add", "ts": 210.0, "dur": 30.0,
+            "args": {"hlo_op": "add"}},                  # -> #2
+           {"name": "orphan", "ts": 500.0, "dur": 10.0,
+            "args": {"hlo_op": "orphan"}}]               # no window
+    out = merge_device_events(ann, ops)
+    assert out["attributed_us"] == {1: 4.0, 3: 10.0, 2: 30.0}
+    assert out["device_total_us"] == 54.0
+    assert out["coverage"] == pytest.approx(44.0 / 54.0)
+
+
+def test_device_session_requires_obs():
+    set_flags({"obs_enabled": False})
+    sess = obs.DeviceTraceSession().start()
+    assert not sess.active
+    assert sess.stop() == {"active": False}
+
+
+# -- SLO instruments ---------------------------------------------------------
+
+def test_ttft_tpot_and_slo_counters(obs_on, dec):
+    """Deterministic serve run: every finished request observes TTFT
+    once; every multi-token request observes TPOT; the per-request
+    record carries both plus the SLO verdict; impossible targets
+    violate, generous targets don't."""
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(
+        dec, num_slots=2, chunk_size=4,
+        slo_targets={"strict": {"ttft_s": 0.0, "latency_s": 0.0},
+                     "loose": {"ttft_s": 3600.0, "latency_s": 3600.0}})
+    rng = np.random.default_rng(3)
+    strict = [eng.submit(rng.integers(0, 64, (4,)), 6, seed=i,
+                         latency_class="strict") for i in range(2)]
+    loose = [eng.submit(rng.integers(0, 64, (4,)), 6, seed=9,
+                        latency_class="loose")]
+    single = [eng.submit(rng.integers(0, 64, (4,)), 1, seed=7)]
+    res = eng.drain()
+    n = len(strict) + len(loose) + len(single)
+    h_ttft = eng.registry.get("serving.ttft_s")
+    h_tpot = eng.registry.get("serving.tpot_s")
+    assert h_ttft.count == n
+    assert h_tpot.count == n - 1          # the 1-token request has none
+    for rid in strict + loose:
+        rec = res[rid].resilience["serving"]
+        assert rec["ttft_s"] > 0
+        assert rec["tpot_s"] > 0
+        assert rec["ttft_s"] <= rec["latency_s"]
+    # impossible targets: every strict request violates both ways
+    r = eng.registry
+    assert r.get("serving.slo.strict.requests").value == len(strict)
+    assert r.get("serving.slo.strict.ttft_violations").value \
+        == len(strict)
+    assert r.get("serving.slo.strict.latency_violations").value \
+        == len(strict)
+    # generous targets: no loose violations, but the class is counted
+    assert r.get("serving.slo.loose.requests").value == len(loose)
+    assert r.get("serving.slo.loose.ttft_violations") is None
+    assert res[loose[0]].resilience["serving"]["slo"] == {
+        "class": "loose", "violated": False,
+        "ttft_target_s": 3600.0, "latency_target_s": 3600.0}
+    # no targets for the default class: no slo block, no counters
+    assert res[single[0]].resilience["serving"]["slo"] is None
+    assert r.get("serving.slo.default.requests") is None
+    m = eng.metrics()
+    assert m["slo_violations"] == 2 * len(strict)
+    assert m["ttft_p50_s"] > 0 and m["tpot_mean_s"] > 0
+    # per-request SLO override beats the class default
+    eng2 = ServingEngine(dec, num_slots=2, chunk_size=4)
+    rid = eng2.submit(np.arange(4) % 64, 4, slo_latency_s=0.0)
+    eng2.drain()
+    assert eng2.registry.get(
+        "serving.slo.default.latency_violations").value == 1
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_recorder_dumps_on_ladder_exhaustion(obs_on, dec,
+                                                    tmp_path):
+    from paddle_tpu.runtime.resilience import (DecodeFailedError,
+                                               fault_injector)
+    set_flags({"obs_flight_dir": str(tmp_path),
+               "resilience_retries": 0, "resilience_backoff_s": 0.0})
+    fault_injector.configure([{"kind": "dispatch_error",
+                               "site": "decode.*", "call": 1,
+                               "times": 999}])
+    try:
+        with pytest.raises(DecodeFailedError):
+            dec.generate(np.arange(4)[None] % 64, max_new_tokens=4)
+    finally:
+        fault_injector.clear()
+        set_flags({"obs_flight_dir": "", "resilience_retries": 3,
+                   "resilience_backoff_s": 0.5})
+    dumps = sorted(tmp_path.glob("postmortem_*.json"))
+    assert dumps, "ladder exhaustion produced no postmortem"
+    pm = json.loads(dumps[-1].read_text())   # strict JSON round-trips
+    assert pm["kind"] == "paddle_tpu.postmortem"
+    assert pm["reason"] == "decode.ladder_exhausted"
+    assert pm["error"]["class"] == "InjectedFault"
+    assert pm["extra"]["site"] == "decode.generate"
+    # the evidence: the span ring, the typed resilience timeline (the
+    # injected faults fire BEFORE a span opens — a failed dispatch
+    # never ran — so the faults live in the timeline, not error spans),
+    # and the metrics snapshot
+    assert isinstance(pm["spans"], list)
+    assert pm["spans_in_ring"] >= len(pm["spans"])
+    kinds = {e.get("kind") for e in pm["resilience_events"]}
+    assert "fault" in kinds and "degradation" in kinds
+    assert any(e.get("site", "").startswith("decode.")
+               for e in pm["resilience_events"])
+    assert "resilience.faults_injected" in pm["metrics"]
+
+
+def test_flight_recorder_disabled_without_obs(dec, tmp_path):
+    set_flags({"obs_enabled": False})
+    assert obs.flight_recorder.dump("nope") is None
+    # explicit path forces a dump even when disabled (operator ask)
+    p = obs.flight_recorder.dump("forced",
+                                 path=str(tmp_path / "pm.json"))
+    assert p and json.loads((tmp_path / "pm.json").read_text())[
+        "reason"] == "forced"
+
+
+# -- empty-histogram semantics (the no-data-is-not-zero satellite) -----------
+
+def test_empty_histogram_reports_nan_not_zero():
+    h = MetricsRegistry().histogram("lat_s", buckets=[0.1, 1.0])
+    assert math.isnan(h.percentile(50))
+    assert math.isnan(h.percentile(99))
+    snap = h.snapshot()
+    assert snap["p50"] is None and snap["p99"] is None
+    assert snap["mean"] is None and snap["count"] == 0
+    h.observe(0.05)
+    snap = h.snapshot()
+    assert snap["p50"] == 0.05 and snap["mean"] == pytest.approx(0.05)
+
+
+def test_prometheus_omits_quantiles_when_empty_exports_drops():
+    r = MetricsRegistry()
+    empty = r.histogram("empty_s", buckets=[0.1])
+    full = r.histogram("full_s", buckets=[0.1])
+    full.observe(0.05)
+    txt = r.to_prometheus()
+    assert "empty_s_p50" not in txt and "empty_s_p99" not in txt
+    assert "full_s_p50 0.05" in txt and "full_s_p99 0.05" in txt
+    # saturation is first-class exposition for every histogram
+    assert "empty_s_samples_dropped 0" in txt
+    assert "full_s_samples_dropped 0" in txt
+    # snapshot carries samples_dropped too (registry-snapshot surface)
+    assert r.snapshot()["full_s"]["samples_dropped"] == 0
+
+
+def test_engine_metrics_nan_before_first_sample(dec):
+    """A fresh engine's percentile keys answer NaN (not a fake-fast 0)
+    until the first request finishes — and the /statusz JSON path
+    sanitizes them to null."""
+    from paddle_tpu.obs.exporter import json_safe
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    m = eng.metrics()
+    assert math.isnan(m["request_latency_p50_s"])
+    assert math.isnan(m["ttft_p99_s"])
+    safe = json_safe(m)
+    assert safe["request_latency_p50_s"] is None
+    json.dumps(safe, allow_nan=False)      # strict-JSON clean
+
+
+# -- trace_report device columns ---------------------------------------------
+
+def test_trace_report_device_columns(tmp_path):
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    spans = [
+        {"name": "decode.chunk", "dur_ms": 2.0, "kind": "span",
+         "attrs": {"device_ms": 1.5, "device_occupancy": 0.75}},
+        {"name": "decode.chunk", "dur_ms": 2.0, "kind": "span",
+         "attrs": {}},                       # never got device time
+        {"name": "serving.request", "dur_ms": 5.0, "kind": "span",
+         "attrs": {}},
+    ]
+    rows = {r["phase"]: r for r in trace_report.phase_table(spans)}
+    chunk = rows["decode.chunk"]
+    assert chunk["device_ms"] == 1.5
+    assert chunk["device_occ_pct"] == pytest.approx(37.5)
+    assert chunk["no_device"] == 1           # one span unattributed
+    assert rows["serving.request"]["device_ms"] is None
+    assert rows["serving.request"]["no_device"] == 1
+    # without any device attrs the table stays in its legacy shape
+    legacy = trace_report.phase_table(
+        [{"name": "x", "dur_ms": 1.0, "attrs": {}}])
+    assert "device_ms" not in legacy[0]

@@ -25,6 +25,12 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
   8. trace_report (tools/trace_report.py obs_trace_decode.json): renders
      step 7's trace into per-phase tables; rc=1 on an empty/unloadable
      trace, so a silently-broken exporter fails the roundtail
+  9. serve_obs_export (this script's --probe-serve-export mode): runs
+     `bench.py --serve` with PADDLE_TPU_OBS=1 PADDLE_TPU_OBS_PORT=<p>
+     PADDLE_TPU_OBS_DEVICE=1, scrapes /metrics, /statusz and /tracez
+     MID-RUN (non-empty Prometheus text, statusz JSON with the engine
+     block, tracez spans), then asserts the final record carries
+     device-attribution coverage > 0 — the live-telemetry-plane gate
 
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
@@ -32,7 +38,9 @@ summary prints at the end. Usage: python tools/roundtail_bench.py
 
 from __future__ import annotations
 
+import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -50,10 +58,102 @@ STEPS = [
                     "2"], {"PADDLE_TPU_OBS": "1"}),
     ("trace_report", [sys.executable, "tools/trace_report.py",
                       "obs_trace_decode.json", "--json"], None),
+    ("serve_obs_export", [sys.executable, "tools/roundtail_bench.py",
+                          "--probe-serve-export"], None),
 ]
 
 
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def probe_serve_export() -> int:
+    """The live-telemetry-plane gate: bench.py --serve with the obs
+    exporter + device-time attribution on, all three endpoints scraped
+    mid-run, and the final record's device coverage checked > 0."""
+    from urllib.request import urlopen
+    port = _free_port()
+    env = dict(os.environ, PADDLE_TPU_OBS="1",
+               PADDLE_TPU_OBS_PORT=str(port),
+               PADDLE_TPU_OBS_DEVICE="1")
+    proc = subprocess.Popen(
+        [sys.executable, "bench.py", "--serve"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    scraped = {}
+    deadline = time.time() + 600
+    try:
+        while time.time() < deadline and not scraped:
+            if proc.poll() is not None:
+                break
+            try:
+                scraped["metrics"] = urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=2).read().decode()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            scraped["statusz"] = json.loads(urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=5).read())
+            scraped["tracez"] = json.loads(urlopen(
+                f"http://127.0.0.1:{port}/tracez", timeout=5).read())
+        out, _ = proc.communicate(timeout=600)
+    except Exception as e:
+        proc.kill()
+        print(f"serve_obs_export: probe failed: {e}")
+        return 1
+    if not scraped:
+        print(f"serve_obs_export: never reached the exporter on "
+              f"port {port} (bench rc={proc.returncode})")
+        return 1
+    ok = True
+    if "# TYPE" not in scraped["metrics"]:
+        print("serve_obs_export: /metrics scrape empty or not "
+              "Prometheus-shaped")
+        ok = False
+    else:
+        print(f"serve_obs_export: /metrics OK "
+              f"({len(scraped['metrics'].splitlines())} lines)")
+    if not isinstance(scraped["statusz"], dict) or \
+            "obs" not in scraped["statusz"]:
+        print("serve_obs_export: /statusz missing the obs block")
+        ok = False
+    else:
+        print(f"serve_obs_export: /statusz OK "
+              f"(keys: {sorted(scraped['statusz'])})")
+    if "spans" not in scraped.get("tracez", {}):
+        print("serve_obs_export: /tracez missing spans")
+        ok = False
+    else:
+        print(f"serve_obs_export: /tracez OK "
+              f"({scraped['tracez']['count']} spans in ring)")
+    if proc.returncode:
+        print(f"serve_obs_export: bench.py --serve rc="
+              f"{proc.returncode}")
+        ok = False
+    # the final stdout line is the bench record; device-attribution
+    # coverage must be nonzero (the merged-profiler evidence ran)
+    try:
+        record = json.loads(out.strip().splitlines()[-1])
+        cov = record["obs"]["device"]["coverage"]
+        if cov > 0:
+            print(f"serve_obs_export: device attribution coverage "
+                  f"{cov}")
+        else:
+            print("serve_obs_export: device attribution coverage is 0")
+            ok = False
+    except Exception as e:
+        print(f"serve_obs_export: no device block in the record: {e}")
+        ok = False
+    return 0 if ok else 1
+
+
 def main():
+    if "--probe-serve-export" in sys.argv:
+        return probe_serve_export()
     os.makedirs("/tmp/roundtail", exist_ok=True)
     results = {}
     for name, cmd, env_extra in STEPS:
