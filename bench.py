@@ -830,7 +830,44 @@ def bench_moe():
     return _emit("moe_lm_train_tokens_per_sec", tps, "tokens/sec")
 
 
-def bench_decode_modes(steps=None):
+def _parse_mesh(spec):
+    """``--mesh dp:D,tp:T`` -> ordered axes dict (None passes through)."""
+    if spec is None or isinstance(spec, dict):
+        return spec
+    axes = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, size = part.partition(":")
+        if not sep:
+            raise ValueError(f"--mesh wants 'name:size,...' (e.g. "
+                             f"'dp:2,tp:2'), got segment {part!r}")
+        axes[name.strip()] = int(size)
+    return axes or None
+
+
+def _bench_mesh(mesh):
+    """Build the decode mesh for a bench run (after the backend probe) —
+    or fail with a clear record when the devices aren't there."""
+    if mesh is None:
+        return None
+    import jax
+
+    from paddle_tpu.parallel import decode_mesh
+    axes = _parse_mesh(mesh)
+    need = 1
+    for v in axes.values():
+        need *= int(v)
+    if jax.device_count() < need:
+        raise ValueError(
+            f"--mesh {axes} needs {need} devices; this process has "
+            f"{jax.device_count()} (on CPU set JAX_PLATFORMS=cpu so the "
+            f"bench can force a virtual device mesh)")
+    return decode_mesh(axes)
+
+
+def bench_decode_modes(steps=None, mesh=None):
     """``--decode``: the fused one-dispatch decode microbenchmark.
 
     Measures tokens/s AND device-dispatch count per generate call for
@@ -880,8 +917,10 @@ def bench_decode_modes(steps=None):
     if on_tpu:
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
+    mesh_obj = _bench_mesh(mesh)
     # + spec_k + 1 slack: speculative rounds overshoot by up to K slots
-    dec = LlamaDecoder(model, max_len=prompt_len + n_new + spec_k + 1)
+    dec = LlamaDecoder(model, max_len=prompt_len + n_new + spec_k + 1,
+                       mesh=mesh_obj)
     rng = np.random.default_rng(0)
     # an eos id no token can match: full-length decode, measuring the
     # eos-enabled program's overhead rather than a data-dependent stop
@@ -895,6 +934,10 @@ def bench_decode_modes(steps=None):
              ("spec_greedy", dict(spec_kw)),
              ("spec_sampled", {"do_sample": True, "temperature": 0.8,
                                "top_k": 40, "seed": 0, **spec_kw})]
+    if mesh_obj is not None:
+        # speculative decode is refused on a mesh (typed, at generate
+        # time) — the sweep drops those rows rather than crash the run
+        modes = [m for m in modes if not m[0].startswith("spec_")]
     run_mark = _obs_mark()        # the whole-run trace export window
     dev_sess = _obs_device_session()   # PADDLE_TPU_OBS_DEVICE=1 evidence
     rows = {}
@@ -947,8 +990,14 @@ def bench_decode_modes(steps=None):
                  head["tokens_per_sec"], "tokens/sec")
     line["decode"] = {"config": "134M" if on_tpu else "tiny-cpu",
                       "new_tokens": n_new, "reps": reps,
-                      "speculative": {"draft": spec_draft, "k": spec_k},
+                      "speculative": (None if mesh_obj is not None
+                                      else {"draft": spec_draft,
+                                            "k": spec_k}),
                       "modes": rows}
+    if mesh_obj is not None:
+        md = dec.sharding.describe()
+        md.pop("partition_rules", None)
+        line["decode"]["mesh"] = md
     # merge measured device time onto the spans BEFORE the export, so
     # the trace artifact (and trace_report's device columns) carry it
     dev_summary = dev_sess.stop() if dev_sess is not None else None
@@ -961,7 +1010,7 @@ def bench_decode_modes(steps=None):
     return line
 
 
-def bench_serve(n_requests=None, slots=None, chunk=None):
+def bench_serve(n_requests=None, slots=None, chunk=None, mesh=None):
     """``--serve``: continuous batching vs static batching.
 
     A Poisson-arrival, mixed-output-length workload served two ways over
@@ -1028,8 +1077,9 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
     if on_tpu:
         for p in model.parameters():
             p._set_value(p.value.astype(jnp.bfloat16))
+    mesh_obj = _bench_mesh(mesh)
     max_len = prompt_len + max(len_pool)
-    dec = LlamaDecoder(model, max_len=max_len)
+    dec = LlamaDecoder(model, max_len=max_len, mesh=mesh_obj)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
                for _ in range(n_req)]
@@ -1116,6 +1166,14 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
                                 .to_prometheus())
         if dev_summary is not None:
             obs_block["device"] = _obs_device_block(dev_summary)
+    # cost-model MFU, PER DEVICE: decode work is ~2*N_params FLOPs per
+    # token; under a mesh each device does 1/mesh_size of it, so the
+    # honest utilisation denominator is (devices x wall x peak). Off-mesh
+    # this is the usual single-chip number (mesh_size=1).
+    mesh_size = dec.sharding.size if dec.sharding is not None else 1
+    cont["mfu_model_per_device"] = round(
+        useful * 2 * model.num_params() / mesh_size / cont_wall
+        / _peak_flops(jax), 6)
     cont["request_latency_p50_s"] = round(m["request_latency_p50_s"], 4)
     cont["request_latency_p99_s"] = round(m["request_latency_p99_s"], 4)
     cont["queue_depth_peak"] = m["queue_depth_peak"]
@@ -1176,11 +1234,17 @@ def bench_serve(n_requests=None, slots=None, chunk=None):
           file=sys.stderr)
     line = _emit("serving_continuous_tokens_per_sec",
                  cont["tokens_per_sec"], "tokens/sec")
+    mesh_rec = None
+    if dec.sharding is not None:
+        mesh_rec = dec.sharding.describe()
+        mesh_rec.pop("partition_rules", None)
+        mesh_rec["carry_sharding"] = eng.status()["mesh"]["carry_sharding"]
     line["serve"] = {
         "config": "134M" if on_tpu else "tiny-cpu",
         "requests": n_req, "slots": slots, "chunk_size": chunk,
         "prompt_len": prompt_len, "output_len_pool": list(len_pool),
         "poisson_mean_gap_s": mean_gap,
+        "mesh": mesh_rec,
         "continuous": cont, "static": static,
         "speedup_tokens_per_sec": round(speedup, 3),
         "continuous_beats_static": bool(
@@ -1332,6 +1396,13 @@ def main():
     ap.add_argument("--serve-requests", type=int, default=None)
     ap.add_argument("--serve-slots", type=int, default=None)
     ap.add_argument("--serve-chunk", type=int, default=None)
+    ap.add_argument("--mesh", default=None,
+                    help="serve/decode on a device mesh, e.g. "
+                         "'dp:2,tp:2': tensor-parallel decode over tp, "
+                         "batch/slot-table over dp, the DecodeState "
+                         "carry sharded on device (recorded in the "
+                         "bench record). On CPU (JAX_PLATFORMS=cpu) a "
+                         "virtual device mesh is forced automatically.")
     ap.add_argument("--steps", type=int, default=None,
                     help="override the --decode per-mode repetition "
                          "count (the obs smoke pass in "
@@ -1339,6 +1410,18 @@ def main():
                          "--steps 2 with PADDLE_TPU_OBS=1)")
     args = ap.parse_args()
 
+    if args.mesh:
+        import os
+        axes = _parse_mesh(args.mesh)
+        need = 1
+        for v in axes.values():
+            need *= int(v)
+        # on the CPU harness the virtual device mesh must be forced
+        # BEFORE jax initializes (XLA_FLAGS lands at backend init)
+        if os.environ.get("JAX_PLATFORMS",
+                          "").strip().lower().startswith("cpu"):
+            from __graft_entry__ import _force_cpu_platform
+            _force_cpu_platform(max(need, 8))
     try:
         _ensure_backend()
     except Exception as e:
@@ -1347,11 +1430,12 @@ def main():
     if args.serve:
         _run_guarded("serve", lambda: bench_serve(
             n_requests=args.serve_requests, slots=args.serve_slots,
-            chunk=args.serve_chunk))
+            chunk=args.serve_chunk, mesh=args.mesh))
         return
     if args.decode:
         _run_guarded("decode_modes",
-                     lambda: bench_decode_modes(steps=args.steps))
+                     lambda: bench_decode_modes(steps=args.steps,
+                                                mesh=args.mesh))
         return
     if args.all:
         for name in ("resnet50", "bert", "unet", "ernie"):

@@ -218,6 +218,10 @@ from paddle_tpu.inference.aot import load_compiled, save_compiled  # noqa: E402,
 from paddle_tpu.inference.bundle import (  # noqa: E402,F401
     AotPredictor, export_decoder_bundle, export_predict_bundle,
 )
+from paddle_tpu.inference.sharding import (  # noqa: E402,F401
+    DecodeSharding, MeshMismatchError, SpeculativeMeshError,
+)
 
 __all__ += ["save_compiled", "load_compiled", "AotPredictor",
-            "export_predict_bundle", "export_decoder_bundle"]
+            "export_predict_bundle", "export_decoder_bundle",
+            "DecodeSharding", "MeshMismatchError", "SpeculativeMeshError"]

@@ -195,8 +195,28 @@ def export_decoder_bundle(decoder, out_dir: str,
     os.makedirs(out_dir, exist_ok=True)
     cfg = decoder.cfg
     p = decoder.params
+    # a mesh-built decoder exports PARTITIONED entries: the example args
+    # below are committed to their carry placements so jax.export bakes
+    # the GSPMD program (sharded weight constants included), and the
+    # topology + partition rules are recorded in decode_mode.mesh — the
+    # load side refuses a different mesh instead of crashing mid-serve
+    srd = getattr(decoder, "sharding", None)
+    hm = getattr(decoder, "_head_major", False)
+
+    def sput(x, field=None):
+        if srd is None:
+            return x
+        if field is None:
+            return srd.put(x, ())           # replicated on the mesh
+        return srd.put_state_field(field, x, hm)
+
     eng, K = None, None
     if draft_model is not None:
+        if srd is not None:
+            from paddle_tpu.inference.sharding import SpeculativeMeshError
+            raise SpeculativeMeshError(
+                "speculative bundles cannot be exported from a mesh-built "
+                "decoder (speculative decode is refused on a mesh)")
         from paddle_tpu.flags import flags
         eng = decoder._spec_engine(draft_model)
         K = int(num_speculative_tokens if num_speculative_tokens is not None
@@ -233,7 +253,7 @@ def export_decoder_bundle(decoder, out_dir: str,
             dkc, dvc = decoder._empty_cache(int(B), eng["cfg"])
             dcaches[str(int(B))] = _cache_meta(dkc)
         for S in prompt_lens:
-            ids = jnp.zeros((int(B), int(S)), jnp.int32)
+            ids = sput(jnp.zeros((int(B), int(S)), jnp.int32))
 
             def prefill(ids, kc, vc):
                 return decoder._prefill(p, ids, kc, vc)
@@ -260,12 +280,13 @@ def export_decoder_bundle(decoder, out_dir: str,
             lambda ids, kc, vc: decoder._prefill(p, ids, kc, vc),
             jnp.zeros((int(B), int(prompt_lens[0])), jnp.int32), kc, vc)[0]
         for N in decode_steps:
-            logits0 = jnp.zeros(logits_sds.shape, logits_sds.dtype)
-            pos0 = jnp.asarray(0, jnp.int32)
-            key0 = jax.random.PRNGKey(0)
-            done0 = jnp.zeros((int(B),), jnp.bool_)
-            eos0 = jnp.asarray(-1, jnp.int32)
-            temp0 = jnp.asarray(float(temperature), jnp.float32)
+            logits0 = sput(jnp.zeros(logits_sds.shape, logits_sds.dtype),
+                           "logits")
+            pos0 = sput(jnp.asarray(0, jnp.int32))
+            key0 = sput(jax.random.PRNGKey(0))
+            done0 = sput(jnp.zeros((int(B),), jnp.bool_), "done")
+            eos0 = sput(jnp.asarray(-1, jnp.int32))
+            temp0 = sput(jnp.asarray(float(temperature), jnp.float32))
             tag = f"decode_b{B}_n{N}"
             if eng is None:
                 def decode(logits, kc, vc, pos, key, done, eos, temp,
@@ -334,16 +355,17 @@ def export_decoder_bundle(decoder, out_dir: str,
                     top_k=None if top_k is None else int(top_k),
                     top_p=None if top_p is None else float(top_p))
 
-            logits0 = jnp.zeros(logits_sds.shape, logits_sds.dtype)
+            logits0 = sput(jnp.zeros(logits_sds.shape, logits_sds.dtype),
+                           "logits")
             ctag = f"decode_chunk_b{B}_t{T}"
             manifest[ctag + ".aot"] = _save_exp(
                 cdecode,
                 (logits0, kc, vc,
-                 jnp.zeros((int(B),), jnp.int32),
-                 jnp.zeros((int(B), 2), jnp.uint32),
-                 jnp.zeros((int(B),), jnp.bool_),
-                 jnp.full((int(B),), -1, jnp.int32),
-                 jnp.ones((int(B),), jnp.float32)),
+                 sput(jnp.zeros((int(B),), jnp.int32), "pos"),
+                 sput(jnp.zeros((int(B), 2), jnp.uint32), "keys"),
+                 sput(jnp.zeros((int(B),), jnp.bool_), "done"),
+                 sput(jnp.full((int(B),), -1, jnp.int32), "eos"),
+                 sput(jnp.ones((int(B),), jnp.float32), "temp")),
                 os.path.join(out_dir, ctag + ".aot"),
                 donate_argnums=(1, 2))
             chunks.append({"file": ctag + ".aot", "batch": int(B),
@@ -361,8 +383,8 @@ def export_decoder_bundle(decoder, out_dir: str,
             atag = f"admit_prefill_s{S}"
             manifest[atag + ".aot"] = _save_exp(
                 aprefill,
-                (jnp.zeros((1, int(S)), jnp.int32), kc1, vc1,
-                 jnp.asarray(1, jnp.int32)),
+                (sput(jnp.zeros((1, int(S)), jnp.int32)), kc1, vc1,
+                 sput(jnp.asarray(1, jnp.int32))),
                 os.path.join(out_dir, atag + ".aot"))
             admits.append({"file": atag + ".aot", "batch": 1,
                            "seq": int(S)})
@@ -389,6 +411,12 @@ def export_decoder_bundle(decoder, out_dir: str,
                            "state_inputs": ["logits", "kc", "vc", "pos",
                                             "keys", "done", "eos",
                                             "temp"]}
+    if srd is not None:
+        # the mesh contract: entries are partitioned programs for THIS
+        # topology (jax.export refuses other device counts outright);
+        # AotPredictor/_BundleBackend refuse a different mesh typed, at
+        # load, and rebuild the carry placements from these rules
+        mode["mesh"] = srd.describe()
     meta = {
         "kind": "llama_decoder",
         "inputs": ["input_ids"],
@@ -451,6 +479,15 @@ class AotPredictor:
         self.device = device
         self.cast_inputs = cast_inputs
         self.allow_bucket_padding = allow_bucket_padding
+        # mesh-exported bundles: rebuild the recorded sharding (raises a
+        # typed MeshMismatchError when this process cannot host the
+        # topology — "refuse at load", never a mid-serve device crash);
+        # serving state and fed arrays are then committed to the mesh
+        self._sharding = None
+        mesh_rec = (self.meta.get("decode_mode") or {}).get("mesh")
+        if mesh_rec is not None:
+            from paddle_tpu.inference.sharding import DecodeSharding
+            self._sharding = DecodeSharding.from_describe(mesh_rec)
         self.padded_calls = 0      # observability: nearest-bucket serves
         self.last_spec_stats = None  # speculative bundles: last generate's
         #                              round/acceptance totals
@@ -644,15 +681,33 @@ class AotPredictor:
             f"{[b['shapes'] for b in self.meta['buckets']]}")
 
     # -- LM decode ---------------------------------------------------------
+    def _head_major(self) -> bool:
+        """Cache row layout from the recorded shapes: head-major rows are
+        ``(B, KV, max_len, D)`` (max_len second-to-last), token-major
+        ``(B, max_len, KV, D)``."""
+        caches = self.meta.get("caches") or {}
+        for cm in caches.values():
+            shape = cm["shape"]
+            return len(shape) >= 2 and shape[-2] == self.meta["max_len"]
+        return False
+
     def _make_cache(self, B: int, which: str = "caches"):
         import jax.numpy as jnp
         cm = self.meta[which][str(B)]
         dt = jnp.dtype(cm["dtype"])
         shape = tuple(cm["shape"])
+
+        def z():
+            buf = jnp.zeros(shape, dt)
+            if self._sharding is None:
+                return buf
+            return self._sharding.put_state_field("kc", buf,
+                                                  self._head_major())
+
         if cm["n_buffers"] == 1:
-            return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
-        kc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
-        vc = tuple(jnp.zeros(shape, dt) for _ in range(cm["n_buffers"]))
+            return z(), z()
+        kc = tuple(z() for _ in range(cm["n_buffers"]))
+        vc = tuple(z() for _ in range(cm["n_buffers"]))
         return kc, vc
 
     def _decode_temp(self, temperature):
@@ -689,13 +744,23 @@ class AotPredictor:
         done = jnp.zeros((nb,), jnp.bool_)
         eos = jnp.asarray(-1 if eos_token_id is None else int(eos_token_id),
                           jnp.int32)
+        if self._sharding is not None:
+            # partitioned entries call with committed mesh arrays only
+            pos = self._sharding.put(pos, ())
+            key = self._sharding.put(key, ())
+            eos = self._sharding.put(eos, ())
+            done = self._sharding.put_state_field("done", done,
+                                                  self._head_major())
         args = (logits, kc, vc)
         if draft_caches is not None:
             args = args + tuple(draft_caches)
         args = args + (pos, key, done, eos)
         t = self._decode_temp(temperature)
         if t is not None:
-            args = args + (jnp.asarray(t, jnp.float32),)
+            t = jnp.asarray(t, jnp.float32)
+            if self._sharding is not None:
+                t = self._sharding.put(t, ())
+            args = args + (t,)
         return args
 
     def generate(self, input_ids, max_new_tokens: int,
@@ -786,6 +851,8 @@ class AotPredictor:
             fed = np.concatenate(
                 [ids, np.zeros((nb - B, S), ids.dtype)], axis=0)
         fed_d = jnp.asarray(fed, jnp.int32)
+        if self._sharding is not None:
+            fed_d = self._sharding.put(fed_d, ())
 
         def run_level(dcb):
             """One serve attempt at one decode bucket, from fresh caches

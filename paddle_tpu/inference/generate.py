@@ -96,21 +96,25 @@ def _rope_at(x, pos, cfg, p):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
 
 
-def _mm(x, p, name):
+def _mm(x, p, name, sharded=False):
     """x @ weight, transparently using the int8 weight-only path when the
     decoder quantized this matrix (weight stays int8 in HBM — half the
     weight bandwidth, which bounds small-batch decode; reference analog:
     weight_only_linear, paddle/phi/kernels/fusion/gpu/). On TPU the
     dequant happens INSIDE the Pallas matmul tile (ops/pallas/int8_matmul)
     — XLA's astype-then-dot materializes the bf16 weight and loses the
-    bandwidth win (measured slower than bf16)."""
+    bandwidth win (measured slower than bf16). Under a mesh (``sharded``)
+    the Pallas tile is skipped: the hand-written kernel has no GSPMD
+    partitioning rule, so the dequant-matmul falls back to the XLA form,
+    which shards like any dot."""
     q = p.get(name + ":int8")
     if q is not None:
         scale = p[name + ":scale"]
         lead = x.shape[:-1]
         x2 = x.reshape((-1, x.shape[-1]))
         from paddle_tpu.ops.pallas import int8_matmul as i8
-        if jax.default_backend() == "tpu" and i8.supported(x2, q):
+        if (not sharded and jax.default_backend() == "tpu"
+                and i8.supported(x2, q)):
             out = i8.int8_matmul(x2, q, scale)
         else:
             out = (x2 @ q.astype(x.dtype)) * scale.astype(x.dtype)
@@ -135,11 +139,15 @@ def _cache_update(buf, t, pos, head_major):
     return jax.lax.dynamic_update_slice(buf, t, at)
 
 
-def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
+def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
+                   sharded=False):
     """One decoder block over h (B, S, H) writing K/V into the cache at
     [pos, pos+S); attention reads the whole cache masked to < pos+S with
     causal alignment to the bottom-right (query i attends to <= pos+i).
-    ``pos``: scalar or per-row (B,) vector."""
+    ``pos``: scalar or per-row (B,) vector. ``sharded`` (trace-time
+    static): the decoder runs under a GSPMD mesh — hand-written Pallas
+    kernels (no partitioning rules) give way to the XLA forms, which
+    shard via sharding propagation."""
     B, S, _ = h.shape
     H, KV, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     pre = f"model.layers.{li}."
@@ -150,7 +158,7 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
             var + cfg.rms_norm_eps)).astype(x.dtype) * w
 
     x = rms(h, p[pre + "input_layernorm.weight"])
-    qkv = _mm(x, p, pre + "self_attn.qkv.weight")
+    qkv = _mm(x, p, pre + "self_attn.qkv.weight", sharded)
     q = qkv[..., :H * D].reshape(B, S, H, D)
     k = qkv[..., H * D:H * D + KV * D].reshape(B, S, KV, D)
     v = qkv[..., H * D + KV * D:].reshape(B, S, KV, D)
@@ -180,6 +188,7 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
     from paddle_tpu.flags import flags as _flags
     from paddle_tpu.ops.pallas import decode_attention as _da
     use_kernel = (head_major and S == 1 and jnp.ndim(pos) == 0
+                  and not sharded
                   and _flags.use_decode_attention
                   and jax.default_backend() == "tpu"
                   and _da.supported(q[:, 0], kc_l))
@@ -213,30 +222,31 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len):
         scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", attn, vv).reshape(B, S, H * D)
-    h = h + _mm(out, p, pre + "self_attn.o_proj.weight")
+    h = h + _mm(out, p, pre + "self_attn.o_proj.weight", sharded)
 
     x = rms(h, p[pre + "post_attention_layernorm.weight"])
-    gu = _mm(x, p, pre + "mlp.gate_up.weight")
+    gu = _mm(x, p, pre + "mlp.gate_up.weight", sharded)
     F_ = gu.shape[-1] // 2
     a = jax.nn.silu(gu[..., :F_]) * gu[..., F_:]
-    return h + _mm(a, p, pre + "mlp.down_proj.weight"), kc, vc
+    return h + _mm(a, p, pre + "mlp.down_proj.weight", sharded), kc, vc
 
 
 def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len,
-                    return_all: bool = False):
+                    return_all: bool = False, sharded: bool = False):
     """ids (B, S) -> logits (B, V) of the LAST position — or of ALL S
     positions (B, S, V) with ``return_all=True`` (speculative verify
     scores every drafted position in one batched forward) — plus the
     updated caches. ``pos``: scalar or per-row (B,) vector."""
     h = p["model.embed_tokens.weight"][ids]
     for li in range(cfg.num_hidden_layers):
-        h, kc, vc = _block_forward(p, cfg, li, h, kc, vc, pos, max_len)
+        h, kc, vc = _block_forward(p, cfg, li, h, kc, vc, pos, max_len,
+                                   sharded)
     var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
     h = (h.astype(jnp.float32) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
          ).astype(h.dtype) * p["model.norm.weight"]
     hh = h if return_all else h[:, -1]
     if "head:int8" in p:
-        logits = _mm(hh, p, "head").astype(jnp.float32)
+        logits = _mm(hh, p, "head", sharded).astype(jnp.float32)
     else:
         head = (p["model.embed_tokens.weight"].T if cfg.tie_word_embeddings
                 else p["lm_head.weight"])
@@ -421,7 +431,8 @@ class LlamaDecoder:
     """
 
     def __init__(self, model: LlamaForCausalLM, max_len: int = 512,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None, mesh=None,
+                 partition_rules=None):
         """weight_dtype="int8": per-output-channel weight-only quantization
         of the decoder/MLP matmul weights (embedding and final norm stay in
         the activation dtype). On TPU the dequant runs inside the Pallas
@@ -433,15 +444,53 @@ class LlamaDecoder:
         of tiny ops on a 134M model): q/k/v and gate/up are concatenated
         at init into single fused matmuls (q_proj|k_proj|v_proj ->
         'self_attn.qkv', gate|up -> 'mlp.gate_up'), and the rope tables
-        are precomputed once for max_len instead of per step."""
+        are precomputed once for max_len instead of per step.
+
+        ``mesh``: a ``ProcessMesh`` / ``jax.sharding.Mesh`` /
+        ``"dp:2,tp:4"`` spec — the decoder then runs TENSOR-PARALLEL over
+        the ``tp`` axis and batch-parallel over ``dp``
+        (inference/sharding.DecodeSharding): params are sharded by regex
+        partition rules (``partition_rules`` overrides
+        ``DEFAULT_DECODE_RULES``), the ``DecodeState`` carry — KV caches
+        on the head axis, per-row pos/keys/done on dp — lives sharded on
+        device across chunk re-entry, and every jitted entry pins its
+        carry outputs to the same placements (sharding-preserving jit).
+        Greedy and per-row-keyed sampled TOKENS are bit-exact with the
+        single-device path; speculative decode is refused with a typed
+        ``SpeculativeMeshError``."""
         if weight_dtype not in (None, "int8"):
             raise ValueError(f"weight_dtype must be None or 'int8', "
                              f"got {weight_dtype!r}")
         self.cfg = model.config
         self.max_len = max_len
         self.weight_dtype = weight_dtype
+        self.sharding = None
+        if mesh is not None:
+            from paddle_tpu.inference.sharding import DecodeSharding
+            self.sharding = (mesh if isinstance(mesh, DecodeSharding)
+                             else DecodeSharding(mesh,
+                                                 rules=partition_rules))
+        elif partition_rules is not None:
+            raise ValueError("partition_rules requires a mesh")
         self.params = _build_params(model, max_len, weight_dtype)
+        if self.sharding is not None:
+            self.params = self.sharding.shard_params(self.params)
         cfg = self.cfg
+        # trace-time statics the closures below capture: whether the
+        # programs run under GSPMD, and the cache layout's head axis
+        shd = self.sharding is not None
+        head_major = cfg.num_attention_heads != cfg.num_key_value_heads
+        self._head_major = head_major
+        srd = self.sharding
+
+        def pin_carry(logits, kc, vc, pos, keys, done):
+            """Sharding-preserving jit: carry outputs keep the carry
+            inputs' placements, so re-entry never decays to replicated
+            (no-op off-mesh)."""
+            if not shd:
+                return logits, kc, vc, pos, keys, done
+            return srd.constrain_carry(logits, kc, vc, pos, keys, done,
+                                       head_major)
         self.trace_count = 0     # python side effect: bumps only on (re)trace
         self.dispatch_count = 0  # one per device program execution
         self._spec_engines = {}  # draft-model state for speculative decode
@@ -450,13 +499,22 @@ class LlamaDecoder:
         #                              generate (also on the result array)
         self._events = []        # typed events of the in-flight generate
 
+        def pin_fwd(logits, kc, vc):
+            if not shd:
+                return logits, kc, vc
+            return (srd.constrain(logits, "logits", head_major),
+                    srd.constrain(kc, "kc", head_major),
+                    srd.constrain(vc, "vc", head_major))
+
         def prefill(p, ids, kc, vc):
             self.trace_count += 1
-            return _forward_cached(p, cfg, ids, kc, vc, 0, max_len)
+            return pin_fwd(*_forward_cached(p, cfg, ids, kc, vc, 0,
+                                            max_len, sharded=shd))
 
         def step(p, ids, kc, vc, pos):
             self.trace_count += 1
-            return _forward_cached(p, cfg, ids, kc, vc, pos, max_len)
+            return pin_fwd(*_forward_cached(p, cfg, ids, kc, vc, pos,
+                                            max_len, sharded=shd))
 
         def fused_decode(p, logits0, kc, vc, pos0, key0, done0, eos_id,
                          temperature, steps: int, do_sample: bool,
@@ -490,7 +548,8 @@ class LlamaDecoder:
                 logits, kc, vc, pos, key, done = carry
                 tok, key, done = pick(logits, key, done)
                 logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
-                                                 vc, pos, max_len)
+                                                 vc, pos, max_len,
+                                                 sharded=shd)
                 return (logits, kc, vc, pos + 1, key, done), tok
 
             (logits, _, _, _, key, done), toks = jax.lax.scan(
@@ -535,7 +594,8 @@ class LlamaDecoder:
                 logits, kc, vc, pos, keys, done = carry
                 tok, keys, done = pick(logits, keys, done)
                 logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
-                                                 vc, pos, max_len)
+                                                 vc, pos, max_len,
+                                                 sharded=shd)
                 # rows past their budget keep stepping until the chunk
                 # boundary; clamping pins their (discarded) writes to the
                 # last cache slot instead of running off the buffer
@@ -545,6 +605,11 @@ class LlamaDecoder:
             (logits, kc, vc, pos, keys, done), toks = jax.lax.scan(
                 body, (logits0, kc, vc, pos0, keys0, done0), None,
                 length=steps)
+            # the re-entry contract: the carry leaves this program with
+            # the SAME placements it arrived with (sharding-preserving
+            # jit) — chaining chunks never gathers the state to host
+            logits, kc, vc, pos, keys, done = pin_carry(
+                logits, kc, vc, pos, keys, done)
             return (jnp.moveaxis(toks, 0, 1), logits, kc, vc, pos, keys,
                     done)
 
@@ -558,10 +623,11 @@ class LlamaDecoder:
             row decodes bit-exactly like an unpadded solo generate."""
             self.trace_count += 1
             logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc, 0,
-                                                 max_len, return_all=True)
+                                                 max_len, return_all=True,
+                                                 sharded=shd)
             logits = jax.lax.dynamic_index_in_dim(
                 logits_all, true_len - 1, axis=1, keepdims=False)
-            return logits, kc, vc
+            return pin_fwd(logits, kc, vc)
 
         self._prefill = self._counted(jax.jit(prefill), "decode.prefill")
         self._step = self._counted(jax.jit(step), "decode.step")
@@ -613,7 +679,10 @@ class LlamaDecoder:
             with obs.span(site, kind="dispatch") as sp:
                 out = jitted(*args, **kwargs)
                 if _flags.obs_cost_analysis:
-                    cost = obs.dispatch_cost(site, jitted, args, kwargs)
+                    cost = obs.dispatch_cost(
+                        site, jitted, args, kwargs,
+                        num_devices=(self.sharding.size if self.sharding
+                                     else 1))
                     if cost:
                         sp.annotate(**cost)
             obs.metrics.counter(
@@ -635,15 +704,25 @@ class LlamaDecoder:
                 f"decode_cache_layout must be 'stacked' or 'per_layer', "
                 f"got {flags.decode_cache_layout!r}")
         head_major = cfg.num_attention_heads != cfg.num_key_value_heads
+
+        def z(shape):
+            buf = jnp.zeros(shape, dt)
+            if self.sharding is None:
+                return buf
+            # caches are BORN on the mesh — batch rows over dp, heads
+            # over tp — and every downstream program pins them there:
+            # the carry never exists gathered, not even at init
+            return self.sharding.put_state_field("kc", buf, head_major)
+
         if head_major:
             per = (B, cfg.num_key_value_heads, self.max_len, cfg.head_dim)
         else:
             per = (B, self.max_len, cfg.num_key_value_heads, cfg.head_dim)
         if flags.decode_cache_layout == "stacked":
             shape = (cfg.num_hidden_layers,) + per
-            return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+            return z(shape), z(shape)
         shape = per
-        zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
+        zeros = lambda: tuple(z(shape)  # noqa: E731
                               for _ in range(cfg.num_hidden_layers))
         return zeros(), zeros()
 
@@ -664,7 +743,7 @@ class LlamaDecoder:
         kc, vc = self._empty_cache(B)
         logits, kc, vc = self._prefill(self.params, ids, kc, vc)
         eos_n = _normalize_eos(eos_token_id)
-        return DecodeState(
+        state = DecodeState(
             logits=logits, kc=kc, vc=vc,
             pos=jnp.full((B,), S, jnp.int32),
             keys=jnp.asarray(jrandom.split(jrandom.PRNGKey(seed), B),
@@ -673,6 +752,11 @@ class LlamaDecoder:
             eos=jnp.full((B,), -1 if eos_n is None else int(eos_n),
                          jnp.int32),
             temp=jnp.full((B,), float(temperature), jnp.float32))
+        if self.sharding is not None:
+            # per-row fields join the mesh (batch over dp); logits and
+            # caches already came out of the prefill pinned
+            state = self.sharding.put_state(state, self._head_major)
+        return state
 
     def decode_chunk(self, state: DecodeState, num_tokens: int,
                      do_sample: bool = False, top_k: Optional[int] = None,
@@ -910,6 +994,18 @@ class LlamaDecoder:
         fallback = decode_fallback_active()
         ladder = []
         if draft_model is not None:
+            if self.sharding is not None:
+                # typed refusal at generate() time: speculative decode on
+                # a mesh either works or is refused up front — never a
+                # mid-dispatch failure the ladder would misread as
+                # transient (SpeculativeMeshError classifies fatal)
+                from paddle_tpu.inference.sharding import \
+                    SpeculativeMeshError
+                raise SpeculativeMeshError(
+                    "speculative decode does not run on a mesh yet: the "
+                    "per-row uneven cache advance has no trusted sharded "
+                    "lowering; drop draft_model or build the decoder "
+                    "without mesh=")
             from paddle_tpu.flags import flags
             K = int(num_speculative_tokens
                     if num_speculative_tokens is not None

@@ -273,13 +273,24 @@ class LlamaForCausalLM(nn.Layer):
         if decode_strategy == "sampling":
             kwargs.setdefault("do_sample", True)
         ml = max(64, need) if max_len is None else max_len
+        # mesh= routes the decode through the GSPMD tensor-parallel
+        # decoder (inference/sharding.py); the mesh topology is part of
+        # the decoder cache key — switching meshes rebuilds
+        mesh = kwargs.pop("mesh", None)
+        mesh_key = None
+        if mesh is not None:
+            from paddle_tpu.inference.sharding import DecodeSharding
+            if not isinstance(mesh, DecodeSharding):
+                mesh = DecodeSharding(mesh)
+            mesh_key = tuple(sorted(mesh.axes.items()))
         # the decoder snapshots weights: rebuild when any param buffer has
         # been swapped since (optimizer step / set_state_dict)
-        version = tuple(id(p._value) for p in self.parameters())
+        version = (tuple(id(p._value) for p in self.parameters()),
+                   mesh_key)
         dec = self.__dict__.get("_decoder")
         if (dec is None or dec.max_len < need
                 or self.__dict__.get("_decoder_version") != version):
-            dec = LlamaDecoder(self, max_len=ml)
+            dec = LlamaDecoder(self, max_len=ml, mesh=mesh)
             self.__dict__["_decoder"] = dec
             self.__dict__["_decoder_version"] = version
         return dec.generate(input_ids, max_new_tokens=max_new_tokens,

@@ -44,11 +44,20 @@ def _sig(args, kwargs) -> Tuple:
     return tuple(leaf(x) for x in flat)
 
 
-def dispatch_cost(site: str, jitted, args=(), kwargs=None
-                  ) -> Optional[dict]:
+def dispatch_cost(site: str, jitted, args=(), kwargs=None,
+                  num_devices: int = 1) -> Optional[dict]:
     """FLOPs/bytes/peak-bytes record for the program ``jitted`` compiles
     at these arguments, or ``None`` when the backend can't say. Cached
-    per (site, signature); safe to call per dispatch once obs is on."""
+    per (site, signature); safe to call per dispatch once obs is on.
+
+    ``num_devices``: mesh size at a SHARDED dispatch site (GSPMD). XLA's
+    ``cost_analysis()`` on a partitioned module reports PER-PARTITION
+    numbers (verified on this jax: a tp=4 matmul reports global/4 plus
+    the collective), so the recorded ``flops`` are already per-device —
+    the honest MFU numerator against the per-device peak. The record
+    carries ``num_devices`` and the derived ``flops_global`` so nothing
+    has to guess which scope a number is in; callers must NOT divide
+    again (that would double-count the partitioning)."""
     kwargs = kwargs or {}
     try:
         key = (site, _sig(args, kwargs))
@@ -83,6 +92,10 @@ def dispatch_cost(site: str, jitted, args=(), kwargs=None
                                      + out.get("output_bytes", 0))
         except Exception:
             pass
+        if out and int(num_devices) > 1:
+            out["num_devices"] = int(num_devices)
+            if "flops" in out:
+                out["flops_global"] = out["flops"] * int(num_devices)
         if not out:
             out = None
     except Exception:

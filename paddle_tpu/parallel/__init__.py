@@ -7,10 +7,11 @@ jax.sharding meshes and XLA sharding propagation. Higher-level surfaces
 """
 
 from paddle_tpu.parallel.mesh import (  # noqa: F401
-    ProcessMesh, auto_mesh, get_mesh, init_mesh, set_mesh,
+    ProcessMesh, auto_mesh, decode_mesh, get_mesh, init_mesh, set_mesh,
 )
 from paddle_tpu.parallel.placements import (  # noqa: F401
     Partial, Placement, ReduceType, Replicate, Shard,
+    guarded_spec, match_partition_rules, shard_by_rules,
 )
 from paddle_tpu.parallel.api import (  # noqa: F401
     dtensor_from_fn, local_shape, named_sharding, placements_to_spec,

@@ -23,7 +23,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["ProcessMesh", "init_mesh", "get_mesh", "set_mesh", "auto_mesh"]
+__all__ = ["ProcessMesh", "init_mesh", "get_mesh", "set_mesh", "auto_mesh",
+           "decode_mesh"]
 
 _GLOBAL_MESH: Optional["ProcessMesh"] = None
 
@@ -145,6 +146,38 @@ def set_mesh(mesh: Optional[ProcessMesh]) -> None:
 
 def get_mesh() -> Optional[ProcessMesh]:
     return _GLOBAL_MESH
+
+
+def decode_mesh(spec) -> ProcessMesh:
+    """Build the serving/decode mesh from a ``"dp:D,tp:T"`` flag string,
+    an ``{"dp": D, "tp": T}`` dict (ordered — axis order is the device
+    reshape order), or pass a ProcessMesh through unchanged. The ``dp``
+    axis carries batch rows (data-parallel engine replicas of the slot
+    table); ``tp`` carries attention heads / MLP hidden / vocab (the
+    Megatron-style tensor-parallel split, Pope et al.). Axis names are
+    free-form — any axes the decode partition rules don't name simply
+    replicate."""
+    if isinstance(spec, ProcessMesh):
+        return spec
+    if isinstance(spec, Mesh):
+        return ProcessMesh(spec)
+    if isinstance(spec, str):
+        axes = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise ValueError(
+                    f"mesh spec {spec!r} must be 'name:size,...' "
+                    f"(e.g. 'dp:2,tp:4'); bad segment {part!r}")
+            name, _, size = part.partition(":")
+            axes[name.strip()] = int(size)
+        spec = axes
+    if not isinstance(spec, dict) or not spec:
+        raise ValueError(f"cannot build a mesh from {spec!r}")
+    return ProcessMesh(shape=tuple(int(v) for v in spec.values()),
+                       dim_names=tuple(spec.keys()))
 
 
 def auto_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sep: int = 1) -> ProcessMesh:

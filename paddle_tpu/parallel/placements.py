@@ -17,7 +17,11 @@ mirroring how the reference's PToRReshardFunction issues an allreduce
 
 from __future__ import annotations
 
-__all__ = ["Placement", "Shard", "Replicate", "Partial", "ReduceType"]
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "ReduceType",
+           "match_partition_rules", "guarded_spec", "shard_by_rules"]
 
 
 class ReduceType:
@@ -100,3 +104,89 @@ class Partial(Placement):
 
     def __repr__(self):
         return f"Partial(reduce_type={self.reduce_type})"
+
+
+# -- regex partition rules (GSPMD param sharding) ---------------------------
+#
+# The EasyLM/fmengine ``match_partition_rules`` idiom (SNIPPETS.md): a
+# param tree is sharded by the FIRST regex that matches each leaf's name,
+# each rule carrying one PartitionSpec-style entry per tensor dim. Scalars
+# and single-element leaves always replicate. The decode/serving stack
+# (inference/sharding.py) builds its tensor-parallel plan on these.
+
+def match_partition_rules(rules: Sequence[Tuple[str, Sequence]],
+                          params: Dict[str, object]) -> Dict[str, tuple]:
+    """``{name: spec_entries}`` for a flat ``{name: array}`` dict, by the
+    first rule whose regex ``re.search``-matches the name. ``rules`` is
+    ``[(regex, entries), ...]`` where ``entries`` is a tuple with one
+    mesh-axis name (or None) per tensor dim — shorter/longer than the
+    leaf's rank is fine, :func:`guarded_spec` trims and pads. A name no
+    rule matches raises (end rule lists with ``(r".*", ())``)."""
+    import numpy as np
+    specs: Dict[str, tuple] = {}
+    for name, v in params.items():
+        if np.ndim(v) == 0 or int(np.prod(np.shape(v))) == 1:
+            specs[name] = ()
+            continue
+        for rx, entries in rules:
+            if re.search(rx, name) is not None:
+                specs[name] = tuple(entries)
+                break
+        else:
+            raise ValueError(f"no partition rule matches param {name!r}")
+    return specs
+
+
+def _axis_size(mesh, entry) -> int:
+    axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+    n = 1
+    for a in axes:
+        n *= mesh.dim_size(a)
+    return n
+
+
+def guarded_spec(shape: Sequence[int], entries: Sequence, mesh):
+    """Entries -> a ``PartitionSpec`` that is always legal for ``shape``
+    on ``mesh``: entries are trimmed/padded to the rank, axis names the
+    mesh doesn't carry are dropped, and an axis whose size does not
+    divide the tensor dim is dropped (replicated) — jax NamedShardings
+    refuse uneven shards, and a replicated dim is always CORRECT under
+    GSPMD (the guard trades efficiency, never numerics). Returns the
+    PartitionSpec (import-light: callers wrap in NamedSharding)."""
+    from jax.sharding import PartitionSpec as P
+    names = set(mesh.dim_names)
+    out = []
+    for d in range(len(shape)):
+        e = entries[d] if d < len(entries) else None
+        if e is None:
+            out.append(None)
+            continue
+        axes = tuple(a for a in (e if isinstance(e, (tuple, list))
+                                 else (e,)) if a in names)
+        if not axes or int(shape[d]) % _axis_size(mesh, axes) != 0:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    return P(*out)
+
+
+def shard_by_rules(params: Dict[str, object], mesh,
+                   rules: Sequence[Tuple[str, Sequence]],
+                   specs: Optional[Dict[str, tuple]] = None
+                   ) -> Dict[str, object]:
+    """``device_put`` every leaf of a flat param dict to its rule-matched
+    ``NamedSharding`` over ``mesh`` (a ProcessMesh). The returned dict is
+    fully committed to the mesh's devices — the make_shard_fns pattern of
+    SNIPPETS.md, minus the pjit ceremony jax no longer needs."""
+    import jax
+    from jax.sharding import NamedSharding
+    specs = match_partition_rules(rules, params) if specs is None else specs
+    out = {}
+    for name, v in params.items():
+        ns = NamedSharding(mesh.jax_mesh,
+                           guarded_spec(getattr(v, "shape", ()),
+                                        specs[name], mesh))
+        out[name] = jax.device_put(v, ns)
+    return out

@@ -29,6 +29,16 @@ down to the per-token rung (T single-step dispatches on the SAME carry
 — no in-flight request is dropped, since a failed dispatch never
 consumed the state) with a typed ``DegradationEvent``, and the events
 land on each affected request's result record.
+
+Mesh serving (inference/sharding.py): a decoder built with ``mesh=`` —
+or a bundle exported from one — serves TENSOR-PARALLEL over the ``tp``
+axis with the batch (the slot table) on ``dp``. The ``DecodeState``
+carry stays sharded on device across chunks AND across admission (the
+row-scatter runs under the same NamedShardings), the per-token
+degradation rung re-enters the same sharded carry, and ``status()``
+reports the live topology + carry placements. ``mesh=`` on the engine
+is a cross-check only: it must match the backend's, typed
+``MeshMismatchError`` otherwise.
 """
 
 from __future__ import annotations
@@ -47,9 +57,8 @@ from paddle_tpu.serving.scheduler import Request, Scheduler
 __all__ = ["ServingEngine"]
 
 
-@jax.jit
-def _admit_row_jit(logits, kc, vc, pos, keys, done, eos, temp,
-                   logits1, kc1, vc1, slot, pos1, key1, eos1, temp1):
+def _admit_row(logits, kc, vc, pos, keys, done, eos, temp,
+               logits1, kc1, vc1, slot, pos1, key1, eos1, temp1):
     """Scatter one freshly prefilled request (batch-1 row state) into the
     batch carry at ``slot``. ``slot`` is a traced scalar — one compiled
     program serves every slot index. One fused update program instead of
@@ -73,15 +82,58 @@ def _admit_row_jit(logits, kc, vc, pos, keys, done, eos, temp,
     return logits, kc, vc, pos, keys, done, eos, temp
 
 
+_admit_row_jit = jax.jit(_admit_row)
+
+
+def _as_sharding(mesh):
+    from paddle_tpu.inference.sharding import DecodeSharding
+    return mesh if isinstance(mesh, DecodeSharding) else DecodeSharding(mesh)
+
+
+def _make_admit_fn(sharding, head_major):
+    """The admission scatter for one engine. Off-mesh: the shared module
+    jit. On a mesh: a jit that pins every output to the carry's
+    NamedShardings — the row-scatter runs UNDER the same placements as
+    the chunk program (the replicated batch-1 row state lands in the
+    dp/tp-sharded carry on device; no gather, no placement decay)."""
+    if sharding is None:
+        return _admit_row_jit
+
+    @jax.jit
+    def admit(*args):
+        logits, kc, vc, pos, keys, done, eos, temp = _admit_row(*args)
+        logits, kc, vc, pos, keys, done = sharding.constrain_carry(
+            logits, kc, vc, pos, keys, done, head_major)
+        eos = sharding.constrain(eos, "eos", head_major)
+        temp = sharding.constrain(temp, "temp", head_major)
+        return logits, kc, vc, pos, keys, done, eos, temp
+
+    return admit
+
+
 class _DecoderBackend:
     """In-process backend: the jitted chunk/admission entries of a
     ``LlamaDecoder``."""
 
-    def __init__(self, dec, num_slots, chunk_size, do_sample, top_k, top_p):
+    def __init__(self, dec, num_slots, chunk_size, do_sample, top_k, top_p,
+                 mesh=None):
+        from paddle_tpu.inference.sharding import MeshMismatchError
         self.dec = dec
         self.num_slots = int(num_slots)
         self.max_len = dec.max_len
         self.prompt_buckets = None          # any pow2 bucket compiles
+        self.sharding = dec.sharding        # the decoder's mesh governs
+        self.head_major = getattr(dec, "_head_major", False)
+        if mesh is not None:
+            want = _as_sharding(mesh)
+            if self.sharding is None:
+                raise MeshMismatchError(
+                    f"engine asked for mesh {want.axes} but the decoder "
+                    f"was built without one; pass mesh= to LlamaDecoder")
+            if not self.sharding.same_topology(want):
+                raise MeshMismatchError(
+                    f"engine mesh {want.axes} does not match the "
+                    f"decoder's {self.sharding.axes}")
         self._kw = dict(
             do_sample=bool(do_sample),
             top_k=None if top_k is None else int(top_k),
@@ -98,8 +150,8 @@ class _DecoderBackend:
 
         from paddle_tpu.inference.generate import DecodeState
         B = self.num_slots
-        kc, vc = self.dec._empty_cache(B)
-        return DecodeState(
+        kc, vc = self.dec._empty_cache(B)   # born sharded under a mesh
+        st = DecodeState(
             logits=jnp.zeros((B, self.dec.cfg.vocab_size), jnp.float32),
             kc=kc, vc=vc,
             pos=jnp.zeros((B,), jnp.int32),
@@ -107,6 +159,9 @@ class _DecoderBackend:
             done=jnp.ones((B,), jnp.bool_),    # every slot starts free
             eos=jnp.full((B,), -1, jnp.int32),
             temp=jnp.ones((B,), jnp.float32))
+        if self.sharding is not None:
+            st = self.sharding.put_state(st, self.head_major)
+        return st
 
     def admit_prefill(self, ids, true_len):
         import jax.numpy as jnp
@@ -139,11 +194,30 @@ class _BundleBackend:
     serving process runs no model Python (``decode_mode.chunked``)."""
 
     def __init__(self, pred, num_slots, chunk_size, do_sample, top_k,
-                 top_p):
+                 top_p, mesh=None):
+        from paddle_tpu.inference.sharding import MeshMismatchError
         self.pred = pred
         self.num_slots = int(num_slots)
         meta = pred.meta
         mode = meta.get("decode_mode") or {}
+        # the mesh contract travels in bundle.json: a bundle exported
+        # under a mesh only serves that topology (its StableHLO entries
+        # are partitioned programs), and an engine that asks for a mesh
+        # refuses a single-device bundle — typed, at load, never a
+        # mid-serve device-count crash
+        self.sharding = pred._sharding      # from decode_mode.mesh
+        self.head_major = pred._head_major()
+        if mesh is not None:
+            want = _as_sharding(mesh)
+            if self.sharding is None:
+                raise MeshMismatchError(
+                    f"engine asked for mesh {want.axes} but this bundle "
+                    f"was exported without one; re-export from a "
+                    f"mesh-built LlamaDecoder")
+            if not self.sharding.same_topology(want):
+                raise MeshMismatchError(
+                    f"engine mesh {want.axes} does not match the "
+                    f"bundle's recorded {self.sharding.axes}")
         ch = mode.get("chunked")
         if not ch:
             raise ValueError(
@@ -187,8 +261,8 @@ class _BundleBackend:
 
         from paddle_tpu.inference.generate import DecodeState
         B = self.num_slots
-        kc, vc = self.pred._make_cache(B)
-        return DecodeState(
+        kc, vc = self.pred._make_cache(B)   # sharded when meta says so
+        st = DecodeState(
             logits=jnp.zeros((B, self._vocab),
                              jnp.dtype(self._logits_dtype)),
             kc=kc, vc=vc,
@@ -197,6 +271,9 @@ class _BundleBackend:
             done=jnp.ones((B,), jnp.bool_),
             eos=jnp.full((B,), -1, jnp.int32),
             temp=jnp.ones((B,), jnp.float32))
+        if self.sharding is not None:
+            st = self.sharding.put_state(st, self.head_major)
+        return st
 
     def admit_prefill(self, ids, true_len):
         import jax.numpy as jnp
@@ -205,10 +282,14 @@ class _BundleBackend:
             raise ValueError(f"no admit_prefill bucket for prompt bucket "
                              f"{S}; exported: {self.prompt_buckets}")
         kc1, vc1 = self.pred._make_cache(1)
+        ids_d = jnp.asarray(np.asarray(ids), jnp.int32)
+        tl = jnp.asarray(int(true_len), jnp.int32)
+        if self.sharding is not None:
+            # partitioned admit entries take committed mesh arrays
+            ids_d = self.sharding.put(ids_d, ())
+            tl = self.sharding.put(tl, ())
         return self.pred._run_entry(
-            self._admit[S], "bundle.admit_prefill",
-            jnp.asarray(np.asarray(ids), jnp.int32), kc1, vc1,
-            jnp.asarray(int(true_len), jnp.int32))
+            self._admit[S], "bundle.admit_prefill", ids_d, kc1, vc1, tl)
 
     def _run(self, fname, site, st):
         toks, logits, kc, vc, pos, keys, done = self.pred._run_entry(
@@ -228,15 +309,16 @@ class _BundleBackend:
         return self._step_file is not None
 
 
-def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p):
+def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p,
+                  mesh=None):
     from paddle_tpu.inference.bundle import AotPredictor
     from paddle_tpu.inference.generate import LlamaDecoder
     if isinstance(backend, LlamaDecoder):
         return _DecoderBackend(backend, num_slots, chunk_size, do_sample,
-                               top_k, top_p)
+                               top_k, top_p, mesh=mesh)
     if isinstance(backend, AotPredictor):
         return _BundleBackend(backend, num_slots, chunk_size, do_sample,
-                              top_k, top_p)
+                              top_k, top_p, mesh=mesh)
     raise TypeError(
         f"backend must be a LlamaDecoder or an AotPredictor, "
         f"got {type(backend).__name__}")
@@ -277,16 +359,24 @@ class ServingEngine:
                  top_p: Optional[float] = None, policy: str = "fifo",
                  prompt_buckets: Optional[Sequence[int]] = None,
                  slo_targets: Optional[Dict[str, Dict[str, float]]]
-                 = None):
+                 = None, mesh=None):
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
         self.chunk_size = int(chunk_size)
         self._b = _make_backend(backend, num_slots, chunk_size, do_sample,
-                                top_k, top_p)
+                                top_k, top_p, mesh=mesh)
+        # on a mesh the slot table maps onto the dp axis: contiguous
+        # blocks of num_slots/dp rows are one data-parallel replica's
+        # slots (jax shards a dim into contiguous blocks); the scheduler
+        # carries the grouping for status/placement introspection
+        srd = self._b.sharding
+        dp = srd.dp_shards(self.num_slots) if srd is not None else 1
         self.scheduler = Scheduler(
             num_slots, policy=policy,
-            prompt_buckets=prompt_buckets or self._b.prompt_buckets)
+            prompt_buckets=prompt_buckets or self._b.prompt_buckets,
+            dp_size=dp)
+        self._admit_fn = _make_admit_fn(srd, self._b.head_major)
         self.state = self._b.new_state()
         self._next_id = 0
         self._results: Dict[int, Any] = {}
@@ -490,7 +580,7 @@ class ServingEngine:
         key1 = jnp.asarray(jrandom.split(jrandom.PRNGKey(req.seed), 1)[0],
                            jnp.uint32)
         st = self.state
-        (logits, kc, vc, pos, keys, done, eos, temp) = _admit_row_jit(
+        (logits, kc, vc, pos, keys, done, eos, temp) = self._admit_fn(
             st.logits, st.kc, st.vc, st.pos, st.keys, st.done, st.eos,
             st.temp, logits1, kc1, vc1,
             jnp.asarray(slot_idx, jnp.int32), jnp.asarray(S, jnp.int32),
@@ -680,6 +770,7 @@ class ServingEngine:
         return {
             "num_slots": self.num_slots,
             "chunk_size": self.chunk_size,
+            "mesh": self._mesh_status(),
             "slots": slots,
             "occupancy_now": len(occupied) / self.num_slots,
             "queue_depth": len(self.scheduler),
@@ -696,6 +787,29 @@ class ServingEngine:
             },
             "slo_targets": self.slo_targets,
         }
+
+    def _mesh_status(self) -> Optional[Dict[str, Any]]:
+        """/statusz mesh block: the topology the engine serves on plus
+        the LIVE carry's per-axis placements (read off the actual device
+        arrays — evidence the state is sharded right now, not a config
+        echo) and the dp slot grouping. ``None`` off-mesh."""
+        srd = self._b.sharding
+        if srd is None:
+            return None
+        from paddle_tpu.inference.sharding import DecodeSharding
+        st = self.state
+        kc0 = st.kc[0] if isinstance(st.kc, tuple) else st.kc
+        d = srd.describe()
+        d.pop("partition_rules", None)      # statusz stays small; rules
+        #                                     live in bundle.json/README
+        d["carry_sharding"] = {
+            "logits": DecodeSharding.spec_str(st.logits),
+            "kv_cache": DecodeSharding.spec_str(kc0),
+            "pos": DecodeSharding.spec_str(st.pos),
+            "keys": DecodeSharding.spec_str(st.keys),
+        }
+        d["dp_slot_groups"] = self.scheduler.dp_groups()
+        return d
 
     def start_exporter(self, port: Optional[int] = None) -> int:
         """Start the live telemetry plane (obs/exporter.py) over this

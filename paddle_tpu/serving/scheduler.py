@@ -134,11 +134,18 @@ class Scheduler:
     admitted request and scatters its row into the decode carry."""
 
     def __init__(self, num_slots: int, policy: str = "fifo",
-                 prompt_buckets: Optional[Sequence[int]] = None):
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 dp_size: int = 1):
         if policy not in ("fifo", "priority"):
             raise ValueError(f"policy must be 'fifo' or 'priority', "
                              f"got {policy!r}")
+        if dp_size < 1 or num_slots % dp_size:
+            raise ValueError(
+                f"dp_size {dp_size} must divide num_slots {num_slots} "
+                f"(each data-parallel replica owns an equal contiguous "
+                f"block of batch rows)")
         self.policy = policy
+        self.dp_size = int(dp_size)
         self.prompt_buckets = (sorted(int(b) for b in prompt_buckets)
                                if prompt_buckets else None)
         self.slots = SlotTable(num_slots)
@@ -169,3 +176,18 @@ class Scheduler:
             _, _, req = heapq.heappop(self._heap)
             out.append((self.slots.occupy(req), req))
         return out
+
+    def dp_groups(self) -> List[dict]:
+        """How the slot table maps onto the mesh's ``dp`` axis: jax
+        shards the batch dim into contiguous equal blocks, so replica i
+        of ``dp_size`` owns slots [i*B/dp, (i+1)*B/dp) — each group is
+        one data-parallel engine replica's rows. Per-group occupancy is
+        the load-balance signal dp-aware admission will read (a replica
+        whose block is all free idles its devices through every chunk)."""
+        per = len(self.slots) // self.dp_size
+        groups = []
+        for i in range(self.dp_size):
+            idx = list(range(i * per, (i + 1) * per))
+            occ = sum(1 for j in idx if self.slots.entries[j] is not None)
+            groups.append({"dp": i, "slots": idx, "occupied": occ})
+        return groups

@@ -624,3 +624,142 @@ def test_model_generate_speculative_surface_and_flag_default():
         np.testing.assert_array_equal(out2, plain)
     finally:
         paddle.set_flags({"decode_speculative_tokens": 4})
+
+
+# -- mesh-sharded decode (GSPMD tensor parallelism) -------------------------
+#
+# The conftest forces an 8-virtual-device CPU platform, so a 2x4 {dp,tp}
+# mesh is always available. Parity is asserted at TOKEN level: sharded
+# matmuls reassociate float reductions (logits differ in ulps), but the
+# argmax/categorical picks — the decode OUTPUT — must be bit-exact.
+
+def _mesh(shape=(2, 4)):
+    from paddle_tpu.parallel import ProcessMesh
+    return ProcessMesh(shape=shape, dim_names=("dp", "tp"))
+
+
+def _spec_axes(x):
+    """Mesh axis names a live array is actually sharded over."""
+    axes = set()
+    for e in tuple(getattr(x.sharding, "spec", ()) or ()):
+        if e is None:
+            continue
+        axes.update(e if isinstance(e, (tuple, list)) else (e,))
+    return axes
+
+
+@pytest.fixture(scope="module")
+def mesh_pair():
+    """One model, two decoders: the single-device reference and the
+    2x4 {dp,tp}-sharded one (params sharded by the decode partition
+    rules, carry sharded on device)."""
+    model = _model(30)
+    ref = LlamaDecoder(model, max_len=32)
+    sh = LlamaDecoder(model, max_len=32, mesh=_mesh((2, 4)))
+    return ref, sh
+
+
+def test_sharded_decode_chunk_reentry_bitexact_greedy(mesh_pair):
+    """decode_chunk re-entry on the 2x4 mesh == the unsharded
+    run-to-completion path, bit-exact, and the carry STAYS sharded
+    across chunks (inspected via .sharding — never gathered to host)."""
+    ref, sh = mesh_pair
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 64, (2, 5))
+    want = np.asarray(ref.generate(prompt, max_new_tokens=12))
+
+    st = sh.init_decode_state(prompt)
+    assert "dp" in _spec_axes(st.kc), st.kc.sharding
+    assert _spec_axes(st.pos) == {"dp"}
+    assert _spec_axes(st.logits) == {"dp", "tp"}
+    kc_spec0 = st.kc.sharding
+    t1, st = sh.decode_chunk(st, 5)
+    # re-entry contract: same placements out as in
+    assert st.kc.sharding.is_equivalent_to(kc_spec0, st.kc.ndim)
+    assert "dp" in _spec_axes(st.kc)
+    t2, st = sh.decode_chunk(st, 7)
+    assert "dp" in _spec_axes(st.kc)
+    got = np.concatenate([prompt, np.asarray(t1), np.asarray(t2)], axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sharded_decode_chunk_bitexact_sampled(mesh_pair):
+    """Per-row-keyed sampling on the mesh draws the SAME tokens as the
+    unsharded chunked path (the admission contract survives sharding)."""
+    ref, sh = mesh_pair
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 64, (2, 5))
+    kw = dict(do_sample=True, top_k=8, temperature=0.8, seed=3,
+              chunk_size=4)
+    a = np.asarray(ref.generate(prompt, 10, **kw))
+    b = np.asarray(sh.generate(prompt, 10, **kw))
+    np.testing.assert_array_equal(a, b)
+    # and a different chunk slicing on the mesh changes nothing
+    c = np.asarray(sh.generate(prompt, 10, **{**kw, "chunk_size": 7}))
+    np.testing.assert_array_equal(a, c)
+
+
+def test_sharded_full_generate_modes_parity(mesh_pair):
+    """The fused one-dispatch path under the mesh: greedy, greedy+eos
+    and sampled each match the single-device decoder token-for-token
+    (dispatch accounting unchanged: prefill + ONE fused dispatch)."""
+    ref, sh = mesh_pair
+    prompt = np.array([[1, 2, 3], [4, 5, 6]])
+    free = np.asarray(ref.generate(prompt, max_new_tokens=12))
+    eos = int(free[0, 5])
+    for kw in (dict(), dict(eos_token_id=eos),
+               dict(do_sample=True, temperature=0.8, top_k=8, seed=1)):
+        d0 = sh.dispatch_count
+        got = np.asarray(sh.generate(prompt, max_new_tokens=12, **kw))
+        assert sh.dispatch_count - d0 == 2, kw
+        want = np.asarray(ref.generate(prompt, max_new_tokens=12, **kw))
+        np.testing.assert_array_equal(got, want, err_msg=str(kw))
+
+
+def test_sharded_head_axis_cache_on_2x2():
+    """On a mesh whose tp divides the KV head count the cache IS sharded
+    on the head axis (the Pope et al. tensor-parallel attention layout),
+    and re-entry keeps it there."""
+    model = _model(31)
+    ref = LlamaDecoder(model, max_len=32)
+    sh = LlamaDecoder(model, max_len=32, mesh=_mesh((2, 2)))
+    prompt = np.array([[5, 6, 7], [8, 9, 10]])
+    st = sh.init_decode_state(prompt)
+    # stacked head-major cache (L, B, KV, max_len, D): dp on B, tp on KV
+    assert _spec_axes(st.kc) == {"dp", "tp"}
+    assert tuple(st.kc.sharding.spec)[1:3] == ("dp", "tp")
+    toks, st = sh.decode_chunk(st, 8)
+    assert _spec_axes(st.kc) == {"dp", "tp"}
+    want = np.asarray(ref.generate(prompt, max_new_tokens=8))
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(toks)], axis=1), want)
+
+
+def test_sharded_speculative_refused_typed(mesh_pair):
+    """Speculative decode on a mesh is refused with a typed error at
+    generate() time — never a mid-dispatch failure the resilience
+    ladder would chew on (SpeculativeMeshError classifies fatal)."""
+    from paddle_tpu.inference.sharding import SpeculativeMeshError
+    from paddle_tpu.runtime.resilience import classify_error
+    _, sh = mesh_pair
+    prompt = np.array([[1, 2, 3]])
+    with pytest.raises(SpeculativeMeshError, match="mesh"):
+        sh.generate(prompt, max_new_tokens=4, draft_model="skip:1",
+                    num_speculative_tokens=2)
+    try:
+        sh.generate(prompt, max_new_tokens=4, draft_model="skip:1")
+    except SpeculativeMeshError as e:
+        assert classify_error(e) != "transient"
+
+
+def test_model_generate_mesh_surface(mesh_pair):
+    """The GenerationMixin surface threads mesh= through to the decoder
+    (topology is part of the decoder cache key) and stays bit-exact."""
+    model = _model(32)
+    prompt = np.array([[1, 2, 3]])
+    plain = np.asarray(model.generate(prompt, max_new_tokens=6))
+    out = np.asarray(model.generate(prompt, max_new_tokens=6,
+                                    mesh=_mesh((2, 2))))
+    np.testing.assert_array_equal(out, plain)
+    assert model._decoder.sharding is not None
+    assert model._decoder.sharding.axes == {"dp": 2, "tp": 2}

@@ -229,6 +229,33 @@ def test_dispatch_cost_cached_per_signature():
     assert c2["flops"] > c1["flops"]               # new signature, new entry
 
 
+def test_dispatch_cost_is_per_device_under_sharding():
+    """The honest-MFU contract at sharded sites: XLA's cost_analysis on
+    a PARTITIONED program reports per-partition FLOPs, so the recorded
+    ``flops`` must come out close to global/num_devices — NOT the global
+    count (which would inflate per-device MFU by the mesh size) — with
+    ``num_devices``/``flops_global`` alongside for the global view."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import ProcessMesh
+    mesh = ProcessMesh(shape=(4,), dim_names=("tp",))
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.zeros((128, 256))
+    b = jnp.zeros((256, 128))
+    base = obs.dispatch_cost("t.unsharded", f, (a, b), {})
+    if base is None:
+        pytest.skip("cost_analysis unavailable on this backend")
+    ash = jax.device_put(a, NamedSharding(mesh.jax_mesh, P(None, "tp")))
+    bsh = jax.device_put(b, NamedSharding(mesh.jax_mesh, P("tp", None)))
+    c = obs.dispatch_cost("t.sharded", f, (ash, bsh), {}, num_devices=4)
+    assert c is not None and c["num_devices"] == 4
+    # per-partition: global/4 plus the all-reduce — far below global
+    assert c["flops"] < base["flops"] * 0.5, (c, base)
+    assert c["flops_global"] == c["flops"] * 4
+
+
 # -- serving timeline --------------------------------------------------------
 
 def test_serving_timeline_complete_and_accounted(obs_on, dec):

@@ -376,3 +376,201 @@ def test_chunk_size_sweep(dec):
         for i, rid in enumerate(ids):
             np.testing.assert_array_equal(np.asarray(res[rid]), solo[i],
                                           err_msg=f"slots={slots} T={T}")
+
+
+# -- mesh-sharded serving (GSPMD tensor parallelism) ------------------------
+#
+# The conftest's 8-virtual-device CPU platform hosts a 2x2 {dp,tp} mesh:
+# tp divides the test config's 2 KV heads (head-axis-sharded caches) and
+# dp divides the 4-slot batch (the slot table maps onto dp replicas).
+# Parity is token-level bit-exactness vs the single-device path.
+
+def _mesh(shape=(2, 2)):
+    from paddle_tpu.parallel import ProcessMesh
+    return ProcessMesh(shape=shape, dim_names=("dp", "tp"))
+
+
+def _spec_axes(x):
+    axes = set()
+    for e in tuple(getattr(x.sharding, "spec", ()) or ()):
+        if e is None:
+            continue
+        axes.update(e if isinstance(e, (tuple, list)) else (e,))
+    return axes
+
+
+@pytest.fixture(scope="module")
+def shdec():
+    """A 2x2 {dp,tp}-sharded decoder over the SAME weights as the
+    module's unsharded ``dec`` fixture (same paddle.seed)."""
+    return LlamaDecoder(_model(), max_len=64, mesh=_mesh((2, 2)))
+
+
+def test_sharded_engine_parity_and_carry_stays_sharded(dec, shdec):
+    """The serving tentpole: requests served over the sharded carry are
+    bit-exact vs solo unsharded generates, the DecodeState stays sharded
+    through admission row-scatters, chunk re-entries and retirement
+    (asserted via .sharding), and the dispatch accounting is unchanged."""
+    rng = np.random.default_rng(40)
+    reqs = _mixed_requests(rng, 8, eos_every=3, dec=dec)
+    solo = [np.asarray(dec.generate(p[None], n, eos_token_id=e))
+            for p, n, e in reqs]
+    eng = ServingEngine(shdec, num_slots=4, chunk_size=4)
+    assert _spec_axes(eng.state.kc) == {"dp", "tp"}
+    ids = [eng.submit(p, n, eos_token_id=e) for p, n, e in reqs]
+    seen_specs = set()
+    finished = {}
+    while len(finished) < len(reqs):
+        for rid, res in eng.step():
+            finished[rid] = res
+        # between EVERY step the carry is still on the mesh: admission
+        # scatters and harvests never gathered it
+        seen_specs.add(str(eng.state.kc.sharding.spec))
+        assert "dp" in _spec_axes(eng.state.kc)
+        assert _spec_axes(eng.state.pos) == {"dp"}
+    assert len(seen_specs) == 1, f"carry placement drifted: {seen_specs}"
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(finished[rid]), solo[i])
+    m = eng.metrics()
+    assert m["prefill_dispatches"] == len(reqs)
+    assert m["step_dispatches"] == 0
+
+
+def test_sharded_engine_status_reports_mesh(shdec):
+    eng = ServingEngine(shdec, num_slots=4, chunk_size=4)
+    st = eng.status()
+    mesh = st["mesh"]
+    assert mesh["axes"] == {"dp": 2, "tp": 2}
+    assert mesh["size"] == 4
+    assert mesh["device_kind"]
+    cs = mesh["carry_sharding"]
+    assert "dp" in cs["kv_cache"] and "tp" in cs["kv_cache"]
+    assert "dp" in cs["pos"]
+    # the slot table maps onto the dp axis: 2 replicas x 2 slots
+    assert [g["slots"] for g in mesh["dp_slot_groups"]] == [[0, 1], [2, 3]]
+    # unsharded engines report mesh: null (statusz schema stays stable)
+    from paddle_tpu.inference.generate import LlamaDecoder as _LD
+    eng2 = ServingEngine(_LD(_model(), max_len=32), num_slots=2,
+                         chunk_size=4)
+    assert eng2.status()["mesh"] is None
+
+
+def test_sharded_engine_sampled_matches_unsharded_engine(dec, shdec):
+    """Sampled serving: per-row key streams make the tokens a function
+    of the request seed alone — the sharded engine and an unsharded
+    engine of a DIFFERENT shape draw identical tokens."""
+    rng = np.random.default_rng(41)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 8)),)),
+             int(rng.integers(3, 9)), i, 0.7 + 0.2 * (i % 3))
+            for i in range(6)]
+    outs = []
+    for backend, slots, T in ((dec, 3, 3), (shdec, 4, 5)):
+        eng = ServingEngine(backend, num_slots=slots, chunk_size=T,
+                            do_sample=True, top_k=8)
+        ids = [eng.submit(p, n, seed=s, temperature=t)
+               for p, n, s, t in reqs]
+        res = eng.drain()
+        outs.append([np.asarray(res[r]) for r in ids])
+    for a, b in zip(*outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_mesh_argument_mismatch_refusals(dec, shdec):
+    from paddle_tpu.inference.sharding import MeshMismatchError
+    # engine mesh vs unsharded decoder: typed refusal
+    with pytest.raises(MeshMismatchError, match="without"):
+        ServingEngine(dec, num_slots=2, chunk_size=4, mesh=_mesh((2, 2)))
+    # engine mesh vs a different decoder topology: typed refusal
+    with pytest.raises(MeshMismatchError, match="match"):
+        ServingEngine(shdec, num_slots=4, chunk_size=4,
+                      mesh=_mesh((1, 2)))
+    # matching mesh: accepted
+    eng = ServingEngine(shdec, num_slots=4, chunk_size=4,
+                        mesh=_mesh((2, 2)))
+    assert eng.status()["mesh"]["axes"] == {"dp": 2, "tp": 2}
+
+
+def test_sharded_bundle_records_mesh_and_refuses_mismatch(dec, shdec,
+                                                          tmp_path):
+    """export_decoder_bundle from a mesh-built decoder records the
+    topology + partition rules in decode_mode.mesh; the engine serves it
+    bit-exactly over the sharded StableHLO entries; mismatched meshes
+    and impossible device counts refuse TYPED at load."""
+    import json as _json
+
+    from paddle_tpu.inference import AotPredictor, export_decoder_bundle
+    from paddle_tpu.inference.sharding import MeshMismatchError
+    export_decoder_bundle(shdec, str(tmp_path), prompt_lens=[8],
+                          decode_steps=[8], batch_sizes=[2],
+                          chunk_sizes=[4])
+    pred = AotPredictor(str(tmp_path))
+    rec = pred.meta["decode_mode"]["mesh"]
+    assert rec["axes"] == {"dp": 2, "tp": 2}
+    assert rec["partition_rules"]
+
+    rng = np.random.default_rng(42)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 9)),)),
+             int(rng.integers(3, 9))) for _ in range(4)]
+    solo = [np.asarray(dec.generate(p[None], n)) for p, n in reqs]
+    eng = ServingEngine(pred, num_slots=2, chunk_size=4,
+                        mesh=_mesh((2, 2)))
+    ids = [eng.submit(p, n) for p, n in reqs]
+    res = eng.drain()
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(res[rid]), solo[i])
+    assert "tp" in _spec_axes(eng.state.kc)
+
+    # a different mesh against the recorded topology: typed refusal
+    with pytest.raises(MeshMismatchError, match="match"):
+        ServingEngine(pred, num_slots=2, chunk_size=4, mesh=_mesh((1, 2)))
+    # an engine mesh against an UNsharded bundle: typed refusal
+    udir = tmp_path / "unsharded"
+    export_decoder_bundle(dec, str(udir), prompt_lens=[8],
+                          decode_steps=[8], batch_sizes=[2],
+                          chunk_sizes=[4])
+    with pytest.raises(MeshMismatchError, match="without"):
+        ServingEngine(AotPredictor(str(udir)), num_slots=2, chunk_size=4,
+                      mesh=_mesh((2, 2)))
+    # a recorded topology this process cannot host: refused AT LOAD
+    meta_path = tmp_path / "bundle.json"
+    meta = _json.loads(meta_path.read_text())
+    meta["decode_mode"]["mesh"]["axes"] = {"dp": 4, "tp": 4}
+    meta_path.write_text(_json.dumps(meta))
+    with pytest.raises(MeshMismatchError, match="devices"):
+        AotPredictor(str(tmp_path))
+
+
+@pytest.mark.faults
+def test_sharded_chunk_failure_degrades_on_sharded_carry(dec, shdec):
+    """The sharded rung drill: a plan kills every 'decode.chunk'
+    dispatch mid-serve; the engine steps down to the per-token rung on
+    the SAME SHARDED carry — no gather-to-host, no dropped in-flight
+    request, greedy outputs bit-exact vs unsharded solo generates, and
+    the carry is still on the mesh afterwards."""
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.runtime.resilience import fault_injector
+
+    rng = np.random.default_rng(43)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 8)),)),
+             int(rng.integers(3, 9))) for _ in range(5)]
+    solo = [np.asarray(dec.generate(p[None], n)) for p, n in reqs]
+    set_flags({"resilience_backoff_s": 0.0})
+    fault_injector.configure([{"kind": "dispatch_error",
+                               "site": "decode.chunk",
+                               "call": 2, "times": 1000}])
+    try:
+        eng = ServingEngine(shdec, num_slots=2, chunk_size=4)
+        ids = [eng.submit(p, n) for p, n in reqs]
+        res = eng.drain()
+        for i, rid in enumerate(ids):
+            np.testing.assert_array_equal(np.asarray(res[rid]), solo[i])
+        m = eng.metrics()
+        assert m["degradations"] >= 1
+        assert m["step_dispatches"] >= eng.chunk_size
+        assert res[ids[-1]].resilience["level"] == "per_token"
+        # the rung ran on the mesh: the carry never left it
+        assert "dp" in _spec_axes(eng.state.kc)
+        assert "tp" in _spec_axes(eng.state.kc)
+    finally:
+        fault_injector.clear()
+        set_flags({"resilience_backoff_s": 0.5})

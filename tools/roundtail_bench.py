@@ -60,6 +60,15 @@ STEPS = [
                       "obs_trace_decode.json", "--json"], None),
     ("serve_obs_export", [sys.executable, "tools/roundtail_bench.py",
                           "--probe-serve-export"], None),
+    # mesh-sharded serving smoke: bench.py --serve on a 2x2 {dp,tp}
+    # VIRTUAL CPU mesh (the bench forces the host-device mesh itself
+    # under JAX_PLATFORMS=cpu) — per-request greedy parity and dispatch
+    # accounting are hard-asserted inside the bench; the probe
+    # additionally checks the record carries the mesh topology, nonzero
+    # occupancy and the per-device MFU. The next real-TPU session runs
+    # the SAME --mesh flag against physical chips unchanged.
+    ("serve_sharded", [sys.executable, "tools/roundtail_bench.py",
+                      "--probe-serve-sharded"], None),
 ]
 
 
@@ -151,9 +160,57 @@ def probe_serve_export() -> int:
     return 0 if ok else 1
 
 
+def probe_serve_sharded() -> int:
+    """The sharded-serving gate: ``bench.py --serve --mesh dp:2,tp:2``
+    on a virtual CPU mesh. Parity + dispatch accounting are asserted
+    inside the bench (rc != 0 on violation); here we assert the record
+    is honest about the mesh: topology + live carry sharding recorded,
+    occupancy nonzero, MFU reported per device."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--serve", "--mesh", "dp:2,tp:2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=1200)
+    if proc.returncode:
+        print(f"serve_sharded: bench rc={proc.returncode}")
+        return 1
+    try:
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+        serve = record["serve"]
+        mesh = serve["mesh"]
+        cont = serve["continuous"]
+    except Exception as e:
+        print(f"serve_sharded: unparseable bench record: {e}")
+        return 1
+    ok = True
+    if mesh is None or mesh.get("axes") != {"dp": 2, "tp": 2}:
+        print(f"serve_sharded: mesh not recorded: {mesh}")
+        ok = False
+    else:
+        print(f"serve_sharded: mesh {mesh['axes']} on "
+              f"{mesh.get('device_kind')}, carry "
+              f"{mesh.get('carry_sharding')}")
+    occ = cont.get("occupancy_useful", 0)
+    if not occ or occ <= 0:
+        print(f"serve_sharded: occupancy_useful {occ} not > 0")
+        ok = False
+    else:
+        print(f"serve_sharded: occupancy_useful {occ}, "
+              f"{cont['tokens_per_sec']} tok/s")
+    if "mfu_model_per_device" not in cont:
+        print("serve_sharded: no per-device MFU in the record")
+        ok = False
+    else:
+        print(f"serve_sharded: mfu_model_per_device "
+              f"{cont['mfu_model_per_device']}")
+    return 0 if ok else 1
+
+
 def main():
     if "--probe-serve-export" in sys.argv:
         return probe_serve_export()
+    if "--probe-serve-sharded" in sys.argv:
+        return probe_serve_sharded()
     os.makedirs("/tmp/roundtail", exist_ok=True)
     results = {}
     for name, cmd, env_extra in STEPS:
