@@ -1262,6 +1262,224 @@ def bench_serve(n_requests=None, slots=None, chunk=None, mesh=None):
     return line
 
 
+def bench_serve_prefix(n_groups=None, slots=None, chunk=None, mesh=None):
+    """``--serve --prefix-mix``: the prefix-cache serving benchmark.
+
+    A shared-prompt arrival mix — G "system prompts", each reused by
+    several requests with distinct suffixes, plus exact-duplicate and
+    unique cold prompts — served twice over the SAME decoder: (a) COLD,
+    prefix cache disabled (every admission recomputes its full
+    prefill), (b) CACHED, with the content-hashed slab pool + batched
+    same-bucket admission on. Reports hit rate, prefill-dispatches-
+    avoided, bytes cached and admission p50/p99 split by hit class.
+
+    Contract checks (hard asserts): every cached-run result is
+    BIT-EXACT vs a solo greedy generate (and therefore vs the cold
+    run); full-prefix-hit admissions performed ZERO prefill dispatches
+    (per-request ``admission_dispatches`` == 0 and the engine-level
+    dispatch ledger balances exactly); the cached run's prefill
+    dispatch count is STRICTLY below the cold run's; and full-hit
+    admission p50 is STRICTLY below cold(miss) admission p50. With
+    PADDLE_TPU_OBS=1 the record's ``obs`` block carries the hit-rate +
+    bytes-cached accounting (engine registry + cache stats)."""
+    import numpy as np
+
+    import jax
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        import jax.numpy as jnp
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        G = n_groups or 3
+        slots = slots or 8
+        chunk = chunk or 16
+        block, prefix_len, suffix_len, n_new = 32, 64, 16, 32
+        per_group, n_dups, n_unique = 5, 6, 4
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256)
+        G = n_groups or 3
+        slots = slots or 4
+        chunk = chunk or 4
+        block, prefix_len, suffix_len, n_new = 4, 12, 4, 6
+        per_group, n_dups, n_unique = 4, 8, 4
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
+    mesh_obj = _bench_mesh(mesh)
+    max_len = prefix_len + suffix_len + n_new + 8
+    dec = LlamaDecoder(model, max_len=max_len, mesh=mesh_obj)
+    rng = np.random.default_rng(0)
+
+    # the arrival mix, in two phases so reuse can actually hit (a
+    # prefix only serves admissions AFTER the admission that cached it):
+    # phase A seeds the pool (one leader per shared prefix + uniques),
+    # phase B is the steady-state tenant traffic (exact duplicates ->
+    # full hits; shared-prefix suffix variants -> partial hits; fresh
+    # uniques -> misses, exercising batched same-bucket admission)
+    prefixes = [rng.integers(0, cfg.vocab_size, (prefix_len,))
+                for _ in range(G)]
+    leader = [np.concatenate([pre,
+                              rng.integers(0, cfg.vocab_size,
+                                           (suffix_len,))])
+              for pre in prefixes]
+    phase_a = list(leader) + [
+        rng.integers(0, cfg.vocab_size, (prefix_len + suffix_len,))
+        for _ in range(n_unique)]
+    phase_b = []
+    for _ in range(n_dups):                       # full hits
+        phase_b.append(leader[0])
+    for g in range(G):                            # partial hits
+        for _ in range(per_group - 1):
+            phase_b.append(np.concatenate(
+                [prefixes[g], rng.integers(0, cfg.vocab_size,
+                                           (suffix_len,))]))
+    for _ in range(n_unique):                     # cold misses
+        phase_b.append(rng.integers(0, cfg.vocab_size,
+                                    (prefix_len + suffix_len,)))
+    rng.shuffle(phase_b)
+    requests = phase_a + phase_b
+    n_req = len(requests)
+    solo = [np.asarray(dec.generate(p[None], n_new)) for p in requests]
+    useful = n_req * n_new
+
+    def run(use_cache):
+        eng = ServingEngine(
+            dec, num_slots=slots, chunk_size=chunk,
+            prefix_cache=bool(use_cache),
+            prefix_cache_bytes=(1 << 30) if use_cache else None,
+            prefix_block_tokens=block if use_cache else None,
+            batch_admission=bool(use_cache))
+        t0 = time.perf_counter()
+        ids_a = [eng.submit(p, n_new, seed=i)
+                 for i, p in enumerate(phase_a)]
+        eng.drain()
+        ids_b = [eng.submit(p, n_new, seed=1000 + i)
+                 for i, p in enumerate(phase_b)]
+        eng.drain()
+        wall = time.perf_counter() - t0
+        results = [eng.result(r) for r in ids_a + ids_b]
+        return eng, results, wall
+
+    # warm every compiled program both runs hit (prefill buckets, chunk
+    # program, scatter/extract/load, suffix prefill) so the timed
+    # admission histograms measure steady state, not compiles
+    warm_eng, _, _ = run(True)
+    del warm_eng
+    run(False)
+
+    run_mark = _obs_mark()
+    eng_cold, res_cold, wall_cold = run(False)
+    eng_hot, res_hot, wall_hot = run(True)
+    m_cold, m_hot = eng_cold.metrics(), eng_hot.metrics()
+    pc = m_hot["prefix_cache"]
+
+    # -- the contract, hard-asserted ---------------------------------------
+    for i in range(n_req):
+        got_c, got_h = np.asarray(res_cold[i]), np.asarray(res_hot[i])
+        assert np.array_equal(got_c, solo[i]), \
+            f"request {i}: COLD output diverged from solo generate"
+        assert np.array_equal(got_h, solo[i]), \
+            f"request {i}: CACHED output diverged from solo generate"
+    full_recs = [r.resilience["serving"] for r in res_hot
+                 if r.resilience["serving"]["prefix_hit"] == "full"]
+    assert full_recs, "prefix mix produced no full-prefix hits"
+    assert all(r["admission_dispatches"] == 0 for r in full_recs), \
+        "a full-prefix hit issued a prefill dispatch"
+    assert pc["engine_hits_full"] >= n_dups - 1, \
+        f"expected >= {n_dups - 1} full hits, got {pc}"
+    assert pc["engine_hits_partial"] >= 1, f"no partial hits: {pc}"
+    hit_rate = (pc["engine_hits_full"] + pc["engine_hits_partial"]) \
+        / n_req
+    assert hit_rate > 0, f"hit rate 0: {pc}"
+    assert m_hot["prefill_dispatches"] < m_cold["prefill_dispatches"], \
+        f"cached prefills {m_hot['prefill_dispatches']} not below " \
+        f"cold {m_cold['prefill_dispatches']}"
+    # the admission ledger balances exactly: every non-full admission
+    # needed a prefill, minus the dispatches batching + full hits saved
+    assert m_hot["prefill_dispatches"] == (
+        pc["engine_misses"] + pc["engine_hits_partial"]
+        + pc["engine_hits_full"] - m_hot["admission_dispatches_saved"]), \
+        f"admission ledger does not balance: {m_hot}"
+    p50_full = m_hot["admission_p50_s"]["full"]
+    p50_cold = m_cold["admission_p50_s"]["miss"]
+    assert p50_full < p50_cold, \
+        f"full-hit admission p50 {p50_full} not below cold " \
+        f"admission p50 {p50_cold}"
+
+    obs_block = _obs_finish(run_mark, "obs_trace_serve_prefix.json",
+                            prefix_cache=dict(pc),
+                            hit_rate=round(hit_rate, 4),
+                            bytes_cached=pc["bytes_cached"],
+                            engine_metrics_prometheus=eng_hot.registry
+                            .to_prometheus())
+    avoided = m_cold["prefill_dispatches"] - m_hot["prefill_dispatches"]
+    print(f"serve-prefix: hit rate {hit_rate:.2f} "
+          f"({pc['engine_hits_full']} full / "
+          f"{pc['engine_hits_partial']} partial / "
+          f"{pc['engine_misses']} miss over {n_req} requests), "
+          f"prefills {m_hot['prefill_dispatches']} vs cold "
+          f"{m_cold['prefill_dispatches']} ({avoided} avoided), "
+          f"{pc['prefill_tokens_saved']} prefill tokens saved, "
+          f"{pc['bytes_cached']} bytes cached, admission p50 "
+          f"full {p50_full*1e3:.2f}ms vs cold {p50_cold*1e3:.2f}ms, "
+          f"parity checked on {n_req} requests x2", file=sys.stderr)
+    line = _emit("serving_prefix_hit_rate_pct", hit_rate * 100, "%")
+    mesh_rec = None
+    if dec.sharding is not None:
+        mesh_rec = dec.sharding.describe()
+        mesh_rec.pop("partition_rules", None)
+    line["serve_prefix"] = {
+        "config": "134M" if on_tpu else "tiny-cpu",
+        "requests": n_req, "slots": slots, "chunk_size": chunk,
+        "block_tokens": block, "prefix_len": prefix_len,
+        "groups": G, "duplicates": n_dups, "mesh": mesh_rec,
+        "cold": {
+            "prefill_dispatches": m_cold["prefill_dispatches"],
+            "wall_s": round(wall_cold, 3),
+            "tokens_per_sec": round(useful / wall_cold, 1),
+            "admission_p50_s": m_cold["admission_p50_s"]["miss"],
+            "admission_p99_s": m_cold["admission_p99_s"]["miss"],
+        },
+        "cached": {
+            "prefill_dispatches": m_hot["prefill_dispatches"],
+            "wall_s": round(wall_hot, 3),
+            "tokens_per_sec": round(useful / wall_hot, 1),
+            "hit_rate": round(hit_rate, 4),
+            "hits_full": pc["engine_hits_full"],
+            "hits_partial": pc["engine_hits_partial"],
+            "misses": pc["engine_misses"],
+            "prefill_tokens_saved": pc["prefill_tokens_saved"],
+            "admission_dispatches_saved":
+                m_hot["admission_dispatches_saved"],
+            "batched_admission_groups":
+                m_hot["batched_admission_groups"],
+            "bytes_cached": pc["bytes_cached"],
+            "slabs": pc["slabs"],
+            "evictions": pc["evictions"],
+            "admission_p50_s": m_hot["admission_p50_s"],
+            "admission_p99_s": m_hot["admission_p99_s"],
+        },
+        "prefill_dispatches_avoided": avoided,
+        "zero_dispatch_full_hits": len(full_recs),
+        "parity_checked": n_req,
+    }
+    line["obs"] = obs_block
+    # re-print the enriched record as the LAST stdout line (the driver
+    # parses the final json line; _emit already printed the bare metric)
+    print(json.dumps(line))
+    return line
+
+
 CONFIGS = {
     "moe": bench_moe,
     "llama": bench_llama,
@@ -1274,6 +1492,7 @@ CONFIGS = {
     "decode1b": bench_decode_1b,
     "decode1b_served": bench_decode_1b_served,
     "serve": bench_serve,
+    "serve_prefix": bench_serve_prefix,
 }
 
 def _run_guarded(name, fn, attempts=3, base_delay=5.0, sleep=time.sleep):
@@ -1396,6 +1615,14 @@ def main():
     ap.add_argument("--serve-requests", type=int, default=None)
     ap.add_argument("--serve-slots", type=int, default=None)
     ap.add_argument("--serve-chunk", type=int, default=None)
+    ap.add_argument("--prefix-mix", action="store_true",
+                    help="with --serve: the prefix-cache benchmark — a "
+                         "shared-prompt arrival mix served cold vs "
+                         "cached (content-hashed KV slab pool), "
+                         "reporting hit rate, prefill-dispatches-"
+                         "avoided and admission p50/p99 by hit class; "
+                         "parity and zero-dispatch full hits are "
+                         "hard-asserted in-bench")
     ap.add_argument("--mesh", default=None,
                     help="serve/decode on a device mesh, e.g. "
                          "'dp:2,tp:2': tensor-parallel decode over tp, "
@@ -1427,6 +1654,11 @@ def main():
     except Exception as e:
         _emit_failure("backend_init", e)
         sys.exit(1)
+    if args.serve and args.prefix_mix:
+        _run_guarded("serve_prefix", lambda: bench_serve_prefix(
+            slots=args.serve_slots, chunk=args.serve_chunk,
+            mesh=args.mesh))
+        return
     if args.serve:
         _run_guarded("serve", lambda: bench_serve(
             n_requests=args.serve_requests, slots=args.serve_slots,

@@ -268,6 +268,22 @@ define_flag("obs_cost_analysis", True,
             "(FLOPs, bytes, peak bytes) to dispatch spans; derived once "
             "per (site, input signature) via an AOT lower+compile — "
             "turn off to trace timing only")
+define_flag("serving_prefix_cache_bytes", 0,
+            "byte budget for the serving engine's content-hashed prefix "
+            "cache (serving/prefix_cache.py): admission consults a "
+            "device-resident, ref-counted KV slab store keyed by the "
+            "prompt's block-boundary content hashes — a full-prefix hit "
+            "admits with ZERO prefill dispatches (one row-scatter), a "
+            "partial hit prefills only the uncached suffix. 0 (default) "
+            "= disabled; the PADDLE_TPU_PREFIX_CACHE_BYTES environment "
+            "variable is an equivalent switch. Least-recently-used "
+            "unpinned slabs evict when the budget is exceeded")
+define_flag("serving_prefix_block_tokens", 64,
+            "prefix-cache hash granularity: prompts are content-hashed "
+            "at every multiple of this many tokens (plus the full "
+            "length), so two prompts sharing a prefix but diverging in "
+            "their suffixes still match at the longest common block "
+            "boundary")
 define_flag("default_dtype", "float32", "default floating point dtype")
 define_flag("allocator_stats", False, "track live tensor bytes (allocator stats analog)")
 define_flag("profiler_dir", "", "directory for profiler trace output")

@@ -377,14 +377,20 @@ def export_decoder_bundle(decoder, out_dir: str,
         kc1, vc1 = decoder._empty_cache(1)
         caches["1"] = _cache_meta(kc1)
         for S in prompt_lens:
-            def aprefill(ids, kc, vc, true_len):
-                return decoder._admit_prefill(p, ids, kc, vc, true_len)
+            # true_len/pos0 are PER-ROW (1,) runtime inputs: pos0 > 0 is
+            # the prefix-cache suffix prefill (the caches arrive
+            # preloaded with the cached prefix's KV rows [0, pos0)) — the
+            # SAME bucketed entry serves cold and cached-suffix admission
+            def aprefill(ids, kc, vc, true_len, pos0):
+                return decoder._admit_prefill(p, ids, kc, vc, true_len,
+                                              pos0)
 
             atag = f"admit_prefill_s{S}"
             manifest[atag + ".aot"] = _save_exp(
                 aprefill,
                 (sput(jnp.zeros((1, int(S)), jnp.int32)), kc1, vc1,
-                 sput(jnp.asarray(1, jnp.int32))),
+                 sput(jnp.ones((1,), jnp.int32)),
+                 sput(jnp.zeros((1,), jnp.int32))),
                 os.path.join(out_dir, atag + ".aot"))
             admits.append({"file": atag + ".aot", "batch": 1,
                            "seq": int(S)})
@@ -410,7 +416,13 @@ def export_decoder_bundle(decoder, out_dir: str,
         mode["chunked"] = {"chunk_sizes": csizes,
                            "state_inputs": ["logits", "kc", "vc", "pos",
                                             "keys", "done", "eos",
-                                            "temp"]}
+                                            "temp"],
+                           # admit entries take per-row (1,) true_len +
+                           # pos0 — the prefix-cache suffix-prefill
+                           # contract; absent on pre-prefix bundles,
+                           # whose partial hits the engine demotes to
+                           # misses
+                           "admit_pos0": True}
     if srd is not None:
         # the mesh contract: entries are partitioned programs for THIS
         # topology (jax.export refuses other device counts outright);

@@ -613,20 +613,29 @@ class LlamaDecoder:
             return (jnp.moveaxis(toks, 0, 1), logits, kc, vc, pos, keys,
                     done)
 
-        def admit_prefill(p, ids, kc, vc, true_len):
-            """Length-bucketed admission prefill: ``ids`` is ONE request
-            right-padded to its prompt bucket (one compiled program per
-            bucket, not per distinct prompt length). Returns the logits
-            of position ``true_len - 1`` — causal masking makes the
-            padded tail invisible to it, and decode overwrites the tail's
-            cache rows before they could ever unmask — so the admitted
-            row decodes bit-exactly like an unpadded solo generate."""
+        def admit_prefill(p, ids, kc, vc, true_len, pos0):
+            """Length-bucketed admission prefill: ``ids`` is a batch of
+            requests right-padded to one prompt bucket (one compiled
+            program per (batch, bucket), not per distinct prompt length).
+            ``true_len`` and ``pos0`` are PER-ROW ``(B,)`` vectors: each
+            row's tokens land in the cache at ``[pos0, pos0+S)`` and its
+            returned logits are those of position ``true_len - 1`` of the
+            bucket — causal masking makes the padded tail invisible to
+            them, and decode overwrites the tail's cache rows before they
+            could ever unmask — so the admitted row decodes bit-exactly
+            like an unpadded solo generate. ``pos0 > 0`` is the prefix-
+            cache SUFFIX prefill (serving/prefix_cache.py): ``kc``/``vc``
+            arrive preloaded with the cached prefix's KV rows ``[0,
+            pos0)`` and only the uncached suffix is computed; several
+            same-bucket admissions batch into one dispatch (per-row
+            offsets keep their prefixes independent)."""
             self.trace_count += 1
-            logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc, 0,
-                                                 max_len, return_all=True,
+            logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc,
+                                                 pos0, max_len,
+                                                 return_all=True,
                                                  sharded=shd)
-            logits = jax.lax.dynamic_index_in_dim(
-                logits_all, true_len - 1, axis=1, keepdims=False)
+            logits = jnp.take_along_axis(
+                logits_all, (true_len - 1)[:, None, None], axis=1)[:, 0]
             return pin_fwd(logits, kc, vc)
 
         self._prefill = self._counted(jax.jit(prefill), "decode.prefill")

@@ -57,6 +57,8 @@ class FlightRecorder:
     def __init__(self):
         self._lock = threading.Lock()
         self._registries: List[tuple] = []     # (name, weakref)
+        self._states: List[tuple] = []         # (name, weakref) — any
+        #                                        object with .snapshot()
         self._seq = 0
         self.last_path: Optional[str] = None
 
@@ -65,6 +67,16 @@ class FlightRecorder:
             self._registries = [
                 (n, r) for n, r in self._registries if r() is not None]
             self._registries.append((name, weakref.ref(registry)))
+
+    def add_state(self, name: str, provider) -> None:
+        """Attach any stateful component exposing ``snapshot()`` (e.g. a
+        serving prefix cache) so its live state lands in the postmortem
+        — weakref, like registries, so the recorder never extends a
+        component's lifetime."""
+        with self._lock:
+            self._states = [
+                (n, r) for n, r in self._states if r() is not None]
+            self._states.append((name, weakref.ref(provider)))
 
     def enabled(self) -> bool:
         return _obs_enabled() and bool(
@@ -101,6 +113,7 @@ class FlightRecorder:
             }
             with self._lock:
                 regs = list(self._registries)
+                states = list(self._states)
             registries = {}
             for name, ref in regs:
                 reg = ref()
@@ -110,6 +123,16 @@ class FlightRecorder:
                     except Exception:
                         pass
             record["registries"] = registries
+            state = {}
+            for name, ref in states:
+                prov = ref()
+                if prov is not None:
+                    try:
+                        state[name] = prov.snapshot()
+                    except Exception:
+                        pass
+            if state:
+                record["state"] = state
             if extra:
                 record["extra"] = extra
             if path is None:

@@ -11,6 +11,12 @@ bundle exported with ``chunk_sizes=`` (``decode_mode.chunked``).
 """
 
 from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
+from paddle_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixCache,
+    PrefixLookup,
+    PrefixSlab,
+    prefix_digests,
+)
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     Request,
     Scheduler,
@@ -19,5 +25,6 @@ from paddle_tpu.serving.scheduler import (  # noqa: F401
     bucket_length,
 )
 
-__all__ = ["ServingEngine", "Request", "Scheduler", "Slot", "SlotTable",
+__all__ = ["ServingEngine", "PrefixCache", "PrefixLookup", "PrefixSlab",
+           "prefix_digests", "Request", "Scheduler", "Slot", "SlotTable",
            "bucket_length"]

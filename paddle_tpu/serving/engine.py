@@ -15,6 +15,17 @@ one chunk dispatch per engine step that had live rows — nothing hidden.
 Admission scatters and row retirement are plain array updates outside
 the counted dispatch sites.
 
+Prefix caching (serving/prefix_cache.py, ``prefix_cache=`` /
+``FLAGS_serving_prefix_cache_bytes``): admission consults a
+content-hashed, ref-counted KV slab pool. A FULL-prefix hit admits via
+the row-scatter alone — zero prefill dispatches — a PARTIAL hit
+prefills only the uncached suffix (``admit_prefill``'s per-row
+``pos0``), and a miss populates the pool on the way through; all three
+paths are bit-exact with cold admission. ``batch_admission=`` folds
+same-bucket waiting requests into one batched prefill dispatch
+(``admission.dispatches_saved``). Both are off by default, keeping the
+one-prefill-per-request accounting above exact.
+
 Two backends serve the same scheduler:
 
 - ``LlamaDecoder`` (in-process): jitted ``_admit_prefill`` /
@@ -58,22 +69,32 @@ __all__ = ["ServingEngine"]
 
 
 def _admit_row(logits, kc, vc, pos, keys, done, eos, temp,
-               logits1, kc1, vc1, slot, pos1, key1, eos1, temp1):
-    """Scatter one freshly prefilled request (batch-1 row state) into the
-    batch carry at ``slot``. ``slot`` is a traced scalar — one compiled
-    program serves every slot index. One fused update program instead of
-    eight eager scatters; NOT a counted dispatch site (the serving
-    dispatch contract counts prefills and chunks only)."""
+               logits1, kc1, vc1, slot, src, pos1, key1, eos1, temp1):
+    """Scatter one freshly prefilled request's row state into the batch
+    carry at ``slot``. ``slot`` and ``src`` are traced scalars — one
+    compiled program serves every slot index and every source row
+    (``src`` picks the row out of ``logits1``/``kc1``/``vc1``, which may
+    be a batched admission-prefill output or a batch-1 prefix-cache
+    slab). A slab's cache buffers may be SHORTER than the carry on the
+    length axis (length-bucketed slab pool): the update writes rows
+    ``[0, bucket)`` and the stale tail past them stays causally masked
+    until decode overwrites it — the padded-admission discipline. One
+    fused update program instead of eight eager scatters; NOT a counted
+    dispatch site (the serving dispatch contract counts prefills and
+    chunks only)."""
     def put_cache(b, r):
         # batch axis: 1 for stacked (L, B, ...) buffers, 0 for per-layer
         # (B, ...) buffers — both are ndim-4 offsets from the row layout
         ax = b.ndim - 4
+        r1 = jax.lax.dynamic_slice_in_dim(r, src, 1, axis=ax)
         starts = tuple(slot if i == ax else 0 for i in range(b.ndim))
-        return jax.lax.dynamic_update_slice(b, r.astype(b.dtype), starts)
+        return jax.lax.dynamic_update_slice(b, r1.astype(b.dtype), starts)
 
     kc = jax.tree_util.tree_map(put_cache, kc, kc1)
     vc = jax.tree_util.tree_map(put_cache, vc, vc1)
-    logits = logits.at[slot].set(logits1[0].astype(logits.dtype))
+    logits = logits.at[slot].set(
+        jax.lax.dynamic_index_in_dim(logits1, src, axis=0,
+                                     keepdims=False).astype(logits.dtype))
     pos = pos.at[slot].set(pos1)
     keys = keys.at[slot].set(key1)
     done = done.at[slot].set(False)
@@ -163,12 +184,27 @@ class _DecoderBackend:
             st = self.sharding.put_state(st, self.head_major)
         return st
 
-    def admit_prefill(self, ids, true_len):
+    # any admission batch size jits its own program; suffix prefills
+    # (pos0 > 0) are native to the in-process entry
+    admit_batch_any = True
+    admit_pos0 = True
+
+    def empty_cache(self, B: int):
+        return self.dec._empty_cache(int(B))
+
+    def admit_prefill(self, ids, true_len, pos0, kc=None, vc=None):
+        """One (possibly batched) admission-prefill dispatch: ``ids``
+        (N, bucket) right-padded rows, per-row ``true_len``/``pos0``.
+        ``kc``/``vc`` default to fresh batch-N caches; the prefix-cache
+        path passes caches preloaded with each row's slab."""
         import jax.numpy as jnp
-        kc1, vc1 = self.dec._empty_cache(1)
+        ids = np.asarray(ids)
+        if kc is None:
+            kc, vc = self.dec._empty_cache(int(ids.shape[0]))
         return self.dec._admit_prefill(
-            self.dec.params, jnp.asarray(np.asarray(ids), jnp.int32),
-            kc1, vc1, jnp.asarray(int(true_len), jnp.int32))
+            self.dec.params, jnp.asarray(ids, jnp.int32), kc, vc,
+            jnp.asarray(np.asarray(true_len), jnp.int32),
+            jnp.asarray(np.asarray(pos0), jnp.int32))
 
     def _run(self, entry, st, steps):
         toks, logits, kc, vc, pos, keys, done = entry(
@@ -246,6 +282,7 @@ class _BundleBackend:
         self._step_file = by_chunk.get(1)
         self._admit = {b["seq"]: b["file"]
                        for b in meta["admit_prefill_buckets"]}
+        self.admit_pos0 = bool(ch.get("admit_pos0"))
         self.prompt_buckets = sorted(self._admit)
         self._logits_dtype = meta.get("logits_dtype", "float32")
         self._vocab = meta["vocab_size"]
@@ -275,21 +312,50 @@ class _BundleBackend:
             st = self.sharding.put_state(st, self.head_major)
         return st
 
-    def admit_prefill(self, ids, true_len):
+    # bundle admit entries are fixed batch-1 StableHLO modules; suffix
+    # prefills need the pos0-taking entries (decode_mode.chunked
+    # admit_pos0 — absent on pre-prefix bundles, whose partial hits the
+    # engine demotes to misses)
+    admit_batch_any = False
+
+    def empty_cache(self, B: int):
+        return self.pred._make_cache(int(B))
+
+    def admit_prefill(self, ids, true_len, pos0, kc=None, vc=None):
         import jax.numpy as jnp
-        S = int(np.asarray(ids).shape[1])
+        ids = np.asarray(ids)
+        if ids.shape[0] != 1:
+            raise ValueError(
+                f"bundle admit entries serve batch 1, got {ids.shape[0]}")
+        S = int(ids.shape[1])
         if S not in self._admit:
             raise ValueError(f"no admit_prefill bucket for prompt bucket "
                              f"{S}; exported: {self.prompt_buckets}")
-        kc1, vc1 = self.pred._make_cache(1)
-        ids_d = jnp.asarray(np.asarray(ids), jnp.int32)
-        tl = jnp.asarray(int(true_len), jnp.int32)
+        if kc is None:
+            kc, vc = self.pred._make_cache(1)
+        ids_d = jnp.asarray(ids, jnp.int32)
+        tl = jnp.asarray(np.asarray(true_len), jnp.int32)
+        p0 = jnp.asarray(np.asarray(pos0), jnp.int32)
+        if not self.admit_pos0:
+            if int(np.asarray(pos0)[0]) != 0:
+                raise ValueError(
+                    "this bundle's admit entries predate the prefix "
+                    "cache (no pos0 input); re-export it for suffix "
+                    "prefills")
+            # legacy entry signature: scalar true_len, no pos0
+            tl = jnp.asarray(int(np.asarray(true_len)[0]), jnp.int32)
+            if self.sharding is not None:
+                ids_d = self.sharding.put(ids_d, ())
+                tl = self.sharding.put(tl, ())
+            return self.pred._run_entry(
+                self._admit[S], "bundle.admit_prefill", ids_d, kc, vc, tl)
         if self.sharding is not None:
             # partitioned admit entries take committed mesh arrays
             ids_d = self.sharding.put(ids_d, ())
             tl = self.sharding.put(tl, ())
+            p0 = self.sharding.put(p0, ())
         return self.pred._run_entry(
-            self._admit[S], "bundle.admit_prefill", ids_d, kc1, vc1, tl)
+            self._admit[S], "bundle.admit_prefill", ids_d, kc, vc, tl, p0)
 
     def _run(self, fname, site, st):
         toks, logits, kc, vc, pos, keys, done = self.pred._run_entry(
@@ -359,7 +425,23 @@ class ServingEngine:
                  top_p: Optional[float] = None, policy: str = "fifo",
                  prompt_buckets: Optional[Sequence[int]] = None,
                  slo_targets: Optional[Dict[str, Dict[str, float]]]
-                 = None, mesh=None):
+                 = None, mesh=None, prefix_cache=None,
+                 prefix_cache_bytes: Optional[int] = None,
+                 prefix_block_tokens: Optional[int] = None,
+                 batch_admission: bool = False):
+        """``prefix_cache``: ``None`` reads the
+        ``FLAGS_serving_prefix_cache_bytes`` /
+        ``PADDLE_TPU_PREFIX_CACHE_BYTES`` budget (0 = disabled, the
+        default); ``True`` enables it (budget from
+        ``prefix_cache_bytes``, the flags, or effectively unlimited);
+        ``False`` disables; a ``PrefixCache`` instance is served
+        directly — shareable across same-topology engines, refused
+        typed (``MeshMismatchError``) on a mesh mismatch.
+        ``batch_admission``: admit several same-bucket waiting requests
+        with ONE batched (suffix-)prefill dispatch instead of
+        per-request batch-1 prefills (``admission.dispatches_saved`` in
+        ``metrics()``); off by default — the classic one-prefill-per-
+        request accounting stays exact."""
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
@@ -380,6 +462,19 @@ class ServingEngine:
         self.state = self._b.new_state()
         self._next_id = 0
         self._results: Dict[int, Any] = {}
+        # content-hashed prefix cache (serving/prefix_cache.py): a full
+        # hit admits via the row-scatter alone — zero prefill dispatches
+        self.batch_admission = bool(batch_admission)
+        self.prefix_cache = self._resolve_prefix_cache(
+            prefix_cache, prefix_cache_bytes, prefix_block_tokens)
+        self._slab_ops = None
+        if self.prefix_cache is not None:
+            from paddle_tpu.serving.prefix_cache import SlabOps
+            # slabs live under the carry's NamedShardings; a shared
+            # cache refuses a different topology typed, at bind time
+            self.prefix_cache.bind_mesh(srd.axes if srd is not None
+                                        else None)
+            self._slab_ops = SlabOps(srd, self._b.head_major)
         # the engine's own always-on metrics registry (paddle_tpu/obs):
         # replaces the ad-hoc counter ints / delay-and-occupancy lists of
         # round 9 — same bookkeeping cost, but one typed store feeding
@@ -425,12 +520,79 @@ class ServingEngine:
         self._h_tpot = r.histogram(
             "serving.tpot_s", "per-request mean inter-token time after "
             "the first token")
+        # prefix-cache instruments: hit classes as the ENGINE admitted
+        # them (a shared cache's own stats() aggregate every engine),
+        # bytes/slab gauges synced from the cache after each admission
+        # round, and admission latency split by hit class — the
+        # cached-vs-cold evidence bench.py --serve --prefix-mix reports
+        self._c_prefix = {
+            "full": r.counter("serving.prefix.hits_full",
+                              "admissions served ENTIRELY from a cached "
+                              "slab: zero prefill dispatches"),
+            "partial": r.counter("serving.prefix.hits_partial",
+                                 "admissions that prefilled only the "
+                                 "uncached suffix"),
+            "miss": r.counter("serving.prefix.misses",
+                              "cold admissions (cache populated on the "
+                              "way through)"),
+        }
+        self._c_prefix_insert = r.counter(
+            "serving.prefix.insertions", "slabs inserted into the pool")
+        self._c_prefix_evict = r.counter(
+            "serving.prefix.evictions",
+            "LRU slabs evicted past the byte budget")
+        self._g_prefix_bytes = r.gauge(
+            "serving.prefix.bytes_cached", "live slab bytes in the pool")
+        self._g_prefix_slabs = r.gauge(
+            "serving.prefix.slabs", "live slabs in the pool")
+        self._c_tokens_saved = r.counter(
+            "serving.prefill_tokens_saved",
+            "prompt tokens whose prefill compute a cached prefix "
+            "avoided")
+        self._c_batched_groups = r.counter(
+            "serving.admission.batched_groups",
+            "admission rounds that batched several same-bucket "
+            "(suffix-)prefills into one dispatch")
+        self._c_disp_saved = r.counter(
+            "serving.admission.dispatches_saved",
+            "prefill dispatches avoided vs one-per-request admission "
+            "(batched groups + full-prefix hits)")
+        self._h_admit = {
+            cls: r.histogram(f"serving.admission_s.{cls}",
+                             f"per-request admission wall time, "
+                             f"{cls}-hit class")
+            for cls in ("full", "partial", "miss")}
+        self._last_prefix_stats = {"insertions": 0, "evictions": 0}
         self.slo_targets = {k: dict(v)
                             for k, v in (slo_targets or {}).items()}
         self._exporter = None
         # crash evidence: a ladder exhaustion's postmortem carries this
-        # engine's registry snapshot (weakref — no lifetime extension)
+        # engine's registry snapshot (weakref — no lifetime extension),
+        # and the prefix-cache occupancy/eviction state so a postmortem
+        # shows what the cache held at crash time
         obs.flight_recorder.add_registry("serving", self.registry)
+        if self.prefix_cache is not None:
+            obs.flight_recorder.add_state("serving.prefix_cache",
+                                          self.prefix_cache)
+
+    @staticmethod
+    def _resolve_prefix_cache(prefix_cache, bytes_, block):
+        from paddle_tpu.serving.prefix_cache import (
+            PrefixCache, resolve_prefix_cache_bytes)
+        if prefix_cache is False:
+            return None
+        if isinstance(prefix_cache, PrefixCache):
+            return prefix_cache
+        budget = bytes_ if bytes_ is not None \
+            else resolve_prefix_cache_bytes()
+        if prefix_cache is None and not budget:
+            return None           # default: flags/env say disabled
+        if prefix_cache is not None and prefix_cache is not True:
+            raise TypeError(
+                f"prefix_cache must be None, a bool, or a PrefixCache, "
+                f"got {type(prefix_cache).__name__}")
+        return PrefixCache(bytes_budget=budget or None,
+                           block_tokens=block)
 
     # legacy counter attributes, now views over the registry (pre-obs
     # callers and the bench dispatch-accounting asserts read these)
@@ -497,8 +659,9 @@ class ServingEngine:
         finished this step (also retrievable via ``result(id)``)."""
         now = time.monotonic()
         self._h_qdepth.observe(len(self.scheduler))
-        for slot_idx, req in self.scheduler.admissions():
-            self._admit(slot_idx, req, now)
+        admitted = self.scheduler.admissions()
+        if admitted:
+            self._admit_all(admitted, now)
         self._g_qdepth.set(len(self.scheduler))
         occupied = self.scheduler.slots.occupied()
         if not occupied:
@@ -531,6 +694,11 @@ class ServingEngine:
             res = self._finish(slot, seq, i)
             self._results[req.id] = res
             finished.append((req.id, res))
+            if slot.pinned_slab is not None:
+                # the request's slab outlived its flight: unpinned, it
+                # becomes evictable again (refcount pinning contract)
+                self.prefix_cache.unpin(slot.pinned_slab)
+                slot.pinned_slab = None
             self.scheduler.slots.release(i)
             freed.append(i)
         if freed:
@@ -564,17 +732,110 @@ class ServingEngine:
         return self._results.get(request_id)
 
     # -- internals ---------------------------------------------------------
-    def _admit(self, slot_idx: int, req: Request, now: float) -> None:
+    def _admit_all(self, admitted, now: float) -> None:
+        """One admission round. Per request: consult the prefix cache —
+        a FULL hit admits via the fused row-scatter alone (ZERO prefill
+        dispatches; the slab's logits + KV rows ARE the cold prefill's
+        row state, so tokens stay bit-exact), a PARTIAL hit prefills
+        only the uncached suffix on top of the loaded slab, a miss runs
+        the cold prefill and populates the cache on the way through.
+        Requests that do need a prefill are grouped by padded bucket
+        width; with ``batch_admission`` each group runs as ONE batched
+        dispatch (mixed cold/suffix rows — per-row pos0 keeps them
+        independent)."""
+        cache = self.prefix_cache
+        plans = []
+        for slot_idx, req in admitted:
+            t0 = time.monotonic()
+            S = len(req.prompt)
+            hit = None
+            if cache is not None:
+                hit = cache.lookup(req.prompt,
+                                   allow_partial=self._b.admit_pos0)
+            if hit is not None and hit.kind == "full":
+                cache.pin(hit.slab)
+                self._scatter(slot_idx, req, hit.slab.logits,
+                              hit.slab.kc, hit.slab.vc, src=0, pos1=S)
+                self._note_admit(slot_idx, req, now, t0, "full",
+                                 tokens_saved=S, dispatches=0,
+                                 slab=hit.slab, events=[])
+                self._c_disp_saved.inc()
+                continue
+            plans.append((slot_idx, req, hit))
+        groups: Dict[int, list] = {}
+        for slot_idx, req, hit in plans:
+            cached = (hit.cached_len
+                      if hit is not None and hit.kind == "partial" else 0)
+            w = self.scheduler.bucket(len(req.prompt) - cached)
+            groups.setdefault(w, []).append((slot_idx, req, hit, cached))
+        for w, grp in sorted(groups.items()):
+            if self.batch_admission and self._b.admit_batch_any \
+                    and len(grp) > 1:
+                self._admit_group(w, grp, now)
+            else:
+                for item in grp:
+                    self._admit_group(w, [item], now)
+        self._prefix_sync()
+
+    def _admit_group(self, w: int, grp, now: float) -> None:
+        """ONE admission-prefill dispatch for the group: batch-N padded
+        suffix ids, per-row true lengths and cache offsets, caches
+        preloaded with each partial row's slab; then one fused
+        row-scatter per admitted request, and — cache enabled — one
+        slab extraction per newly seen prompt."""
+        cache, ops = self.prefix_cache, self._slab_ops
+        t0 = time.monotonic()
+        N = len(grp)
+        ids = np.zeros((N, w), np.int32)
+        true_len = np.zeros((N,), np.int32)
+        pos0 = np.zeros((N,), np.int32)
+        kcN = vcN = None
+        for j, (slot_idx, req, hit, cached) in enumerate(grp):
+            suffix = np.asarray(req.prompt)[cached:]
+            ids[j, :len(suffix)] = suffix
+            true_len[j] = len(suffix)
+            pos0[j] = cached
+            if cached:
+                cache.pin(hit.slab)
+                if kcN is None:
+                    kcN, vcN = self._b.empty_cache(N)
+                kcN, vcN = ops.load(kcN, vcN, hit.slab.kc, hit.slab.vc,
+                                    j)
+        ev0 = self._b.event_count()
+        logitsN, kcN, vcN = self._b.admit_prefill(ids, true_len, pos0,
+                                                  kcN, vcN)
+        self._c_prefill.inc()
+        if N > 1:
+            self._c_batched_groups.inc()
+            self._c_disp_saved.inc(N - 1)
+        events = self._b.events_since(ev0)
+        for j, (slot_idx, req, hit, cached) in enumerate(grp):
+            S = len(req.prompt)
+            self._scatter(slot_idx, req, logitsN, kcN, vcN, src=j,
+                          pos1=S)
+            if cache is not None:
+                digests = hit.digests if hit is not None else None
+                if digests is None or not cache.contains_full(digests):
+                    bucket = self.scheduler.bucket(S)
+                    skc, svc, slg = ops.extract(kcN, vcN, logitsN, j,
+                                                bucket)
+                    cache.insert(req.prompt, skc, svc, slg, bucket,
+                                 digests=digests)
+            cls = "partial" if cached else "miss"
+            self._note_admit(slot_idx, req, now, t0, cls,
+                             tokens_saved=cached,
+                             dispatches=1 if j == 0 else 0,
+                             slab=hit.slab if cached else None,
+                             events=events)
+
+    def _scatter(self, slot_idx: int, req: Request, logits1, kc1, vc1,
+                 src: int, pos1: int) -> None:
+        """The fused admission row-scatter: row ``src`` of the given
+        row state lands in carry row ``slot_idx``. A full-prefix hit's
+        WHOLE admission is one of these."""
         import jax.numpy as jnp
         import jax.random as jrandom
 
-        S = len(req.prompt)
-        bucket = self.scheduler.bucket(S)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :S] = req.prompt
-        ev0 = self._b.event_count()
-        logits1, kc1, vc1 = self._b.admit_prefill(ids, S)
-        self._c_prefill.inc()
         # the SAME row-key rule as generate(chunk_size=) at B=1: the
         # request's stream is keyed by its seed alone
         key1 = jnp.asarray(jrandom.split(jrandom.PRNGKey(req.seed), 1)[0],
@@ -583,21 +844,54 @@ class ServingEngine:
         (logits, kc, vc, pos, keys, done, eos, temp) = self._admit_fn(
             st.logits, st.kc, st.vc, st.pos, st.keys, st.done, st.eos,
             st.temp, logits1, kc1, vc1,
-            jnp.asarray(slot_idx, jnp.int32), jnp.asarray(S, jnp.int32),
-            key1,
+            jnp.asarray(slot_idx, jnp.int32), jnp.asarray(src, jnp.int32),
+            jnp.asarray(pos1, jnp.int32), key1,
             jnp.asarray(-1 if req.eos_token_id is None
                         else int(req.eos_token_id), jnp.int32),
             jnp.asarray(req.temperature, jnp.float32))
         self.state = dataclasses.replace(
             st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
             done=done, eos=eos, temp=temp)
+
+    def _note_admit(self, slot_idx: int, req: Request, now: float,
+                    t0: float, cls: str, tokens_saved: int,
+                    dispatches: int, slab, events) -> None:
         slot = self.scheduler.slots.entries[slot_idx]
         slot.admitted_at = now
-        slot.events.extend(self._b.events_since(ev0))
+        slot.events.extend(events)
+        enabled = self.prefix_cache is not None
+        slot.prefix_hit = cls if enabled else None
+        slot.prefill_tokens_saved = int(tokens_saved)
+        slot.admission_dispatches = int(dispatches)
+        slot.pinned_slab = slab
+        self._h_admit[cls].observe(time.monotonic() - t0)
+        if enabled:
+            self._c_prefix[cls].inc()
+            if tokens_saved:
+                self._c_tokens_saved.inc(int(tokens_saved))
         self._h_qdelay.observe(now - req.submit_time)
         obs.tracer.event("serving.request.admitted", request=req.id,
                          slot=slot_idx,
-                         queue_delay_s=round(now - req.submit_time, 6))
+                         queue_delay_s=round(now - req.submit_time, 6),
+                         prefix_hit=slot.prefix_hit,
+                         prefill_tokens_saved=int(tokens_saved))
+
+    def _prefix_sync(self) -> None:
+        """Mirror the cache's pool-level numbers into the engine's typed
+        registry (gauges absolute; insertion/eviction counters by delta,
+        so a SHARED cache's events land once per engine observation)."""
+        cache = self.prefix_cache
+        if cache is None:
+            return
+        st = cache.stats()
+        self._g_prefix_bytes.set(st["bytes_cached"])
+        self._g_prefix_slabs.set(st["slabs"])
+        last = self._last_prefix_stats
+        for key, ctr in (("insertions", self._c_prefix_insert),
+                         ("evictions", self._c_prefix_evict)):
+            if st[key] > last[key]:
+                ctr.inc(st[key] - last[key])
+                last[key] = st[key]
 
     def _dispatch_chunk(self, occupied) -> np.ndarray:
         from paddle_tpu.flags import flags as _flags
@@ -690,6 +984,14 @@ class ServingEngine:
                 "slot": slot_idx,
                 "latency_class": req.latency_class,
                 "slo": slo,
+                # prefix-cache accounting for THIS request: its hit
+                # class (None = cache disabled), the prompt tokens whose
+                # prefill it skipped, and how many prefill dispatches
+                # its admission issued (0 = full hit or rode a batched
+                # group's dispatch)
+                "prefix_hit": slot.prefix_hit,
+                "prefill_tokens_saved": slot.prefill_tokens_saved,
+                "admission_dispatches": slot.admission_dispatches,
             },
         }
         # the request's lifetime span (submit -> finished) on the same
@@ -786,6 +1088,11 @@ class ServingEngine:
                 "step_dispatches": self.step_dispatches,
             },
             "slo_targets": self.slo_targets,
+            # what the prefix-cache pool holds RIGHT NOW (None =
+            # disabled): occupancy, eviction counts and the bounded
+            # slab table — also what a flight-recorder postmortem shows
+            "prefix_cache": (None if self.prefix_cache is None
+                             else self.prefix_cache.snapshot()),
         }
 
     def _mesh_status(self) -> Optional[Dict[str, Any]]:
@@ -882,4 +1189,23 @@ class ServingEngine:
                 self.registry.get(n).value
                 for n in self.registry.names()
                 if ".slo." in n and n.endswith("_violations"))),
+            # admission economics: dispatches avoided (full hits +
+            # batched groups), tokens of prefill compute skipped, and
+            # per-hit-class admission latency (NaN until a class has a
+            # sample)
+            "admission_dispatches_saved": int(self._c_disp_saved.value),
+            "batched_admission_groups": int(
+                self._c_batched_groups.value),
+            "prefill_tokens_saved": int(self._c_tokens_saved.value),
+            "admission_p50_s": {cls: h.percentile(50)
+                                for cls, h in self._h_admit.items()},
+            "admission_p99_s": {cls: h.percentile(99)
+                                for cls, h in self._h_admit.items()},
+            "prefix_cache": (None if self.prefix_cache is None else {
+                **self.prefix_cache.stats(),
+                "engine_hits_full": int(self._c_prefix["full"].value),
+                "engine_hits_partial": int(
+                    self._c_prefix["partial"].value),
+                "engine_misses": int(self._c_prefix["miss"].value),
+            }),
         }

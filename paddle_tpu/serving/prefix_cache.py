@@ -1,0 +1,421 @@
+"""Content-hashed prefix cache + length-bucketed KV slab pool.
+
+Real multi-tenant traffic is dominated by shared prefixes (system
+prompts, few-shot templates), and a cold admission recomputes the full
+prefill even when an identical prefix's KV is already sitting in HBM.
+This module is the TPU-native answer: NOT a GPU-style block table
+(Kwon et al., PagedAttention — PAPERS.md deliberately rejects it in
+favor of dense padded caches XLA owns, Pope et al. 2211.05102) but a
+store of DENSE batch-1 KV slabs, each the contiguous cache rows
+``[0, bucket(S))`` of one previously prefilled prompt, keyed by content
+hashes of the token-id prefix taken at every ``block_tokens`` boundary
+plus the full length:
+
+- a FULL hit (the whole prompt matches a cached entry exactly) admits
+  via the serving engine's existing fused row-scatter alone — ZERO
+  prefill dispatches, the admission cost the ROADMAP targets;
+- a PARTIAL hit (the longest block-boundary digest matches) loads the
+  slab's rows into a fresh batch-1 cache and prefills only the uncached
+  suffix at ``pos0 = cached_len`` (``admit_prefill``'s per-row offset),
+  saving ``cached_len`` tokens of prefill compute;
+- a MISS populates the cache on the way through: the admission
+  prefill's row state is sliced to the prompt's length bucket and
+  inserted under its full-length digest AND every block-boundary digest
+  (the boundary entries are what later, longer prompts partial-hit).
+
+Both hit classes are BIT-EXACT with cold admission: K/V rows are
+per-position projections of causally-masked hidden states, so rows
+``[0, L)`` depend only on tokens ``[0, L)``; stale slab rows past the
+prefix behave exactly like the padded-prefill tail the engine already
+relies on (masked until decode overwrites them).
+
+Slabs are ref-counted — an in-flight slab (pinned by the engine for a
+request's lifetime) cannot be evicted — and the pool evicts
+least-recently-used unpinned slabs once ``bytes_budget`` is exceeded.
+Slab arrays live on device under the SAME NamedShardings as the decode
+carry (the extract/load ops constrain them), so the mesh serving path
+never gathers a slab to host; a cache shared across engines refuses a
+mismatched mesh with a typed ``MeshMismatchError``.
+
+Pure host bookkeeping plus jitted slab extract/load helpers; the
+admission policy that consults it lives in ``serving/engine.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache", "PrefixSlab", "PrefixLookup", "prefix_digests",
+           "resolve_prefix_cache_bytes", "SlabOps"]
+
+_UNBOUND = object()      # cache not yet bound to a mesh topology
+
+
+def resolve_prefix_cache_bytes() -> int:
+    """The prefix-cache byte budget: ``PADDLE_TPU_PREFIX_CACHE_BYTES``
+    wins over ``FLAGS_serving_prefix_cache_bytes``; 0 = disabled."""
+    env = os.environ.get("PADDLE_TPU_PREFIX_CACHE_BYTES", "").strip()
+    if env:
+        return int(float(env))
+    from paddle_tpu.flags import flags
+    return int(flags.serving_prefix_cache_bytes)
+
+
+def prefix_digests(tokens, block_tokens: int) -> List[Tuple[int, str]]:
+    """Chained content hashes of a token-id prefix at every
+    ``block_tokens`` boundary plus the full length, longest first.
+    Chaining (``h_i = H(h_{i-1} || block_i)``) makes the whole ladder
+    one O(S) pass, and a one-token divergence anywhere in a block
+    changes every digest at and past that block — the property the
+    block-boundary miss tests pin down."""
+    ids = np.ascontiguousarray(np.asarray(tokens).reshape(-1), np.int64)
+    S = int(ids.shape[0])
+    if S < 1:
+        raise ValueError("prefix must have at least 1 token")
+    block = int(block_tokens)
+    if block < 1:
+        raise ValueError(f"block_tokens must be >= 1, got {block}")
+    out: List[Tuple[int, str]] = []
+    h = hashlib.blake2b(digest_size=16)
+    done = 0
+    for end in range(block, S + 1, block):
+        h.update(ids[done:end].tobytes())
+        done = end
+        out.append((end, h.hexdigest()))
+    if done < S:
+        h.update(ids[done:S].tobytes())
+        out.append((S, h.hexdigest()))
+    out.reverse()            # longest (= full length) first
+    return out
+
+
+@dataclasses.dataclass(eq=False)     # identity equality: fields hold
+class PrefixSlab:                    # device arrays
+    """One cached prefix's device-resident row state: batch-1 KV cache
+    buffers trimmed to the prompt's length bucket (the length-bucketed
+    pool — bytes scale with the prefix, not ``max_len``) plus the
+    next-token logits of position ``length - 1`` (what a full hit
+    scatters so decode resumes exactly where the cold prefill would).
+    ``refs`` pins the slab against eviction while requests ride it."""
+    kc: Any
+    vc: Any
+    logits: Any               # (1, V) — valid for the FULL length only
+    length: int               # true token length of the inserted prefix
+    bucket: int               # cache columns the arrays actually hold
+    nbytes: int
+    digests: List[str] = dataclasses.field(default_factory=list)
+    refs: int = 0
+    stamp: int = 0            # LRU clock (bumped on hit/insert)
+
+    def describe(self) -> dict:
+        return {"length": self.length, "bucket": self.bucket,
+                "bytes": self.nbytes, "refs": self.refs}
+
+
+@dataclasses.dataclass
+class PrefixLookup:
+    """One prompt's cache verdict: ``kind`` in {"full", "partial",
+    "miss"}; ``cached_len`` is the prefix length the admission may skip
+    (0 on a miss; ``len(prompt)`` on a full hit); ``digests`` is the
+    prompt's hash ladder, reusable by the insert that follows a miss."""
+    kind: str
+    slab: Optional[PrefixSlab]
+    cached_len: int
+    digests: List[Tuple[int, str]]
+
+
+def _nbytes(tree) -> int:
+    import jax
+    return int(sum(np.dtype(x.dtype).itemsize * int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(tree)))
+
+
+class SlabOps:
+    """The two device-side slab movements, jitted per shape signature
+    and pinned to the engine's carry shardings. NOT counted dispatch
+    sites — like the engine's admission row-scatter, they are plain
+    array updates outside the serving dispatch contract.
+
+    ``extract``: slice one row of a (batched) admission-prefill output
+    down to its length bucket — the slab that enters the pool.
+    ``load``: scatter a slab's rows into row ``row`` of a fresh batch-N
+    cache pair — the base a suffix prefill computes on top of. Loading
+    the WHOLE slab (bucket columns, not just ``cached_len``) is sound:
+    rows past the reused prefix are causally masked until the suffix
+    prefill / decode overwrite them, the same discipline the padded
+    admission tail already rides."""
+
+    def __init__(self, sharding=None, head_major: bool = False):
+        self._srd = sharding
+        self._hm = bool(head_major)
+        self._extract_jits: Dict[int, Any] = {}
+        self._load_jit = None
+
+    def _pin(self, kc, vc, logits=None):
+        if self._srd is None:
+            return (kc, vc) if logits is None else (kc, vc, logits)
+        kc = self._srd.constrain(kc, "kc", self._hm)
+        vc = self._srd.constrain(vc, "vc", self._hm)
+        if logits is None:
+            return kc, vc
+        return kc, vc, self._srd.constrain(logits, "logits", self._hm)
+
+    def extract(self, kc, vc, logits, row, cols: int):
+        import jax
+        fn = self._extract_jits.get(int(cols))
+        if fn is None:
+            hm = self._hm
+
+            def _extract(kc, vc, logits, row):
+                def cut(b):
+                    ax = b.ndim - 4
+                    r = jax.lax.dynamic_slice_in_dim(b, row, 1, axis=ax)
+                    lax_ = ax + (2 if hm else 1)
+                    return jax.lax.slice_in_dim(r, 0, int(cols),
+                                                axis=lax_)
+                kc2 = jax.tree_util.tree_map(cut, kc)
+                vc2 = jax.tree_util.tree_map(cut, vc)
+                lg = jax.lax.dynamic_slice_in_dim(logits, row, 1, axis=0)
+                return self._pin(kc2, vc2, lg)
+
+            fn = self._extract_jits[int(cols)] = jax.jit(_extract)
+        import jax.numpy as jnp
+        return fn(kc, vc, logits, jnp.asarray(int(row), jnp.int32))
+
+    def load(self, kc, vc, slab_kc, slab_vc, row):
+        import jax
+        if self._load_jit is None:
+            def _load(kc, vc, skc, svc, row):
+                def put(b, r):
+                    ax = b.ndim - 4
+                    starts = tuple(row if i == ax else 0
+                                   for i in range(b.ndim))
+                    return jax.lax.dynamic_update_slice(
+                        b, r.astype(b.dtype), starts)
+                kc = jax.tree_util.tree_map(put, kc, skc)
+                vc = jax.tree_util.tree_map(put, vc, svc)
+                return self._pin(kc, vc)
+
+            self._load_jit = jax.jit(_load)
+        import jax.numpy as jnp
+        return self._load_jit(kc, vc, slab_kc, slab_vc,
+                              jnp.asarray(int(row), jnp.int32))
+
+
+class PrefixCache:
+    """The ref-counted, LRU + byte-budget slab store. Thread-safe host
+    bookkeeping; the slab arrays themselves are immutable device
+    buffers, so a concurrent reader can never observe a torn slab.
+
+    One cache may be shared by several engines (cross-engine prefix
+    reuse); the first engine to bind it fixes the mesh topology and a
+    later engine with a different one is refused typed
+    (``MeshMismatchError``) — a slab's placements only fit the carry it
+    was extracted from."""
+
+    def __init__(self, bytes_budget: Optional[int] = None,
+                 block_tokens: Optional[int] = None):
+        from paddle_tpu.flags import flags
+        if bytes_budget is None:
+            bytes_budget = resolve_prefix_cache_bytes() or (1 << 62)
+        self.bytes_budget = int(bytes_budget)
+        if self.bytes_budget < 1:
+            raise ValueError(
+                f"bytes_budget must be >= 1, got {bytes_budget} "
+                f"(an engine disables the cache by not building one)")
+        self.block_tokens = int(block_tokens
+                                if block_tokens is not None
+                                else flags.serving_prefix_block_tokens)
+        if self.block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, "
+                             f"got {self.block_tokens}")
+        self._lock = threading.RLock()
+        self._index: Dict[str, Tuple[PrefixSlab, int]] = {}
+        self._slabs: List[PrefixSlab] = []
+        self._clock = itertools.count(1)
+        self._mesh: Any = _UNBOUND
+        self.bytes_cached = 0
+        # lifetime accounting (the engine mirrors these into its typed
+        # registry; /statusz and the flight recorder read snapshot())
+        self.hits_full = 0
+        self.hits_partial = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+        self.bytes_evicted = 0
+        self.prefill_tokens_saved = 0
+
+    # -- mesh binding -------------------------------------------------------
+    def bind_mesh(self, axes: Optional[Dict[str, int]]) -> None:
+        """Fix the topology the slabs live under (None = single
+        device). Rebinding with the same axes is a no-op; a different
+        topology is a typed refusal — the slab arrays' NamedShardings
+        cannot be reinterpreted onto another mesh."""
+        from paddle_tpu.inference.sharding import MeshMismatchError
+        with self._lock:
+            if self._mesh is _UNBOUND:
+                self._mesh = dict(axes) if axes else None
+                return
+            want = dict(axes) if axes else None
+            if self._mesh != want:
+                raise MeshMismatchError(
+                    f"prefix cache holds slabs for mesh {self._mesh}; "
+                    f"an engine on {want} cannot serve them — share a "
+                    f"cache only between same-topology engines")
+
+    # -- lookup / insert ----------------------------------------------------
+    def lookup(self, tokens, allow_partial: bool = True) -> PrefixLookup:
+        """Longest-prefix match over the prompt's digest ladder. A full
+        hit needs the exact full-length entry WITH resume logits; the
+        longest boundary entry otherwise serves as a partial base,
+        capped at ``S - 1`` so the admission always has at least one
+        suffix token to recompute the resume logits from.
+        ``allow_partial=False`` (a backend without suffix-prefill
+        entries — a pre-prefix AOT bundle) demotes partial matches to
+        misses up front, keeping the accounting honest."""
+        digests = prefix_digests(tokens, self.block_tokens)
+        S = digests[0][0]
+        with self._lock:
+            for L, d in digests:
+                ent = self._index.get(d)
+                if ent is None:
+                    continue
+                slab, ent_len = ent
+                if L == S and ent_len == slab.length:
+                    slab.stamp = next(self._clock)
+                    self.hits_full += 1
+                    self.prefill_tokens_saved += S
+                    return PrefixLookup("full", slab, S, digests)
+                if not allow_partial:
+                    continue
+                cached = min(ent_len, S - 1)
+                if cached < 1:
+                    continue
+                slab.stamp = next(self._clock)
+                self.hits_partial += 1
+                self.prefill_tokens_saved += cached
+                return PrefixLookup("partial", slab, cached, digests)
+            self.misses += 1
+            return PrefixLookup("miss", None, 0, digests)
+
+    def contains_full(self, digests: List[Tuple[int, str]]) -> bool:
+        """True when the full-length entry (with resume logits) for this
+        digest ladder is already live — the engine skips the slab
+        extraction then."""
+        with self._lock:
+            ent = self._index.get(digests[0][1])
+            return ent is not None and ent[1] == ent[0].length
+
+    def insert(self, tokens, kc, vc, logits, bucket: int,
+               digests: Optional[List[Tuple[int, str]]] = None
+               ) -> Optional[PrefixSlab]:
+        """Register one prefilled prompt's sliced row state under its
+        full-length digest and every block-boundary digest (first
+        writer wins — content-equal prefixes produce identical KV).
+        Returns the slab (the existing one when the full entry is
+        already present), or None when the cache chose not to keep it.
+        Evicts LRU unpinned slabs past the byte budget."""
+        if digests is None:
+            digests = prefix_digests(tokens, self.block_tokens)
+        S = digests[0][0]
+        with self._lock:
+            have = self._index.get(digests[0][1])
+            if have is not None and have[1] == have[0].length:
+                have[0].stamp = next(self._clock)
+                return have[0]        # dedupe: full entry already live
+            slab = PrefixSlab(kc=kc, vc=vc, logits=logits, length=S,
+                              bucket=int(bucket),
+                              nbytes=_nbytes((kc, vc, logits)),
+                              stamp=next(self._clock))
+            for L, d in digests:
+                cur = self._index.get(d)
+                # the full-length key always points at ITS slab (that's
+                # what resume logits key off); boundary keys keep their
+                # first writer
+                if cur is None or L == S:
+                    self._index[d] = (slab, L)
+                    slab.digests.append(d)
+            self._slabs.append(slab)
+            self.insertions += 1
+            self.bytes_cached += slab.nbytes
+            self._evict_to_budget()
+            return slab if slab in self._slabs else None
+
+    # -- pinning / eviction -------------------------------------------------
+    def pin(self, slab: PrefixSlab) -> None:
+        with self._lock:
+            slab.refs += 1
+
+    def unpin(self, slab: PrefixSlab) -> None:
+        with self._lock:
+            if slab.refs < 1:
+                raise RuntimeError("unpin without a matching pin")
+            slab.refs -= 1
+            self._evict_to_budget()
+
+    def _evict_to_budget(self) -> None:
+        # lock held. Oldest-stamp unpinned slabs go first; pinned slabs
+        # (requests in flight on them) are untouchable, so the pool may
+        # transiently overshoot the budget until they unpin.
+        while self.bytes_cached > self.bytes_budget:
+            victims = [s for s in self._slabs if s.refs == 0]
+            if not victims:
+                return
+            v = min(victims, key=lambda s: s.stamp)
+            self._slabs.remove(v)
+            for d in v.digests:
+                if self._index.get(d, (None,))[0] is v:
+                    del self._index[d]
+            self.bytes_cached -= v.nbytes
+            self.bytes_evicted += v.nbytes
+            self.evictions += 1
+
+    # -- introspection ------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slabs)
+
+    @property
+    def mesh_axes(self) -> Optional[Dict[str, int]]:
+        with self._lock:
+            return None if self._mesh in (_UNBOUND, None) \
+                else dict(self._mesh)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            hits = self.hits_full + self.hits_partial
+            total = hits + self.misses
+            return {
+                "slabs": len(self._slabs),
+                "bytes_cached": self.bytes_cached,
+                "bytes_budget": self.bytes_budget,
+                "block_tokens": self.block_tokens,
+                "hits_full": self.hits_full,
+                "hits_partial": self.hits_partial,
+                "misses": self.misses,
+                "hit_rate": (hits / total) if total else 0.0,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "bytes_evicted": self.bytes_evicted,
+                "prefill_tokens_saved": self.prefill_tokens_saved,
+                "pinned": sum(1 for s in self._slabs if s.refs),
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /statusz + flight-recorder view: the stats block plus a
+        bounded per-slab occupancy table (newest first), so a
+        postmortem shows WHAT the cache held at crash time."""
+        with self._lock:
+            out = self.stats()
+            out["occupancy"] = self.bytes_cached / self.bytes_budget
+            slabs = sorted(self._slabs, key=lambda s: -s.stamp)[:32]
+            out["slab_table"] = [s.describe() for s in slabs]
+            out["mesh"] = self.mesh_axes
+            return out

@@ -88,6 +88,16 @@ class Slot:
     # stamped after the first chunk dispatch the slot rode: the
     # admission->first-token interval is the TTFT instrument's sample
     first_token_at: Optional[float] = None
+    # prefix-cache admission record (serving/prefix_cache.py): the hit
+    # class this admission resolved to (None = cache disabled), the
+    # prompt tokens whose prefill it skipped, how many prefill
+    # dispatches it issued (0 = full hit, or rode a batched group's
+    # dispatch), and the slab pinned for the request's flight — the
+    # engine unpins it at release, making the slab evictable again
+    prefix_hit: Optional[str] = None
+    prefill_tokens_saved: int = 0
+    admission_dispatches: int = 0
+    pinned_slab: Any = None
 
 
 class SlotTable:

@@ -32,6 +32,13 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      block, tracez spans), then asserts the final record carries
      device-attribution coverage > 0 — the live-telemetry-plane gate
 
+ 10. serve_prefix (this script's --probe-serve-prefix mode): runs
+     `bench.py --serve --prefix-mix` with PADDLE_TPU_OBS=1 — the
+     content-hashed prefix-cache gate: hit rate > 0, cached prefill
+     dispatches strictly below the cold run, full-hit admission p50
+     below cold p50, hit-rate + bytes-cached in the obs block; token
+     parity and zero-dispatch full hits are hard-asserted in-bench
+
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
 """
@@ -69,6 +76,14 @@ STEPS = [
     # the SAME --mesh flag against physical chips unchanged.
     ("serve_sharded", [sys.executable, "tools/roundtail_bench.py",
                       "--probe-serve-sharded"], None),
+    # prefix-cache serving gate: bench.py --serve --prefix-mix with obs
+    # on — parity (vs solo generates, x2 runs) and zero-dispatch
+    # full-prefix hits are hard-asserted INSIDE the bench; the probe
+    # additionally asserts the record is honest: hit rate > 0, cached
+    # prefill-dispatch count strictly below the cold run's, and the
+    # hit-rate + bytes-cached accounting present in the obs block
+    ("serve_prefix", [sys.executable, "tools/roundtail_bench.py",
+                      "--probe-serve-prefix"], None),
 ]
 
 
@@ -206,11 +221,76 @@ def probe_serve_sharded() -> int:
     return 0 if ok else 1
 
 
+def probe_serve_prefix() -> int:
+    """The prefix-cache serving gate: ``bench.py --serve --prefix-mix``
+    with obs on. Parity and zero-dispatch full hits are asserted inside
+    the bench (rc != 0 on violation); here we assert the record: hit
+    rate > 0, cached prefill dispatches STRICTLY below the cold run's,
+    full-hit admission p50 below cold admission p50, and the hit-rate +
+    bytes-cached accounting in the obs block."""
+    env = dict(os.environ, PADDLE_TPU_OBS="1")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--serve", "--prefix-mix"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=1200)
+    if proc.returncode:
+        print(f"serve_prefix: bench rc={proc.returncode} (parity or "
+              f"dispatch-accounting assert tripped in-bench)")
+        return 1
+    try:
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+        sp = record["serve_prefix"]
+        cached, cold = sp["cached"], sp["cold"]
+    except Exception as e:
+        print(f"serve_prefix: unparseable bench record: {e}")
+        return 1
+    ok = True
+    if not cached.get("hit_rate", 0) > 0:
+        print(f"serve_prefix: hit rate {cached.get('hit_rate')} not > 0")
+        ok = False
+    else:
+        print(f"serve_prefix: hit rate {cached['hit_rate']} "
+              f"({cached['hits_full']} full / {cached['hits_partial']} "
+              f"partial / {cached['misses']} miss)")
+    if not cached["prefill_dispatches"] < cold["prefill_dispatches"]:
+        print(f"serve_prefix: cached prefills "
+              f"{cached['prefill_dispatches']} not strictly below cold "
+              f"{cold['prefill_dispatches']}")
+        ok = False
+    else:
+        print(f"serve_prefix: prefills {cached['prefill_dispatches']} "
+              f"vs cold {cold['prefill_dispatches']} "
+              f"({sp['prefill_dispatches_avoided']} avoided, "
+              f"{sp['zero_dispatch_full_hits']} zero-dispatch full "
+              f"hits)")
+    p50_full = cached["admission_p50_s"].get("full")
+    p50_cold = cold["admission_p50_s"]
+    if p50_full is None or not p50_full < p50_cold:
+        print(f"serve_prefix: full-hit admission p50 {p50_full} not "
+              f"below cold {p50_cold}")
+        ok = False
+    else:
+        print(f"serve_prefix: admission p50 full {p50_full*1e3:.2f}ms "
+              f"vs cold {p50_cold*1e3:.2f}ms")
+    obs = record.get("obs") or {}
+    if not obs.get("enabled") or "hit_rate" not in obs \
+            or "bytes_cached" not in obs:
+        print(f"serve_prefix: obs block missing hit-rate/bytes-cached "
+              f"accounting (keys: {sorted(obs)})")
+        ok = False
+    else:
+        print(f"serve_prefix: obs block OK (hit_rate {obs['hit_rate']}, "
+              f"bytes_cached {obs['bytes_cached']})")
+    return 0 if ok else 1
+
+
 def main():
     if "--probe-serve-export" in sys.argv:
         return probe_serve_export()
     if "--probe-serve-sharded" in sys.argv:
         return probe_serve_sharded()
+    if "--probe-serve-prefix" in sys.argv:
+        return probe_serve_prefix()
     os.makedirs("/tmp/roundtail", exist_ok=True)
     results = {}
     for name, cmd, env_extra in STEPS:
