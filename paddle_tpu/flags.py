@@ -151,6 +151,20 @@ define_flag("use_decode_attention", True,
             "route single-token GQA cache attention through the Pallas "
             "decode kernel (ops/pallas/decode_attention.py); MHA (no "
             "head sharing) stays on XLA, which is faster there")
+define_flag("decode_quant", "",
+            "default decode dtype recipe for LlamaDecoder when neither "
+            "quant= nor weight_dtype= is passed: '' (fp32/bf16, the "
+            "default), 'int8w' (per-channel absmax int8 weights, dequant "
+            "fused into the matmuls) or 'int8wk' (int8w + int8 KV cache "
+            "with per-row absmax scales, dequant-on-load in the scan "
+            "body); the PADDLE_TPU_DECODE_QUANT environment variable is "
+            "an equivalent switch")
+define_flag("decode_attention_interpret", False,
+            "route eligible decode attention through the Pallas decode "
+            "kernel in INTERPRET mode when not on a TPU backend (off-TPU "
+            "the kernel is normally skipped for the faster XLA form); "
+            "the CPU-harness parity evidence for the kernel-routed "
+            "chunked decode path — never a production switch")
 define_flag("decode_fallback", False,
             "serve LlamaDecoder.generate / nn.generation.generate_tokens "
             "through the per-token host loop (one dispatch + one host sync "

@@ -240,11 +240,23 @@ def export_decoder_bundle(decoder, out_dir: str,
     manifest = {}
 
     def _cache_meta(kc):
-        leaves = jax.tree_util.tree_leaves(kc)
-        return {"shape": list(leaves[0].shape),
-                "n_buffers": len(leaves),
-                "dtype": str(leaves[0].dtype),
-                "layout": "stacked" if len(leaves) == 1 else "per_layer"}
+        from paddle_tpu.quantization.kv_cache import is_quantized_kv
+        bufs = kc if isinstance(kc, tuple) else (kc,)
+        meta = {"n_buffers": len(bufs),
+                "layout": "stacked" if len(bufs) == 1 else "per_layer"}
+        if is_quantized_kv(bufs[0]):
+            # int8 KV carry (the int8wk recipe): the serving process
+            # rebuilds {"q": int8, "s": f32 scale} buffers from this
+            meta.update(
+                shape=list(bufs[0]["q"].shape),
+                dtype=str(bufs[0]["q"].dtype),
+                quant={"kv": str(bufs[0]["q"].dtype),
+                       "scale_shape": list(bufs[0]["s"].shape),
+                       "scale_dtype": str(bufs[0]["s"].dtype)})
+        else:
+            meta.update(shape=list(bufs[0].shape),
+                        dtype=str(bufs[0].dtype))
+        return meta
 
     for B in batch_sizes:
         kc, vc = decoder._empty_cache(int(B))
@@ -401,7 +413,19 @@ def export_decoder_bundle(decoder, out_dir: str,
             "temperature": "runtime",
             "default_temperature": float(temperature),
             "top_k": None if top_k is None else int(top_k),
-            "top_p": None if top_p is None else float(top_p)}
+            "top_p": None if top_p is None else float(top_p),
+            # the dtype recipe baked into every entry (weights are
+            # StableHLO constants; the KV carry dtype is structural):
+            # load-side serving cross-checks an explicit quant ask
+            # against this and refuses mismatches typed
+            "quant": {
+                "recipe": getattr(decoder, "quant", None) or "none",
+                "weights": ("int8" if getattr(decoder, "weight_dtype",
+                                              None) == "int8"
+                            else str(jnp.dtype(cfg.dtype))),
+                "kv_cache": ("int8" if getattr(decoder, "quant_kv", False)
+                             else str(jnp.dtype(cfg.dtype))),
+            }}
     if eng is not None:
         mode["speculative"] = {
             "num_speculative_tokens": K,
@@ -510,6 +534,20 @@ class AotPredictor:
             self.warmup()
 
     # -- common ------------------------------------------------------------
+    @property
+    def quant_recipe(self) -> Optional[str]:
+        """The dtype recipe this bundle was exported with (``None`` =
+        unquantized, else 'int8w'/'int8wk'). Read from
+        ``decode_mode.quant``; legacy bundles fall back to the
+        ``weight_dtype`` metadata (int8 weights = 'int8w')."""
+        mode = self.meta.get("decode_mode") or {}
+        q = mode.get("quant")
+        if q is not None:
+            r = q.get("recipe")
+            return None if r in (None, "none") else r
+        return ("int8w" if self.meta.get("weight_dtype") == "int8"
+                else None)
+
     def get_input_names(self) -> List[str]:
         return list(self.meta["inputs"])
 
@@ -621,6 +659,11 @@ class AotPredictor:
             for b, cm in self.meta["caches"].items():
                 per = int(np.prod(cm["shape"])) * cm["n_buffers"] \
                     * np.dtype(cm["dtype"]).itemsize
+                q = cm.get("quant")
+                if q is not None:        # + the int8 carry's f32 scales
+                    per += int(np.prod(q["scale_shape"])) \
+                        * cm["n_buffers"] \
+                        * np.dtype(q["scale_dtype"]).itemsize
                 caches[b] = 2 * per                      # K and V
             report["kv_cache_bytes_per_batch"] = caches
         return report
@@ -708,8 +751,15 @@ class AotPredictor:
         cm = self.meta[which][str(B)]
         dt = jnp.dtype(cm["dtype"])
         shape = tuple(cm["shape"])
+        quant = cm.get("quant")
 
         def z():
+            if quant is not None:
+                # int8wk carry: int8 rows + their scale buffer (never
+                # mesh-exported — int8wk is refused on a mesh at build)
+                return {"q": jnp.zeros(shape, dt),
+                        "s": jnp.zeros(tuple(quant["scale_shape"]),
+                                       jnp.dtype(quant["scale_dtype"]))}
             buf = jnp.zeros(shape, dt)
             if self._sharding is None:
                 return buf
@@ -779,18 +829,33 @@ class AotPredictor:
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False,
                  temperature: Optional[float] = None,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0, quant: Optional[str] = None) -> np.ndarray:
         """Serve a decode: the whole token loop is ONE exported fused
         module execution. Eos id (``None`` or negative = no eos), seed
         and — on current bundles — temperature are runtime inputs;
         ``do_sample``/``top_k``/``top_p`` were fixed at export and a
-        mismatching request is a contract violation. Speculative bundles
+        mismatching request is a contract violation. ``quant`` is a
+        cross-check against the recipe baked into the bundle
+        (``decode_mode.quant``): an unquantized bundle refuses a
+        quantized ask typed (``QuantMismatchError``) and vice versa —
+        ``None`` serves whatever was exported. Speculative bundles
         (``decode_mode.speculative``) additionally run the exported
         draft prefill and record the round/acceptance totals in
         ``last_spec_stats``."""
         if self.meta["kind"] != "llama_decoder":
             raise ValueError(f"bundle kind {self.meta['kind']!r} cannot "
                              "generate; use run()")
+        if quant is not None:
+            from paddle_tpu.quantization.kv_cache import (
+                QuantMismatchError, canonical_quant)
+            want, have = canonical_quant(quant), self.quant_recipe
+            if want != have:
+                raise QuantMismatchError(
+                    f"this bundle was exported with quant recipe "
+                    f"{have or 'none'!r} (weights are baked StableHLO "
+                    f"constants); the ask for {want or 'none'!r} cannot "
+                    f"be served — re-export the decoder with the "
+                    f"matching quant=")
         import jax.numpy as jnp
 
         from paddle_tpu.inference.generate import _normalize_eos

@@ -122,11 +122,40 @@ def _mm(x, p, name, sharded=False):
     return x @ p[name]
 
 
+def _cache_layer(kc, li):
+    """ONE layer's buffer out of a stacked cache: plain slice for an
+    array, per-leaf slice for a quantized ``{"q", "s"}`` buffer."""
+    from paddle_tpu.quantization.kv_cache import is_quantized_kv
+    if is_quantized_kv(kc):
+        return {"q": kc["q"][li], "s": kc["s"][li]}
+    return kc[li]
+
+
+def _cache_layer_set(kc, kc_l, li):
+    """Write one layer's updated buffer back into a stacked cache."""
+    from paddle_tpu.quantization.kv_cache import is_quantized_kv
+    if is_quantized_kv(kc):
+        return {"q": jax.lax.dynamic_update_slice(
+                    kc["q"], kc_l["q"][None], (li, 0, 0, 0, 0)),
+                "s": jax.lax.dynamic_update_slice(
+                    kc["s"], kc_l["s"][None], (li, 0, 0, 0, 0))}
+    return jax.lax.dynamic_update_slice(kc, kc_l[None], (li, 0, 0, 0, 0))
+
+
 def _cache_update(buf, t, pos, head_major):
     """Write t into ONE layer's cache buffer at [pos, pos+S). Scalar pos:
     a single dynamic-update-slice. Per-row (B,) pos: the same DUS vmapped
     over the batch (lowers to scatter — each row lands at its own
-    offset, the speculative-decode requirement)."""
+    offset, the speculative-decode requirement). A quantized buffer
+    (``int8wk``) quantizes the incoming rows by per-row absmax and
+    updates the int8 and scale leaves with the SAME index math (the
+    scale keeps a last dim of 1, so ranks line up)."""
+    from paddle_tpu.quantization.kv_cache import (is_quantized_kv,
+                                                  quantize_kv_rows)
+    if is_quantized_kv(buf):
+        qt = quantize_kv_rows(t)
+        return {"q": _cache_update(buf["q"], qt["q"], pos, head_major),
+                "s": _cache_update(buf["s"], qt["s"], pos, head_major)}
     if jnp.ndim(pos) == 1:
         if head_major:     # buf (B, KV, L, D), t (B, KV, S, D)
             f = lambda c, u, p0: jax.lax.dynamic_update_slice(  # noqa: E731
@@ -178,34 +207,45 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
         kc = tuple(kc_l if i == li else c for i, c in enumerate(kc))
         vc = tuple(vc_l if i == li else c for i, c in enumerate(vc))
     else:
-        kc_l = _cache_update(kc[li], kt, pos, head_major)
-        vc_l = _cache_update(vc[li], vt, pos, head_major)
-        kc = jax.lax.dynamic_update_slice(kc, kc_l[None],
-                                          (li, 0, 0, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, vc_l[None],
-                                          (li, 0, 0, 0, 0))
+        kc_l = _cache_update(_cache_layer(kc, li), kt, pos, head_major)
+        vc_l = _cache_update(_cache_layer(vc, li), vt, pos, head_major)
+        kc = _cache_layer_set(kc, kc_l, li)
+        vc = _cache_layer_set(vc, vc_l, li)
 
     from paddle_tpu.flags import flags as _flags
     from paddle_tpu.ops.pallas import decode_attention as _da
-    use_kernel = (head_major and S == 1 and jnp.ndim(pos) == 0
+    from paddle_tpu.quantization.kv_cache import (dequantize_kv,
+                                                  is_quantized_kv)
+    quant_kv = is_quantized_kv(kc_l)
+    use_kernel = (head_major and S == 1 and jnp.ndim(pos) <= 1
                   and not sharded
                   and _flags.use_decode_attention
-                  and jax.default_backend() == "tpu"
-                  and _da.supported(q[:, 0], kc_l))
+                  and (jax.default_backend() == "tpu"
+                       or _flags.decode_attention_interpret)
+                  and _da.supported(q[:, 0],
+                                    kc_l["q"] if quant_kv else kc_l))
     # per-row qpos: scalar pos broadcasts as (1,1,S,1), vector as (B,1,S,1)
     qpos = (jnp.reshape(pos, (-1, 1, 1, 1))
             + jnp.arange(S)[None, None, :, None])
     if use_kernel:
         # one-kernel GQA cache attention (block_multi_head_attention
         # capability): no repeated-KV materialization, online softmax,
-        # compute skipped past the valid prefix. Measured (v5e, B=8
+        # compute skipped past the valid prefix; ``pos`` may be per-row
+        # (the chunked serving path, where rows sit at different cache
+        # offsets). Int8 caches (int8wk) stream int8 tiles and dequant
+        # in VMEM against their per-row scales. Measured (v5e, B=8
         # D=64): 8-way GQA L=4096 0.24 ms vs 0.88 ms XLA; 4-way L=8192
         # 0.60 ms vs 2.06 ms; ~1B GQA4 end-to-end 2.98 vs 7.08 ms/tok.
-        out = _da.decode_attention(q[:, 0], kc_l, vc_l,
-                                   pos + 1).reshape(B, S, H * D)
+        if quant_kv:
+            out = _da.decode_attention(
+                q[:, 0], kc_l["q"], vc_l["q"], pos + 1,
+                k_scale=kc_l["s"], v_scale=vc_l["s"]).reshape(B, S, H * D)
+        else:
+            out = _da.decode_attention(q[:, 0], kc_l, vc_l,
+                                       pos + 1).reshape(B, S, H * D)
     elif head_major:
-        kk = jnp.repeat(kc_l, rep, axis=1)
-        vv = jnp.repeat(vc_l, rep, axis=1)
+        kk = jnp.repeat(dequantize_kv(kc_l, q.dtype), rep, axis=1)
+        vv = jnp.repeat(dequantize_kv(vc_l, q.dtype), rep, axis=1)
         scores = jnp.einsum("bqhd,bhkd->bhqk", q, kk) / jnp.sqrt(
             jnp.float32(D)).astype(q.dtype)
         kpos = jnp.arange(max_len)[None, None, None, :]
@@ -214,7 +254,8 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhqk,bhkd->bqhd", attn, vv).reshape(B, S, H * D)
     else:
-        kk, vv = kc_l, vc_l                       # (B, max_len, KV, D)
+        kk = dequantize_kv(kc_l, q.dtype)         # (B, max_len, KV, D)
+        vv = dequantize_kv(vc_l, q.dtype)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(
             jnp.float32(D)).astype(q.dtype)
         kpos = jnp.arange(max_len)[None, None, None, :]
@@ -432,13 +473,27 @@ class LlamaDecoder:
 
     def __init__(self, model: LlamaForCausalLM, max_len: int = 512,
                  weight_dtype: Optional[str] = None, mesh=None,
-                 partition_rules=None):
-        """weight_dtype="int8": per-output-channel weight-only quantization
-        of the decoder/MLP matmul weights (embedding and final norm stay in
-        the activation dtype). On TPU the dequant runs inside the Pallas
-        matmul tile (ops/pallas/int8_matmul), so the quantized matrices
-        stream int8 from HBM — halving the weight bandwidth that bounds
-        small-batch decode (reference weight_only_linear capability).
+                 partition_rules=None, quant: Optional[str] = None):
+        """``quant`` picks the decode dtype recipe
+        (quantization/kv_cache.resolve_decode_quant; default also via
+        ``FLAGS_decode_quant`` / ``PADDLE_TPU_DECODE_QUANT``):
+
+        - ``"int8w"`` — per-output-channel absmax int8 weight-only
+          quantization of the decoder/MLP matmuls (embedding and norms
+          stay in the activation dtype); the legacy
+          ``weight_dtype="int8"`` argument is an alias. On TPU the
+          dequant runs inside the Pallas matmul tile
+          (ops/pallas/int8_matmul), so the quantized matrices stream
+          int8 from HBM — halving the weight bandwidth that bounds
+          small-batch decode (reference weight_only_linear capability).
+        - ``"int8wk"`` — int8w PLUS an int8 KV cache: every written K/V
+          row quantizes by per-row absmax (scales live beside the int8
+          rows in the ``DecodeState`` carry) and dequantizes on load
+          inside the scan body's attention — or inside the Pallas
+          decode-attention tile — so neither the weights nor the cache
+          ever materialize an fp copy in HBM. Refused typed on a mesh
+          (``QuantizedKVMeshError``); ``int8w`` serves on a mesh via
+          the XLA dequant form.
 
         Decode steps are kernel-count-sensitive (the scan body runs ~1ms
         of tiny ops on a 134M model): q/k/v and gate/up are concatenated
@@ -458,12 +513,14 @@ class LlamaDecoder:
         Greedy and per-row-keyed sampled TOKENS are bit-exact with the
         single-device path; speculative decode is refused with a typed
         ``SpeculativeMeshError``."""
-        if weight_dtype not in (None, "int8"):
-            raise ValueError(f"weight_dtype must be None or 'int8', "
-                             f"got {weight_dtype!r}")
+        from paddle_tpu.quantization.kv_cache import resolve_decode_quant
+        self.quant = resolve_decode_quant(quant, weight_dtype)
+        # legacy surface (bundle meta, draft-param reuse): any quantized
+        # recipe quantizes the weights int8
+        self.weight_dtype = "int8" if self.quant else None
+        self.quant_kv = self.quant == "int8wk"
         self.cfg = model.config
         self.max_len = max_len
-        self.weight_dtype = weight_dtype
         self.sharding = None
         if mesh is not None:
             from paddle_tpu.inference.sharding import DecodeSharding
@@ -472,7 +529,13 @@ class LlamaDecoder:
                                                  rules=partition_rules))
         elif partition_rules is not None:
             raise ValueError("partition_rules requires a mesh")
-        self.params = _build_params(model, max_len, weight_dtype)
+        if self.quant_kv and self.sharding is not None:
+            from paddle_tpu.inference.sharding import QuantizedKVMeshError
+            raise QuantizedKVMeshError(
+                "quant='int8wk' does not run on a mesh yet: the int8 KV "
+                "carry's scale buffers have no partition rules; use "
+                "quant='int8w' (weight-only) on a mesh, or drop mesh=")
+        self.params = _build_params(model, max_len, self.weight_dtype)
         if self.sharding is not None:
             self.params = self.sharding.shard_params(self.params)
         cfg = self.cfg
@@ -715,6 +778,11 @@ class LlamaDecoder:
         head_major = cfg.num_attention_heads != cfg.num_key_value_heads
 
         def z(shape):
+            if self.quant_kv:
+                # int8 rows + per-row scale buffer (never on a mesh:
+                # int8wk is refused typed at init)
+                from paddle_tpu.quantization.kv_cache import quant_kv_zeros
+                return quant_kv_zeros(shape, jnp)
             buf = jnp.zeros(shape, dt)
             if self.sharding is None:
                 return buf

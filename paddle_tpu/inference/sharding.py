@@ -36,13 +36,23 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["DecodeSharding", "DEFAULT_DECODE_RULES", "MeshMismatchError",
-           "SpeculativeMeshError"]
+           "SpeculativeMeshError", "QuantizedKVMeshError"]
 
 
 class MeshMismatchError(ValueError):
     """A mesh/sharding contract violation: a bundle exported for one mesh
     loaded under another, an engine mesh that contradicts its backend's,
     or too few devices for a recorded topology."""
+
+
+class QuantizedKVMeshError(NotImplementedError):
+    """The ``int8wk`` recipe (int8 KV cache + per-row scales) is not
+    supported on a mesh yet: the quantized carry's scale buffers have no
+    partition rules and the hand-written kernels gate off under GSPMD
+    anyway, so the bandwidth win would not materialize. ``int8w``
+    (weight-only) DOES serve on a mesh — the dequant matmul falls back
+    to the XLA form, which shards like any dot. Typed so decoder
+    construction refuses up front, never a mid-dispatch failure."""
 
 
 class SpeculativeMeshError(NotImplementedError):

@@ -283,14 +283,19 @@ class LlamaForCausalLM(nn.Layer):
             if not isinstance(mesh, DecodeSharding):
                 mesh = DecodeSharding(mesh)
             mesh_key = tuple(sorted(mesh.axes.items()))
+        # quant= picks the decode dtype recipe (int8w weight-only /
+        # int8wk weights+KV; quantization/kv_cache) — part of the
+        # decoder cache key: switching recipes rebuilds
+        from paddle_tpu.quantization.kv_cache import resolve_decode_quant
+        quant = resolve_decode_quant(kwargs.pop("quant", None))
         # the decoder snapshots weights: rebuild when any param buffer has
         # been swapped since (optimizer step / set_state_dict)
         version = (tuple(id(p._value) for p in self.parameters()),
-                   mesh_key)
+                   mesh_key, quant)
         dec = self.__dict__.get("_decoder")
         if (dec is None or dec.max_len < need
                 or self.__dict__.get("_decoder_version") != version):
-            dec = LlamaDecoder(self, max_len=ml, mesh=mesh)
+            dec = LlamaDecoder(self, max_len=ml, mesh=mesh, quant=quant)
             self.__dict__["_decoder"] = dec
             self.__dict__["_decoder_version"] = version
         return dec.generate(input_ids, max_new_tokens=max_new_tokens,

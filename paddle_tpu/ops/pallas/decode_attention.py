@@ -10,13 +10,22 @@ the whole thing in one pass:
 - grid (B, KV-heads, L-blocks); the ``rep`` query heads sharing a KV head
   ride one program (GQA without materializing repeated K/V),
 - online-softmax accumulation across cache blocks in VMEM scratch,
-- a dynamic length bound (``pos``, SMEM scalar): blocks past the valid
-  prefix skip their compute (``pl.when``), so padded cache tails cost
-  DMA only, and masked positions never enter the softmax.
+- a dynamic length bound (``pos``, SMEM scalars — a traced scalar for
+  the classic lockstep decode, or a PER-ROW ``(B,)`` vector for the
+  chunked/speculative paths where rows sit at different cache offsets):
+  blocks past a row's valid prefix skip their compute (``pl.when``), so
+  padded cache tails cost DMA only, and masked positions never enter
+  the softmax,
+- optional int8 cache tiles (the ``int8wk`` decode recipe): K/V stream
+  int8 from HBM and dequantize IN VMEM against their per-row scales
+  (``k_scale``/``v_scale``, the cache's ``(..., 1)`` scale buffers) —
+  the same dequant-inside-the-tile discipline as int8_matmul, so the
+  quantized cache's bandwidth win survives into the kernel.
 
 Layouts: q (B, H, D) one token per sequence; kc/vc (B, KV, L, D) padded
-cache (head-major, so cache blocks are contiguous (L, D) tiles); out
-(B, H, D). Inference-path only (no custom VJP).
+cache (head-major, so cache blocks are contiguous (L, D) tiles), f32/bf16
+or int8 with (B, KV, L, 1) scales; out (B, H, D). Inference-path only
+(no custom VJP).
 """
 
 from __future__ import annotations
@@ -44,8 +53,12 @@ def supported(q, kc) -> bool:
     return H % KV == 0 and D % 8 == 0 and L % 128 == 0
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-            *, scale, bl, nl, rep):
+def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale, bl, nl, rep, quant):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
     li = pl.program_id(2)
 
     @pl.when(li == 0)
@@ -54,13 +67,18 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    n_valid = pos_ref[0]                           # valid cache length
+    n_valid = pos_ref[b]                           # THIS row's valid length
 
     @pl.when(li * bl < n_valid)
     def _block():
         q = q_ref[0, 0].astype(jnp.float32)        # (rep, D)
         k = k_ref[0, 0].astype(jnp.float32)        # (bl, D)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            # dequant in VMEM: int8 rows times their per-row scales —
+            # the cache streamed int8 all the way from HBM
+            k = k * ks_ref[0, 0].astype(jnp.float32)     # (bl, 1)
+            v = v * vs_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         idx = li * bl + jax.lax.broadcasted_iota(jnp.int32, (rep, bl), 1)
@@ -82,9 +100,14 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
 
 @functools.partial(jax.jit, static_argnames=("block_l",))
-def decode_attention(q, kc, vc, pos, block_l: int = 256):
+def decode_attention(q, kc, vc, pos, block_l: int = 256,
+                     k_scale=None, v_scale=None):
     """q (B, H, D) x cache (B, KV, L, D), valid length ``pos`` (traced
-    scalar; positions >= pos are masked) -> (B, H, D)."""
+    scalar, or a per-row ``(B,)`` vector when rows sit at different
+    cache offsets; positions >= the row's bound are masked) -> (B, H, D).
+    Int8 caches pass their per-row scale buffers via
+    ``k_scale``/``v_scale`` ((B, KV, L, 1) f32) and dequantize inside
+    the tile."""
     B, H, D = q.shape
     _, KV, L, _ = kc.shape
     rep = H // KV
@@ -94,22 +117,35 @@ def decode_attention(q, kc, vc, pos, block_l: int = 256):
     nl = L // bl
     scale = 1.0 / math.sqrt(D)
     q4 = q.reshape(B, KV, rep, D)
+    quant = k_scale is not None
+    out_dtype = q.dtype
+    pos_b = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, rep, D), lambda b, g, l: (b, g, 0, 0)),
+        pl.BlockSpec((1, 1, bl, D), lambda b, g, l: (b, g, l, 0)),
+        pl.BlockSpec((1, 1, bl, D), lambda b, g, l: (b, g, l, 0)),
+    ]
+    args = [pos_b, q4, kc, vc]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bl, 1), lambda b, g, l: (b, g, l, 0)),
+            pl.BlockSpec((1, 1, bl, 1), lambda b, g, l: (b, g, l, 0)),
+        ]
+        args += [k_scale, v_scale]
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, bl=bl, nl=nl, rep=rep),
+        functools.partial(_kernel, scale=scale, bl=bl, nl=nl, rep=rep,
+                          quant=quant),
         grid=(B, KV, nl),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, rep, D), lambda b, g, l: (b, g, 0, 0)),
-            pl.BlockSpec((1, 1, bl, D), lambda b, g, l: (b, g, l, 0)),
-            pl.BlockSpec((1, 1, bl, D), lambda b, g, l: (b, g, l, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, rep, D), lambda b, g, l: (b, g, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, KV, rep, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KV, rep, D), out_dtype),
         scratch_shapes=[
             pltpu.VMEM((rep, 128), jnp.float32),
             pltpu.VMEM((rep, 128), jnp.float32),
             pltpu.VMEM((rep, D), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(jnp.asarray(pos, jnp.int32).reshape(1), q4, kc, vc)
+    )(*args)
     return out.reshape(B, H, D)

@@ -1,0 +1,126 @@
+"""Int8 KV-cache quantization for the decode stack (the ``int8wk`` recipe).
+
+Pope et al. (PAPERS.md): small-batch decode is bound by HBM reads of the
+weights AND the KV cache — every decoded token re-reads the whole valid
+prefix of K/V. Storing the cache int8 cuts that stream ~4x vs f32 (~2x
+vs bf16) at the cost of one dequant multiply that fuses into the
+attention matmuls (dequant-on-load feeding the MXU; LLM.int8/AWQ
+weight-only lineage, PAPERS.md).
+
+Representation: a quantized cache buffer is a plain ``{"q", "s"}`` dict
+(a standard pytree — it flows through jit carries, ``jax.export``
+bundle entries, the serving engine's admission row-scatter and the
+prefix-cache slab ops without any custom-node registration):
+
+- ``q``: int8, the same shape the unquantized cache buffer had;
+- ``s``: f32 per-position-per-head scales with a KEPT last dim of 1
+  (``q.shape[:-1] + (1,)``), so every structural transform that indexes
+  "the rank-relative batch/length axis" (``ndim - 4`` in the engine
+  scatter and SlabOps) lands on the same axis for both leaves.
+
+Each written K/V row quantizes by its own absmax over the head dim —
+scales travel WITH their rows, so chunk re-entry, admission scatter and
+prefix-slab extract/load stay bit-exact with run-to-completion (the
+quantize/dequantize of a row depends only on that row's values).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["QuantMismatchError", "canonical_quant", "resolve_decode_quant",
+           "is_quantized_kv", "quantize_kv_rows", "dequantize_kv",
+           "quant_kv_zeros", "QUANT_RECIPES"]
+
+#: the decode dtype recipes: int8w = per-channel absmax int8 weights
+#: (fp32 scales), int8wk = int8w + int8 KV cache (per-row absmax scales)
+QUANT_RECIPES = ("int8w", "int8wk")
+
+_NONE_ASKS = ("", "none", "fp32", "float32", "bf16", "bfloat16")
+
+
+class QuantMismatchError(ValueError):
+    """A quantization contract violation: an unquantized decoder/bundle
+    asked to serve a quantized recipe, a quantized bundle asked to serve
+    a different recipe (or fp32), or conflicting ``quant=`` /
+    ``weight_dtype=`` arguments. Typed so callers refuse up front
+    instead of silently serving the wrong dtype recipe."""
+
+
+def canonical_quant(quant) -> Optional[str]:
+    """Normalize a quant ask: ``None``/``""``/``"none"``/``"fp32"`` ->
+    ``None`` (unquantized); ``"int8w"``/``"int8wk"`` -> themselves;
+    anything else is a typed refusal."""
+    if quant is None:
+        return None
+    q = str(quant).strip().lower()
+    if q in _NONE_ASKS:
+        return None
+    if q not in QUANT_RECIPES:
+        raise QuantMismatchError(
+            f"unknown decode quant recipe {quant!r}; expected one of "
+            f"{QUANT_RECIPES} (or none/fp32 for the unquantized path)")
+    return q
+
+
+def resolve_decode_quant(quant=None, weight_dtype=None) -> Optional[str]:
+    """The decoder-init recipe resolution: an explicit ``quant=`` wins;
+    the legacy ``weight_dtype="int8"`` aliases ``"int8w"``; with neither,
+    the ``PADDLE_TPU_DECODE_QUANT`` env / ``FLAGS_decode_quant`` default
+    applies (empty = unquantized). Conflicting explicit arguments are a
+    typed refusal."""
+    if weight_dtype not in (None, "int8"):
+        raise ValueError(f"weight_dtype must be None or 'int8', "
+                         f"got {weight_dtype!r}")
+    alias = "int8w" if weight_dtype == "int8" else None
+    if quant is not None:
+        q = canonical_quant(quant)
+        if alias is not None and q is None:
+            raise QuantMismatchError(
+                f"quant={quant!r} contradicts weight_dtype='int8' "
+                f"(pass one or the other)")
+        return q
+    if alias is not None:
+        return alias
+    env = os.environ.get("PADDLE_TPU_DECODE_QUANT", "").strip()
+    if env:
+        return canonical_quant(env)
+    from paddle_tpu.flags import flags
+    return canonical_quant(flags.decode_quant)
+
+
+def is_quantized_kv(cache) -> bool:
+    """True for one quantized cache buffer (the ``{"q", "s"}`` dict)."""
+    return isinstance(cache, dict) and "q" in cache and "s" in cache
+
+
+def quantize_kv_rows(t):
+    """Quantize freshly computed K/V rows ``t (..., D)`` by per-row
+    absmax over the head dim: returns ``{"q": int8 (..., D),
+    "s": f32 (..., 1)}``. Deterministic and row-local — the property
+    every re-entry/scatter bit-exactness claim rides on."""
+    import jax.numpy as jnp
+    x = t.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def dequantize_kv(cache, dtype):
+    """Dequant-on-load: int8 rows times their per-row scales, in
+    ``dtype``. Unquantized buffers pass through untouched, so attention
+    code can call this unconditionally."""
+    if not is_quantized_kv(cache):
+        return cache
+    return cache["q"].astype(dtype) * cache["s"].astype(dtype)
+
+
+def quant_kv_zeros(shape, jnp=None):
+    """An empty quantized cache buffer of the given (unquantized) cache
+    shape."""
+    if jnp is None:
+        import jax.numpy as jnp
+    return {"q": jnp.zeros(shape, jnp.int8),
+            "s": jnp.zeros(tuple(shape[:-1]) + (1,), jnp.float32)}

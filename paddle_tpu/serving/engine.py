@@ -132,14 +132,34 @@ def _make_admit_fn(sharding, head_major):
     return admit
 
 
+def _check_quant_ask(quant, have, what: str) -> None:
+    """Typed quant-recipe cross-check: an engine/caller that asks for a
+    dtype recipe must get exactly that recipe from its backend — an
+    unquantized backend refuses a quantized ask, and vice versa. A
+    ``None`` ask means "serve whatever the backend has" (back-compat)."""
+    if quant is None:
+        return
+    from paddle_tpu.quantization.kv_cache import (QuantMismatchError,
+                                                  canonical_quant)
+    want = canonical_quant(quant)
+    if want != have:
+        raise QuantMismatchError(
+            f"{what} serves quant recipe {have or 'none'!r} but the "
+            f"engine asked for {want or 'none'!r}; rebuild the backend "
+            f"with the matching quant= (or drop the ask)")
+
+
 class _DecoderBackend:
     """In-process backend: the jitted chunk/admission entries of a
     ``LlamaDecoder``."""
 
     def __init__(self, dec, num_slots, chunk_size, do_sample, top_k, top_p,
-                 mesh=None):
+                 mesh=None, quant=None):
         from paddle_tpu.inference.sharding import MeshMismatchError
+        _check_quant_ask(quant, getattr(dec, "quant", None),
+                         "this LlamaDecoder")
         self.dec = dec
+        self.quant = getattr(dec, "quant", None)
         self.num_slots = int(num_slots)
         self.max_len = dec.max_len
         self.prompt_buckets = None          # any pow2 bucket compiles
@@ -230,9 +250,11 @@ class _BundleBackend:
     serving process runs no model Python (``decode_mode.chunked``)."""
 
     def __init__(self, pred, num_slots, chunk_size, do_sample, top_k,
-                 top_p, mesh=None):
+                 top_p, mesh=None, quant=None):
         from paddle_tpu.inference.sharding import MeshMismatchError
+        _check_quant_ask(quant, pred.quant_recipe, "this bundle")
         self.pred = pred
+        self.quant = pred.quant_recipe
         self.num_slots = int(num_slots)
         meta = pred.meta
         mode = meta.get("decode_mode") or {}
@@ -376,15 +398,15 @@ class _BundleBackend:
 
 
 def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p,
-                  mesh=None):
+                  mesh=None, quant=None):
     from paddle_tpu.inference.bundle import AotPredictor
     from paddle_tpu.inference.generate import LlamaDecoder
     if isinstance(backend, LlamaDecoder):
         return _DecoderBackend(backend, num_slots, chunk_size, do_sample,
-                               top_k, top_p, mesh=mesh)
+                               top_k, top_p, mesh=mesh, quant=quant)
     if isinstance(backend, AotPredictor):
         return _BundleBackend(backend, num_slots, chunk_size, do_sample,
-                              top_k, top_p, mesh=mesh)
+                              top_k, top_p, mesh=mesh, quant=quant)
     raise TypeError(
         f"backend must be a LlamaDecoder or an AotPredictor, "
         f"got {type(backend).__name__}")
@@ -428,7 +450,8 @@ class ServingEngine:
                  = None, mesh=None, prefix_cache=None,
                  prefix_cache_bytes: Optional[int] = None,
                  prefix_block_tokens: Optional[int] = None,
-                 batch_admission: bool = False):
+                 batch_admission: bool = False, quant: Optional[str]
+                 = None, cache_aware_admission: Optional[bool] = None):
         """``prefix_cache``: ``None`` reads the
         ``FLAGS_serving_prefix_cache_bytes`` /
         ``PADDLE_TPU_PREFIX_CACHE_BYTES`` budget (0 = disabled, the
@@ -441,13 +464,24 @@ class ServingEngine:
         with ONE batched (suffix-)prefill dispatch instead of
         per-request batch-1 prefills (``admission.dispatches_saved`` in
         ``metrics()``); off by default — the classic one-prefill-per-
-        request accounting stays exact."""
+        request accounting stays exact.
+        ``quant``: cross-check only — the backend must serve exactly
+        this dtype recipe ('int8w'/'int8wk'/'none'); an unquantized
+        backend refuses a quantized ask typed
+        (``QuantMismatchError``) and vice versa. ``None`` = serve
+        whatever the backend has.
+        ``cache_aware_admission``: among same-priority queued requests,
+        admit in an order that maximizes prefix-slab reuse (requests
+        whose digest is already cached lead; same-digest requests admit
+        together; FIFO within a digest group) — defaults to ON whenever
+        the prefix cache is enabled; ``serving.admission.cache_reordered``
+        in ``metrics()`` counts the queue jumps."""
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
         self.chunk_size = int(chunk_size)
         self._b = _make_backend(backend, num_slots, chunk_size, do_sample,
-                                top_k, top_p, mesh=mesh)
+                                top_k, top_p, mesh=mesh, quant=quant)
         # on a mesh the slot table maps onto the dp axis: contiguous
         # blocks of num_slots/dp rows are one data-parallel replica's
         # slots (jax shards a dim into contiguous blocks); the scheduler
@@ -475,6 +509,16 @@ class ServingEngine:
             self.prefix_cache.bind_mesh(srd.axes if srd is not None
                                         else None)
             self._slab_ops = SlabOps(srd, self._b.head_major)
+        # cache-aware admission ordering: on by default when the prefix
+        # cache is (the scheduler's probe answers "is this digest a
+        # guaranteed slab hit right now"); reordering is confined to a
+        # priority tier and FIFO holds within a digest group
+        self._cache_aware = (bool(cache_aware_admission)
+                             if cache_aware_admission is not None
+                             else self.prefix_cache is not None)
+        if self._cache_aware and self.prefix_cache is not None:
+            self.scheduler.cache_aware = True
+            self.scheduler.cache_probe = self.prefix_cache.has_digest
         # the engine's own always-on metrics registry (paddle_tpu/obs):
         # replaces the ad-hoc counter ints / delay-and-occupancy lists of
         # round 9 — same bookkeeping cost, but one typed store feeding
@@ -557,6 +601,11 @@ class ServingEngine:
             "serving.admission.dispatches_saved",
             "prefill dispatches avoided vs one-per-request admission "
             "(batched groups + full-prefix hits)")
+        self._c_reordered = r.counter(
+            "serving.admission.cache_reordered",
+            "queued requests admitted ahead of an earlier-submitted "
+            "same-priority peer because their prefix digest maximized "
+            "slab reuse (cache-aware admission ordering)")
         self._h_admit = {
             cls: r.histogram(f"serving.admission_s.{cls}",
                              f"per-request admission wall time, "
@@ -639,13 +688,21 @@ class ServingEngine:
                 f"max_len {self._b.max_len}")
         rid = self._next_id
         self._next_id += 1
-        self.scheduler.push(Request(
+        req = Request(
             id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_token_id=_normalize_eos(eos_token_id),
             temperature=float(temperature), seed=int(seed),
             priority=int(priority), submit_time=time.monotonic(),
             latency_class=str(latency_class),
-            slo_ttft_s=slo_ttft_s, slo_latency_s=slo_latency_s))
+            slo_ttft_s=slo_ttft_s, slo_latency_s=slo_latency_s)
+        if self.scheduler.cache_aware:
+            # the cache-aware ordering's grouping key: the prompt's
+            # FIRST block-boundary digest (the shortest ladder entry) —
+            # requests sharing >= one hash block group together
+            from paddle_tpu.serving.prefix_cache import prefix_digests
+            req.prefix_group = prefix_digests(
+                prompt, self.prefix_cache.block_tokens)[-1][1]
+        self.scheduler.push(req)
         self._g_qdepth.set(len(self.scheduler))
         obs.tracer.event("serving.request.queued", request=rid,
                          prompt_len=len(prompt),
@@ -660,6 +717,9 @@ class ServingEngine:
         now = time.monotonic()
         self._h_qdepth.observe(len(self.scheduler))
         admitted = self.scheduler.admissions()
+        if self.scheduler.cache_reordered > int(self._c_reordered.value):
+            self._c_reordered.inc(self.scheduler.cache_reordered
+                                  - int(self._c_reordered.value))
         if admitted:
             self._admit_all(admitted, now)
         self._g_qdepth.set(len(self.scheduler))
@@ -1072,6 +1132,7 @@ class ServingEngine:
         return {
             "num_slots": self.num_slots,
             "chunk_size": self.chunk_size,
+            "quant": self._b.quant,
             "mesh": self._mesh_status(),
             "slots": slots,
             "occupancy_now": len(occupied) / self.num_slots,
@@ -1194,6 +1255,7 @@ class ServingEngine:
             # per-hit-class admission latency (NaN until a class has a
             # sample)
             "admission_dispatches_saved": int(self._c_disp_saved.value),
+            "admission_cache_reordered": int(self._c_reordered.value),
             "batched_admission_groups": int(
                 self._c_batched_groups.value),
             "prefill_tokens_saved": int(self._c_tokens_saved.value),

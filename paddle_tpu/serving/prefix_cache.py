@@ -113,10 +113,13 @@ class PrefixSlab:                    # device arrays
     digests: List[str] = dataclasses.field(default_factory=list)
     refs: int = 0
     stamp: int = 0            # LRU clock (bumped on hit/insert)
+    dtype: str = ""           # KV leaf dtypes, e.g. "float32" or
+    #                           "int8+float32" (int8 rows + f32 scales)
 
     def describe(self) -> dict:
         return {"length": self.length, "bucket": self.bucket,
-                "bytes": self.nbytes, "refs": self.refs}
+                "bytes": self.nbytes, "dtype": self.dtype,
+                "refs": self.refs}
 
 
 @dataclasses.dataclass
@@ -132,9 +135,20 @@ class PrefixLookup:
 
 
 def _nbytes(tree) -> int:
+    """Slab bytes at the arrays' ACTUAL dtypes (tree leaves): an int8 KV
+    slab (the ``int8wk`` decode recipe) charges the byte budget at
+    1 byte/elt plus its f32 scale leaves — never at a notional fp32."""
     import jax
     return int(sum(np.dtype(x.dtype).itemsize * int(np.prod(x.shape))
                    for x in jax.tree_util.tree_leaves(tree)))
+
+
+def _kv_dtype(tree) -> str:
+    """The slab's KV leaf dtypes as a stable string (e.g. "float32",
+    "int8+float32") for /statusz and flight-recorder snapshots."""
+    import jax
+    return "+".join(sorted({str(x.dtype)
+                            for x in jax.tree_util.tree_leaves(tree)}))
 
 
 class SlabOps:
@@ -305,6 +319,14 @@ class PrefixCache:
             self.misses += 1
             return PrefixLookup("miss", None, 0, digests)
 
+    def has_digest(self, digest: str) -> bool:
+        """True when ANY live slab is keyed under this digest — the
+        cache-aware admission ordering's probe (serving/scheduler.py):
+        a queued request whose block-boundary digest is already live
+        will hit if admitted now."""
+        with self._lock:
+            return digest in self._index
+
     def contains_full(self, digests: List[Tuple[int, str]]) -> bool:
         """True when the full-length entry (with resume logits) for this
         digest ladder is already live — the engine skips the slab
@@ -333,6 +355,7 @@ class PrefixCache:
             slab = PrefixSlab(kc=kc, vc=vc, logits=logits, length=S,
                               bucket=int(bucket),
                               nbytes=_nbytes((kc, vc, logits)),
+                              dtype=_kv_dtype((kc, vc)),
                               stamp=next(self._clock))
             for L, d in digests:
                 cur = self._index.get(d)
@@ -417,5 +440,8 @@ class PrefixCache:
             out["occupancy"] = self.bytes_cached / self.bytes_budget
             slabs = sorted(self._slabs, key=lambda s: -s.stamp)[:32]
             out["slab_table"] = [s.describe() for s in slabs]
+            # the dtype recipes the pool holds (int8 slabs charge the
+            # budget at 1 byte/elt — see _nbytes)
+            out["slab_dtypes"] = sorted({s.dtype for s in self._slabs})
             out["mesh"] = self.mesh_axes
             return out

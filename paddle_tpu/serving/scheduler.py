@@ -72,6 +72,11 @@ class Request:
     latency_class: str = "default"
     slo_ttft_s: Optional[float] = None
     slo_latency_s: Optional[float] = None
+    # prefix-cache grouping key (the prompt's first block-boundary
+    # content digest, stamped by the engine when the cache is on):
+    # same-priority requests sharing it are admitted together by the
+    # cache-aware ordering so their admissions reuse one slab
+    prefix_group: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -145,7 +150,7 @@ class Scheduler:
 
     def __init__(self, num_slots: int, policy: str = "fifo",
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 dp_size: int = 1):
+                 dp_size: int = 1, cache_aware: bool = False):
         if policy not in ("fifo", "priority"):
             raise ValueError(f"policy must be 'fifo' or 'priority', "
                              f"got {policy!r}")
@@ -161,6 +166,16 @@ class Scheduler:
         self.slots = SlotTable(num_slots)
         self._heap: list = []
         self._seq = itertools.count()
+        # cache-aware admission ordering (prefix-cache follow-on):
+        # among SAME-priority queued requests, admit in an order that
+        # maximizes prefix-slab reuse — requests whose digest is already
+        # live in the cache (``cache_probe``) lead, and same-digest
+        # requests admit together. FIFO is preserved WITHIN a digest
+        # group (and across priorities); ``cache_reordered`` counts
+        # requests that jumped ahead of an earlier-submitted peer.
+        self.cache_aware = bool(cache_aware)
+        self.cache_probe = None      # Optional[Callable[[str], bool]]
+        self.cache_reordered = 0
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -180,12 +195,63 @@ class Scheduler:
 
     def admissions(self) -> List[Tuple[int, Request]]:
         """Fill every free slot from the queue; returns the
-        ``(slot_index, request)`` pairs admitted this round."""
-        out = []
-        while self._heap and self.slots.free_slots():
-            _, _, req = heapq.heappop(self._heap)
-            out.append((self.slots.occupy(req), req))
-        return out
+        ``(slot_index, request)`` pairs admitted this round. With
+        ``cache_aware`` the pop order within a priority tier bends
+        toward prefix-slab reuse (:meth:`_cache_aware_pops`); plain
+        FIFO/priority order otherwise."""
+        free_n = len(self.slots.free_slots())
+        if not free_n or not self._heap:
+            return []
+        if self.cache_aware:
+            picked = self._cache_aware_pops(free_n)
+        else:
+            picked = [heapq.heappop(self._heap)[2]
+                      for _ in range(min(free_n, len(self._heap)))]
+        return [(self.slots.occupy(req), req) for req in picked]
+
+    def _cache_aware_pops(self, free_n: int) -> List[Request]:
+        """Choose up to ``free_n`` queued requests, reordering ONLY
+        within a priority tier: the tier's head is the earliest request
+        whose ``prefix_group`` digest is already live in the cache
+        (``cache_probe``) — a guaranteed slab hit — else the FIFO head;
+        then same-group followers are pulled forward (FIFO within the
+        group) so one slab serves the whole burst. Requests left over
+        go back on the heap with their original sequence numbers, so
+        nothing is starved and cross-round order stays stable."""
+        entries = []
+        while self._heap:
+            entries.append(heapq.heappop(self._heap))
+        chosen: List[Request] = []
+        while len(chosen) < free_n and entries:
+            p0 = entries[0][0]
+            tier_end = next((i for i, e in enumerate(entries)
+                             if e[0] != p0), len(entries))
+            head_i = 0
+            if self.cache_probe is not None:
+                for j in range(tier_end):
+                    g = entries[j][2].prefix_group
+                    if g is not None and self.cache_probe(g):
+                        head_i = j
+                        break
+            if head_i > 0:
+                self.cache_reordered += 1
+            head = entries.pop(head_i)
+            chosen.append(head[2])
+            tier_end -= 1
+            g = head[2].prefix_group
+            if g is not None:
+                i = 0
+                while i < tier_end and len(chosen) < free_n:
+                    if entries[i][2].prefix_group == g:
+                        if i > 0:
+                            self.cache_reordered += 1
+                        chosen.append(entries.pop(i)[2])
+                        tier_end -= 1
+                    else:
+                        i += 1
+        for e in entries:
+            heapq.heappush(self._heap, e)
+        return chosen
 
     def dp_groups(self) -> List[dict]:
         """How the slot table maps onto the mesh's ``dp`` axis: jax

@@ -237,6 +237,78 @@ def test_decode_attention_kernel_interpret_parity():
     assert not supported(jnp.zeros((2, 5, 8)), jnp.zeros((2, 2, 256, 8)))
 
 
+def test_decode_attention_per_row_pos_and_int8_parity():
+    """The kernel's per-row valid-length bound ((B,) pos — the chunked
+    serving path, where rows sit at different cache offsets) and the
+    int8-cache tiles (dequant in VMEM against per-row scales) both match
+    the masked dense reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention
+    from paddle_tpu.quantization.kv_cache import (dequantize_kv,
+                                                  quantize_kv_rows)
+
+    rng = np.random.default_rng(1)
+    B, L, D, KV, H = 2, 256, 8, 2, 6
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((B, KV, L, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, KV, L, D)), jnp.float32)
+    pos = jnp.asarray([100, 37], jnp.int32)
+    rep = H // KV
+
+    def ref(kd, vd):
+        kk, vv = jnp.repeat(kd, rep, 1), jnp.repeat(vd, rep, 1)
+        s = jnp.einsum("bhd,bhkd->bhk", q, kk) / np.sqrt(D)
+        s = jnp.where(jnp.arange(L)[None, None, :] < pos[:, None, None],
+                      s, -jnp.inf)
+        return np.asarray(jnp.einsum("bhk,bhkd->bhd",
+                                     jax.nn.softmax(s, -1), vv))
+
+    got = np.asarray(decode_attention(q, kc, vc, pos, block_l=128))
+    np.testing.assert_allclose(got, ref(kc, vc), rtol=2e-5, atol=2e-5)
+    qk, qv = quantize_kv_rows(kc), quantize_kv_rows(vc)
+    got8 = np.asarray(decode_attention(
+        q, qk["q"], qv["q"], pos, block_l=128,
+        k_scale=qk["s"], v_scale=qv["s"]))
+    want8 = ref(dequantize_kv(qk, jnp.float32),
+                dequantize_kv(qv, jnp.float32))
+    np.testing.assert_allclose(got8, want8, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_attention_chunked_path_parity():
+    """The chunked decode path routes the SAME decode-attention kernel
+    (per-row pos — no second kernel entry point) behind
+    FLAGS_use_decode_attention: with the flag on (interpret mode off-TPU
+    via FLAGS_decode_attention_interpret) and off, the chunked GQA
+    decode emits identical tokens, fp32 and int8wk alike."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2,   # GQA -> kernel-eligible
+                      max_position_embeddings=256)
+    paddle.seed(9)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(5).integers(0, 64, (2, 4))
+    for quant in (None, "int8wk"):
+        paddle.set_flags({"use_decode_attention": True,
+                          "decode_attention_interpret": True})
+        try:
+            # max_len 128: the kernel's L % 128 == 0 eligibility bound
+            dec_on = LlamaDecoder(model, max_len=128, quant=quant)
+            on = np.asarray(dec_on.generate(ids, 8, chunk_size=3))
+            paddle.set_flags({"use_decode_attention": False})
+            dec_off = LlamaDecoder(model, max_len=128, quant=quant)
+            off = np.asarray(dec_off.generate(ids, 8, chunk_size=3))
+        finally:
+            paddle.set_flags({"use_decode_attention": True,
+                              "decode_attention_interpret": False})
+        np.testing.assert_array_equal(on, off, err_msg=f"quant={quant}")
+
+
 def test_group_norm_silu_fused_matches_unfused():
     """Round-4 fused GroupNorm+SiLU (ops/pallas/group_norm.py, reference
     add_group_norm_silu): value + grad parity vs the lax composition,

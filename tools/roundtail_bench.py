@@ -39,6 +39,19 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      below cold p50, hit-rate + bytes-cached in the obs block; token
      parity and zero-dispatch full hits are hard-asserted in-bench
 
+ 11. decode_quant (`bench.py --decode --quant int8w`): the quantized-
+     decode gate — dispatch counts (prefill + 1), fused/chunked/
+     per-token bit-exactness, >=0.99 teacher-forced top-1 agreement vs
+     fp32, the >=1.8x per-dispatch weight-byte drop from the obs cost
+     telemetry, and decode-attention on/off token parity, all
+     hard-asserted inside the bench; on real TPU this is also where
+     the int8 tokens/s-vs-fp32 numbers for BASELINE.md come from
+
+ 12. serve_quant (`bench.py --serve --quant int8wk`): continuous
+     batching over the int8 weight + int8 KV-cache decoder — the
+     engine's per-request parity and dispatch accounting hard-assert
+     against the quantized carry
+
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
 """
@@ -84,6 +97,18 @@ STEPS = [
     # hit-rate + bytes-cached accounting present in the obs block
     ("serve_prefix", [sys.executable, "tools/roundtail_bench.py",
                       "--probe-serve-prefix"], None),
+    # quantized-decode gate: bench.py --decode --quant — dispatch counts
+    # (prefill + 1), fused/chunked/per-token bit-exactness, >=0.99
+    # teacher-forced top-1 agreement vs fp32, the >=1.8x per-dispatch
+    # weight-byte drop (obs cost telemetry) and decode-attention on/off
+    # token parity are ALL hard-asserted inside the bench — rc != 0 on
+    # any violation. int8w is the acceptance recipe; the serve leg runs
+    # the continuous-batching engine over the int8 KV carry (int8wk)
+    # with its usual parity + dispatch-accounting asserts.
+    ("decode_quant", [sys.executable, "bench.py", "--decode", "--quant",
+                      "int8w"], None),
+    ("serve_quant", [sys.executable, "bench.py", "--serve", "--quant",
+                     "int8wk"], None),
 ]
 
 
