@@ -1476,6 +1476,194 @@ def bench_serve(n_requests=None, slots=None, chunk=None, mesh=None,
     return line
 
 
+def bench_serve_replicated(n_requests=None, replicas=3, slots=None,
+                           chunk=None, faults=False):
+    """``--serve --replicas N [--faults]``: fault-isolated replicated
+    serving — the zero-request-loss gate.
+
+    N independent ``ServingEngine`` replicas over the SAME weights,
+    fronted by the health-checked ``serving.Router``. With ``--faults``
+    the run injects the ISSUE's drill: one replica's chunk dispatches
+    die FATALLY mid-serve (its circuit breaker must open and its
+    accepted work requeue to survivors with generated tokens replayed)
+    while another replica's heartbeat is delayed (it must go suspect,
+    keep serving, and recover). Hard asserts, in-bench:
+
+    - ZERO lost accepted requests: every submitted request resolves to
+      tokens BIT-EXACT (greedy) with an undisturbed solo generate, or
+      to a typed error (``DeadlineExceededError``/``ReplicaDeadError``)
+      — accounting submitted == bit_exact + typed, nothing silent;
+    - with --faults, exactly one replica died, >=1 request requeued,
+      and the hung replica recovered;
+    - ``snapshot()`` -> ``restore()`` round-trips continue generation
+      bit-exactly on fp32 AND int8wk carries.
+
+    Reports tokens/s and p99 latency under injected failure — the
+    "fast AND survives" evidence row."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.runtime.resilience import (DeadlineExceededError,
+                                               ReplicaDeadError,
+                                               fault_injector)
+    from paddle_tpu.serving import ReplicaSet, Router, ServingEngine
+
+    replicas = int(replicas)
+    if replicas < 2:
+        raise ValueError(f"--replicas needs >= 2, got {replicas}")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    n_req = n_requests or 18
+    slots = slots or 2
+    chunk = chunk or 4
+    prompt_len, len_pool = 8, (4, 8, 12, 16)
+    model = LlamaForCausalLM(cfg)
+    max_len = prompt_len + max(len_pool) + 8
+    decs = [LlamaDecoder(model, max_len=max_len)
+            for _ in range(replicas)]
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+    lens = rng.choice(len_pool, n_req)
+    solo = [np.asarray(decs[0].generate(prompts[i][None], int(lens[i])))
+            for i in range(n_req)]
+
+    router = Router(ReplicaSet.from_backends(
+        decs, num_slots=slots, chunk_size=chunk), breaker_threshold=2)
+    plan = []
+    if faults:
+        # the ISSUE drill: kill replica1 mid-chunk (fatal — the ladder
+        # cannot save it), delay replica2's heartbeat for a window
+        plan = [
+            {"kind": "dispatch_error", "site": "serving.replica1.chunk",
+             "call": 2, "times": 10**9, "code": "INTERNAL"},
+            {"kind": "dispatch_error", "site": "serving.replica1.step",
+             "call": 1, "times": 10**9, "code": "INTERNAL"},
+            {"kind": "delay_heartbeat", "node": "replica2",
+             "after_beats": 2, "skip_beats": 4},
+        ]
+        set_flags({"resilience_backoff_s": 0.0})
+        fault_injector.configure(plan)
+    saw_suspect = False
+    t0 = time.perf_counter()
+    try:
+        rids = [router.submit(prompts[i], int(lens[i]))
+                for i in range(n_req)]
+        outcomes = {}
+        finish_at = {}
+        while any(r.has_work() for r in router.replicas.live()):
+            for rid, res in router.step():
+                outcomes[rid] = res
+                finish_at[rid] = time.perf_counter() - t0
+            if faults:
+                states = {r.name: r.state for r in router.replicas}
+                saw_suspect = saw_suspect or \
+                    states.get("replica2") == "suspect"
+        for _ in range(8):        # idle beats let the skip window lapse
+            router.step()
+    finally:
+        if faults:
+            fault_injector.clear()
+            set_flags({"resilience_backoff_s": 0.5})
+    wall = time.perf_counter() - t0
+
+    # -- the zero-loss ledger (hard-asserted) -------------------------------
+    bit_exact, typed, requeued_ok = 0, 0, 0
+    for i, rid in enumerate(rids):
+        out = outcomes.get(rid)
+        assert out is not None, \
+            f"request {i} vanished: submitted but never resolved"
+        if isinstance(out, (DeadlineExceededError, ReplicaDeadError)):
+            typed += 1
+            continue
+        assert not isinstance(out, BaseException), \
+            f"request {i} resolved to an UNtyped error: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged from the undisturbed run"
+        bit_exact += 1
+        if out.resilience.get("router", {}).get("requeues"):
+            requeued_ok += 1
+    assert bit_exact + typed == n_req, \
+        f"loss: {n_req} submitted, {bit_exact} exact + {typed} typed"
+    m = router.metrics()
+    states = m["states"]
+    if faults:
+        assert states["replica1"] == "dead", \
+            f"killed replica's breaker never opened: {states}"
+        assert m["replica_deaths"] == 1 and m["requeued"] >= 1, m
+        assert requeued_ok >= 1, \
+            "no request survived a requeue bit-exactly"
+        assert saw_suspect and states["replica2"] == "healthy", \
+            f"hung replica drill: suspect={saw_suspect}, {states}"
+
+    # -- snapshot -> restore round-trip, fp32 + int8wk carries --------------
+    snap_parity = {}
+    budget = max(len_pool)        # long enough to still be mid-flight
+    for quant in (None, "int8wk"):
+        qdec = (decs[0] if quant is None
+                else LlamaDecoder(model, max_len=max_len, quant=quant))
+        ref = [np.asarray(qdec.generate(prompts[i][None], budget))
+               for i in range(4)]
+        eng = ServingEngine(qdec, num_slots=slots, chunk_size=chunk)
+        ids = [eng.submit(prompts[i], budget) for i in range(4)]
+        got = {}
+        for _ in range(2):
+            for rid, res in eng.step():
+                got[rid] = res
+        with tempfile.TemporaryDirectory(prefix="bench_snap_") as tmp:
+            eng.snapshot(tmp)
+            fresh = ServingEngine(qdec, num_slots=slots,
+                                  chunk_size=chunk)
+            info = fresh.restore(tmp)
+        assert info["in_flight"] >= 1, \
+            f"snapshot drill never caught a row mid-flight: {info}"
+        got.update(fresh.drain())
+        for i, rid in enumerate(ids):
+            assert np.array_equal(np.asarray(got[rid]), ref[i]), \
+                f"snapshot->restore diverged (quant={quant}, req {i})"
+        snap_parity[quant or "fp32"] = {
+            "resumed_in_flight": info["in_flight"],
+            "resumed_queued": info["queued"], "bit_exact": True}
+
+    useful = int(lens.sum())
+    lat = np.asarray([finish_at[r] for r in rids if r in finish_at
+                      and not isinstance(outcomes[r], BaseException)])
+    p99 = float(np.percentile(lat, 99)) if lat.size else float("nan")
+    print(f"serve-replicated: {replicas} replicas, {n_req} requests, "
+          f"faults={'on' if faults else 'off'} — {bit_exact} bit-exact "
+          f"+ {typed} typed = ZERO lost; "
+          f"{m['requeued']} requeued, deaths {m['replica_deaths']}, "
+          f"suspects {m['heartbeat_suspects']}, "
+          f"{useful / wall:.0f} tok/s, p99 {p99 * 1e3:.0f}ms; "
+          f"snapshot round-trip bit-exact (fp32 + int8wk)",
+          file=sys.stderr)
+    line = _emit("serving_replicated_tokens_per_sec",
+                 round(useful / wall, 1), "tokens/sec")
+    line["serve_replicated"] = {
+        "replicas": replicas, "slots_per_replica": slots,
+        "chunk_size": chunk, "requests": n_req,
+        "faults_injected": plan,
+        "bit_exact": bit_exact, "typed_errors": typed,
+        "lost": n_req - bit_exact - typed,
+        "requeued": m["requeued"],
+        "requeued_bit_exact": requeued_ok,
+        "replica_deaths": m["replica_deaths"],
+        "heartbeat_suspects": m["heartbeat_suspects"],
+        "replica_states": states,
+        "latency_p99_s": round(p99, 4),
+        "wall_s": round(wall, 3),
+        "snapshot_round_trip": snap_parity,
+    }
+    print(json.dumps(line))
+    return line
+
+
 def bench_serve_prefix(n_groups=None, slots=None, chunk=None, mesh=None):
     """``--serve --prefix-mix``: the prefix-cache serving benchmark.
 
@@ -1707,6 +1895,7 @@ CONFIGS = {
     "decode1b_served": bench_decode_1b_served,
     "serve": bench_serve,
     "serve_prefix": bench_serve_prefix,
+    "serve_replicated": bench_serve_replicated,
 }
 
 def _run_guarded(name, fn, attempts=3, base_delay=5.0, sleep=time.sleep):
@@ -1829,6 +2018,16 @@ def main():
     ap.add_argument("--serve-requests", type=int, default=None)
     ap.add_argument("--serve-slots", type=int, default=None)
     ap.add_argument("--serve-chunk", type=int, default=None)
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="with --serve: replicated serving over N "
+                         "independent engines behind the health-checked "
+                         "Router — hard-asserts zero lost accepted "
+                         "requests (bit-exact or typed error) and the "
+                         "snapshot->restore round-trip")
+    ap.add_argument("--faults", action="store_true",
+                    help="with --serve --replicas: inject the replica-"
+                         "kill + delayed-heartbeat fault plan and "
+                         "report p99 under failure")
     ap.add_argument("--prefix-mix", action="store_true",
                     help="with --serve: the prefix-cache benchmark — a "
                          "shared-prompt arrival mix served cold vs "
@@ -1875,6 +2074,12 @@ def main():
     except Exception as e:
         _emit_failure("backend_init", e)
         sys.exit(1)
+    if args.serve and args.replicas:
+        _run_guarded("serve_replicated", lambda: bench_serve_replicated(
+            n_requests=args.serve_requests, replicas=args.replicas,
+            slots=args.serve_slots, chunk=args.serve_chunk,
+            faults=args.faults))
+        return
     if args.serve and args.prefix_mix:
         _run_guarded("serve_prefix", lambda: bench_serve_prefix(
             slots=args.serve_slots, chunk=args.serve_chunk,

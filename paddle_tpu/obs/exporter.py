@@ -104,11 +104,15 @@ class ObsExporter:
         self._status: Dict[str, Callable[[], dict]] = {}
 
     # -- composition --------------------------------------------------------
-    def add_registry(self, name: str, registry) -> "ObsExporter":
+    def add_registry(self, name: str, registry,
+                     labels: Optional[Dict[str, str]] = None
+                     ) -> "ObsExporter":
         """Attach a MetricsRegistry whose instruments join the /metrics
-        scrape (after the process-global registry)."""
+        scrape (after the process-global registry). ``labels`` attach to
+        every sample line — how N replica registries with identical
+        metric names share one exposition (``{replica="replica0"}``)."""
         with self._lock:
-            self._registries[name] = registry
+            self._registries[name] = (registry, dict(labels or {}))
         return self
 
     def add_status_provider(self, name: str,
@@ -119,13 +123,17 @@ class ObsExporter:
             self._status[name] = fn
         return self
 
-    def add_engine(self, engine, name: str = "serving") -> "ObsExporter":
+    def add_engine(self, engine, name: str = "serving",
+                   labels: Optional[Dict[str, str]] = None
+                   ) -> "ObsExporter":
         """Attach a ServingEngine: its private registry joins /metrics
-        and its live status (slot table, queue, occupancy, ladder rung)
-        joins /statusz. Held by weakref — an exporter never keeps a
-        dead engine (and its device carry) alive."""
+        (optionally labelled — a replicated router attaches each replica
+        with ``labels={"replica": name}``) and its live status (slot
+        table, queue, occupancy, ladder rung) joins /statusz. Held by
+        weakref — an exporter never keeps a dead engine (and its device
+        carry) alive."""
         ref = weakref.ref(engine)
-        self.add_registry(name, engine.registry)
+        self.add_registry(name, engine.registry, labels=labels)
 
         def status():
             eng = ref()
@@ -226,9 +234,9 @@ class ObsExporter:
         parts = [_global_metrics.to_prometheus()]
         with self._lock:
             regs = list(self._registries.items())
-        for _, reg in regs:
+        for _, (reg, labels) in regs:
             try:
-                parts.append(reg.to_prometheus())
+                parts.append(reg.to_prometheus(labels=labels or None))
             except Exception:
                 pass
         return "".join(p for p in parts if p)

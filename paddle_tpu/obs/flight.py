@@ -63,19 +63,24 @@ class FlightRecorder:
         self.last_path: Optional[str] = None
 
     def add_registry(self, name: str, registry) -> None:
+        """Re-attaching a name REPLACES the old entry (a rebuilt engine
+        or unfenced replica must not leave a stale twin in the dump)."""
         with self._lock:
             self._registries = [
-                (n, r) for n, r in self._registries if r() is not None]
+                (n, r) for n, r in self._registries
+                if r() is not None and n != name]
             self._registries.append((name, weakref.ref(registry)))
 
     def add_state(self, name: str, provider) -> None:
         """Attach any stateful component exposing ``snapshot()`` (e.g. a
-        serving prefix cache) so its live state lands in the postmortem
-        — weakref, like registries, so the recorder never extends a
-        component's lifetime."""
+        serving prefix cache, a replica router's health table) so its
+        live state lands in the postmortem — weakref, like registries,
+        so the recorder never extends a component's lifetime; same
+        name-replacement rule as :meth:`add_registry`."""
         with self._lock:
             self._states = [
-                (n, r) for n, r in self._states if r() is not None]
+                (n, r) for n, r in self._states
+                if r() is not None and n != name]
             self._states.append((name, weakref.ref(provider)))
 
     def enabled(self) -> bool:

@@ -239,39 +239,54 @@ class MetricsRegistry:
             items = list(self._by_name.items())
         return {name: m.snapshot() for name, m in sorted(items)}
 
-    def to_prometheus(self) -> str:
+    def to_prometheus(self, labels: Optional[Dict[str, str]] = None
+                      ) -> str:
         """Prometheus text exposition format 0.0.4 (the scrape surface a
-        real deployment would mount behind ``/metrics``)."""
+        real deployment would mount behind ``/metrics``). ``labels``
+        attach to every sample line (e.g. ``{"replica": "replica0"}``)
+        — how N same-shaped replica registries share one scrape without
+        colliding metric names."""
+        lab = ""
+        if labels:
+            lab = ",".join(f'{_prom_name(k)}="{v}"'
+                           for k, v in sorted(labels.items()))
         with self._lock:
             items = sorted(self._by_name.items())
         lines: List[str] = []
+
+        def sample(pn: str, value, extra: str = "") -> str:
+            parts = ",".join(p for p in (extra, lab) if p)
+            return f"{pn}{{{parts}}} {value}" if parts \
+                else f"{pn} {value}"
+
         for name, m in items:
             pn = _prom_name(name)
             if m.help:
                 lines.append(f"# HELP {pn} {m.help}")
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {pn} counter")
-                lines.append(f"{pn} {m.value:g}")
+                lines.append(sample(pn, f"{m.value:g}"))
             elif isinstance(m, Gauge):
                 lines.append(f"# TYPE {pn} gauge")
-                lines.append(f"{pn} {m.value:g}")
+                lines.append(sample(pn, f"{m.value:g}"))
             else:
                 lines.append(f"# TYPE {pn} histogram")
                 snap = m.snapshot()
                 for le, c in snap["buckets"].items():
-                    lines.append(f'{pn}_bucket{{le="{le}"}} {c}')
-                lines.append(f"{pn}_sum {snap['sum']:g}")
-                lines.append(f"{pn}_count {snap['count']}")
+                    lines.append(sample(f"{pn}_bucket", c,
+                                        extra=f'le="{le}"'))
+                lines.append(sample(f"{pn}_sum", f"{snap['sum']:g}"))
+                lines.append(sample(f"{pn}_count", snap["count"]))
                 # reservoir quantiles ride as plain gauges — and are
                 # OMITTED for an empty histogram, so a scrape can never
                 # read "no data yet" as "0 ms p99"
                 if snap["count"]:
-                    lines.append(f"{pn}_p50 {snap['p50']:g}")
-                    lines.append(f"{pn}_p99 {snap['p99']:g}")
+                    lines.append(sample(f"{pn}_p50", f"{snap['p50']:g}"))
+                    lines.append(sample(f"{pn}_p99", f"{snap['p99']:g}"))
                 # telemetry saturation is itself telemetry: a clipped
                 # reservoir means the quantiles above are best-effort
-                lines.append(
-                    f"{pn}_samples_dropped {snap['samples_dropped']}")
+                lines.append(sample(f"{pn}_samples_dropped",
+                                    snap["samples_dropped"]))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

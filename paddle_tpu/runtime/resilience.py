@@ -50,9 +50,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = [
-    "RetryEvent", "DegradationEvent", "FaultEvent",
+    "RetryEvent", "DegradationEvent", "FaultEvent", "ReplicaEvent",
     "InjectedFault", "CorruptCheckpointError", "CorruptBundleError",
-    "DecodeFailedError",
+    "DecodeFailedError", "DeadlineExceededError", "ReplicaDeadError",
     "classify_error", "resilient_call",
     "FaultInjector", "fault_injector", "atomic_write_bytes",
     "record_event", "drain_events", "recent_events",
@@ -94,6 +94,24 @@ class DegradationEvent:
 
 
 @dataclasses.dataclass(frozen=True)
+class ReplicaEvent:
+    """One replica health transition in the serving router (chunk
+    failure strike, circuit-breaker open, heartbeat suspect/recover,
+    fence/unfence, requeue) — the typed record replicated serving emits
+    into the same spine as retries/degradations, so a fault drill can
+    assert WHICH replica failed and what the router did about it."""
+    site: str               # e.g. "serving.router"
+    replica: str            # replica name ("replica1")
+    action: str             # strike|breaker_open|suspect|recovered|
+    #                         unfenced|requeue|shed
+    detail: str
+    kind: str = "replica"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultEvent:
     """One injected fault firing (the injector's own audit record)."""
     site: str
@@ -111,7 +129,8 @@ _EVENTS_LOCK = threading.Lock()
 
 _EVENT_COUNTERS = {"retry": "resilience.retries",
                    "degradation": "resilience.degradations",
-                   "fault": "resilience.faults_injected"}
+                   "fault": "resilience.faults_injected",
+                   "replica": "resilience.replica_events"}
 
 
 def record_event(ev) -> None:
@@ -138,7 +157,7 @@ def record_event(ev) -> None:
                              **{k: v for k, v in ev.as_dict().items()
                                 if k in ("from_level", "to_level",
                                          "attempt", "error_class",
-                                         "fault")})
+                                         "fault", "replica", "action")})
     except Exception:
         pass
 
@@ -192,6 +211,33 @@ class DecodeFailedError(RuntimeError):
                  last_error: Optional[BaseException] = None):
         super().__init__(message)
         self.events = list(events or [])
+        self.last_error = last_error
+
+
+class DeadlineExceededError(RuntimeError):
+    """A serving request was shed because its deadline cannot be (or was
+    not) met: expired at ``submit()``, rejected by queue-depth
+    backpressure (the estimated queue delay already blows the budget),
+    expired while queued, or expired at requeue after a replica death
+    (no zombie retries). The message deliberately does NOT contain the
+    ``DEADLINE_EXCEEDED`` backend marker — this is an admission-control
+    refusal, never a transient worth retrying."""
+
+    def __init__(self, message: str, request_id: Optional[int] = None):
+        super().__init__(message)
+        self.request_id = request_id
+
+
+class ReplicaDeadError(RuntimeError):
+    """A serving replica's circuit breaker is open (K consecutive
+    classified-fatal chunks / an exhausted ladder), or a request ran out
+    of replicas to run on (every candidate dead or excluded). Carries
+    the replica name(s) and the last underlying error."""
+
+    def __init__(self, message: str, replica: Optional[str] = None,
+                 last_error: Optional[BaseException] = None):
+        super().__init__(message)
+        self.replica = replica
         self.last_error = last_error
 
 

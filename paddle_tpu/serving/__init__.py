@@ -17,6 +17,11 @@ from paddle_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixSlab,
     prefix_digests,
 )
+from paddle_tpu.serving.router import (  # noqa: F401
+    Replica,
+    ReplicaSet,
+    Router,
+)
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     Request,
     Scheduler,
@@ -26,5 +31,6 @@ from paddle_tpu.serving.scheduler import (  # noqa: F401
 )
 
 __all__ = ["ServingEngine", "PrefixCache", "PrefixLookup", "PrefixSlab",
-           "prefix_digests", "Request", "Scheduler", "Slot", "SlotTable",
+           "prefix_digests", "Replica", "ReplicaSet", "Router",
+           "Request", "Scheduler", "Slot", "SlotTable",
            "bucket_length"]

@@ -50,11 +50,36 @@ degradation rung re-enters the same sharded carry, and ``status()``
 reports the live topology + carry placements. ``mesh=`` on the engine
 is a cross-check only: it must match the backend's, typed
 ``MeshMismatchError`` otherwise.
+
+Deadlines + load shedding (``submit(deadline_s=)``): an expired budget
+is refused typed (``DeadlineExceededError``) BEFORE any prefill, a
+queue whose estimated delay already blows the budget sheds the submit
+(backpressure), a queued request that expires while waiting is shed at
+admission, and an in-flight row past its deadline is frozen like EOS at
+the next chunk boundary and returned partial, flagged
+``deadline_expired`` — the accepted-work contract is "tokens or a typed
+error", never a silent drop and never a zombie burning slot-steps.
+
+Crash recovery: ``snapshot(dir)`` serializes the carry (quantized
+``{"q","s"}`` leaves and mesh shardings included) plus the slot/queue
+bookkeeping under an atomic sha256-manifest write; ``restore(dir)`` on
+a fresh same-shape engine verifies the manifest (typed
+``CorruptCheckpointError`` on a torn/flipped file) and resumes with
+bit-exact greedy continuation. ``snapshot_every_chunks=`` snapshots on
+a chunk-boundary cadence and ``drain(deadline_s=)`` snapshots instead
+of discarding — the graceful-drain story. ``replica_tag=`` names this
+engine as one replica of a ``serving.router.ReplicaSet``: per-replica
+fault-injection sites (``serving.<tag>.chunk``/``.step``) let a drill
+kill ONE replica while its peers keep serving.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import io
+import json
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -451,7 +476,10 @@ class ServingEngine:
                  prefix_cache_bytes: Optional[int] = None,
                  prefix_block_tokens: Optional[int] = None,
                  batch_admission: bool = False, quant: Optional[str]
-                 = None, cache_aware_admission: Optional[bool] = None):
+                 = None, cache_aware_admission: Optional[bool] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every_chunks: int = 0,
+                 replica_tag: Optional[str] = None):
         """``prefix_cache``: ``None`` reads the
         ``FLAGS_serving_prefix_cache_bytes`` /
         ``PADDLE_TPU_PREFIX_CACHE_BYTES`` budget (0 = disabled, the
@@ -475,7 +503,12 @@ class ServingEngine:
         whose digest is already cached lead; same-digest requests admit
         together; FIFO within a digest group) — defaults to ON whenever
         the prefix cache is enabled; ``serving.admission.cache_reordered``
-        in ``metrics()`` counts the queue jumps."""
+        in ``metrics()`` counts the queue jumps.
+        ``snapshot_dir``/``snapshot_every_chunks``: write a resumable
+        carry snapshot (:meth:`snapshot`) into ``snapshot_dir`` every N
+        chunk dispatches (0 = never; the default) — the crash-recovery
+        cadence. ``replica_tag``: names this engine as one replica of a
+        router's ``ReplicaSet`` and arms the per-replica fault sites."""
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
@@ -611,6 +644,37 @@ class ServingEngine:
                              f"per-request admission wall time, "
                              f"{cls}-hit class")
             for cls in ("full", "partial", "miss")}
+        # deadline machinery: sheds are typed refusals, expired rows are
+        # partial returns — every path has its own counter so the bench
+        # can account for EVERY accepted request
+        self._c_shed_deadline = r.counter(
+            "serving.shed.deadline",
+            "submits refused typed: the deadline was already expired "
+            "(shed before any prefill)")
+        self._c_shed_backpressure = r.counter(
+            "serving.shed.backpressure",
+            "submits refused typed: estimated queue delay already "
+            "blows the request's deadline")
+        self._c_shed_queue = r.counter(
+            "serving.shed.queue_deadline",
+            "queued requests shed at admission: deadline expired while "
+            "waiting (no prefill was ever dispatched)")
+        self._c_deadline_rows = r.counter(
+            "serving.deadline.expired_rows",
+            "in-flight rows frozen at a chunk boundary past their "
+            "deadline and returned partial (flagged deadline_expired)")
+        self._c_snapshots = r.counter(
+            "serving.snapshots", "resumable DecodeState snapshots "
+            "written (crash-recovery cadence + graceful drain)")
+        # crash recovery / replica identity
+        self.replica_tag = None if replica_tag is None else str(replica_tag)
+        self._snap_dir = snapshot_dir
+        self._snap_every = int(snapshot_every_chunks or 0)
+        if self._snap_every and not self._snap_dir:
+            raise ValueError(
+                "snapshot_every_chunks needs snapshot_dir to write into")
+        self._snap_last_chunks = 0
+        self._last_snapshot: Optional[Tuple[float, str]] = None
         self._last_prefix_stats = {"insertions": 0, "evictions": 0}
         self.slo_targets = {k: dict(v)
                             for k, v in (slo_targets or {}).items()}
@@ -619,9 +683,11 @@ class ServingEngine:
         # engine's registry snapshot (weakref — no lifetime extension),
         # and the prefix-cache occupancy/eviction state so a postmortem
         # shows what the cache held at crash time
-        obs.flight_recorder.add_registry("serving", self.registry)
+        tag = (f"serving.{self.replica_tag}" if self.replica_tag
+               else "serving")
+        obs.flight_recorder.add_registry(tag, self.registry)
         if self.prefix_cache is not None:
-            obs.flight_recorder.add_state("serving.prefix_cache",
+            obs.flight_recorder.add_state(f"{tag}.prefix_cache",
                                           self.prefix_cache)
 
     @staticmethod
@@ -663,11 +729,19 @@ class ServingEngine:
                temperature: float = 1.0, seed: int = 0,
                priority: int = 0, latency_class: str = "default",
                slo_ttft_s: Optional[float] = None,
-               slo_latency_s: Optional[float] = None) -> int:
+               slo_latency_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its id (results key).
         ``latency_class`` + optional per-request SLO targets feed the
-        per-class TTFT/latency violation counters."""
+        per-class TTFT/latency violation counters. ``deadline_s`` is a
+        HARD budget in seconds from now: an already-expired budget and a
+        queue whose estimated delay blows it are shed here with a typed
+        :class:`DeadlineExceededError` (``serving.shed.deadline`` /
+        ``serving.shed.backpressure``) — the request never costs a
+        prefill; a request that expires later is shed at admission or
+        frozen partial between chunks."""
         from paddle_tpu.inference.generate import _normalize_eos
+        from paddle_tpu.runtime.resilience import DeadlineExceededError
         prompt = np.asarray(prompt)
         if prompt.ndim == 2:
             if prompt.shape[0] != 1:
@@ -686,6 +760,29 @@ class ServingEngine:
                 f"prompt {len(prompt)} (bucket {bucket}) + "
                 f"{max_new_tokens} new tokens exceeds the backend's "
                 f"max_len {self._b.max_len}")
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                # the cheapest shed: the budget is gone before any work
+                self._c_shed_deadline.inc()
+                obs.tracer.event("serving.request.shed",
+                                 reason="deadline_expired",
+                                 deadline_s=deadline_s)
+                raise DeadlineExceededError(
+                    f"request deadline ({deadline_s:.4f}s) already "
+                    f"expired at submit; shed before any prefill")
+            est = self.estimated_queue_delay_s()
+            if est > deadline_s:
+                self._c_shed_backpressure.inc()
+                obs.tracer.event("serving.request.shed",
+                                 reason="backpressure",
+                                 estimated_queue_delay_s=round(est, 6),
+                                 deadline_s=deadline_s)
+                raise DeadlineExceededError(
+                    f"estimated queue delay {est:.4f}s (depth "
+                    f"{len(self.scheduler)} over {self.num_slots} "
+                    f"slots) already exceeds the {deadline_s:.4f}s "
+                    f"deadline; shed at submit")
         rid = self._next_id
         self._next_id += 1
         req = Request(
@@ -694,7 +791,8 @@ class ServingEngine:
             temperature=float(temperature), seed=int(seed),
             priority=int(priority), submit_time=time.monotonic(),
             latency_class=str(latency_class),
-            slo_ttft_s=slo_ttft_s, slo_latency_s=slo_latency_s)
+            slo_ttft_s=slo_ttft_s, slo_latency_s=slo_latency_s,
+            deadline_s=deadline_s)
         if self.scheduler.cache_aware:
             # the cache-aware ordering's grouping key: the prompt's
             # FIRST block-boundary digest (the shortest ladder entry) —
@@ -709,12 +807,27 @@ class ServingEngine:
                          max_new_tokens=int(max_new_tokens))
         return rid
 
+    def estimated_queue_delay_s(self) -> float:
+        """The backpressure signal: how long a NEW submit would likely
+        wait for a slot — (queued ahead / slots) admission waves at the
+        observed mean request wall time. 0.0 until a request has
+        finished (no evidence, no shedding)."""
+        lat = self._h_latency
+        if not lat.count or not len(self.scheduler):
+            return 0.0
+        return len(self.scheduler) / self.num_slots * lat.mean
+
     # -- the serving loop --------------------------------------------------
     def step(self) -> List[Tuple[int, Any]]:
-        """One iteration: admit into free slots, run ONE chunk dispatch,
-        harvest finished rows. Returns ``[(request_id, result), ...]``
-        finished this step (also retrievable via ``result(id)``)."""
+        """One iteration: shed/freeze expired deadlines, admit into free
+        slots, run ONE chunk dispatch, harvest finished rows. Returns
+        ``[(request_id, result), ...]`` finished this step (also
+        retrievable via ``result(id)``). A request shed for an expired
+        deadline finishes as a typed ``DeadlineExceededError`` VALUE in
+        the list (and in ``result(id)``) — accepted work always resolves
+        to tokens or a typed error."""
         now = time.monotonic()
+        pre = self._enforce_deadlines(now)
         self._h_qdepth.observe(len(self.scheduler))
         admitted = self.scheduler.admissions()
         if self.scheduler.cache_reordered > int(self._c_reordered.value):
@@ -725,7 +838,7 @@ class ServingEngine:
         self._g_qdepth.set(len(self.scheduler))
         occupied = self.scheduler.slots.occupied()
         if not occupied:
-            return []
+            return pre
         self._h_occ.observe(len(occupied) / self.num_slots)
         toks = self._dispatch_chunk(occupied)
         t_chunk_done = time.monotonic()
@@ -762,24 +875,98 @@ class ServingEngine:
             self.scheduler.slots.release(i)
             freed.append(i)
         if freed:
-            # freeze freed rows until re-admission: they keep riding the
-            # batched program, but pinned — their output is discarded.
-            # A fixed-shape (B,) mask OR, not a scatter: eager scatters
-            # recompile per freed-set shape (~ms each on the host path)
-            import jax.numpy as jnp
-            mask = np.zeros(self.num_slots, bool)
-            mask[freed] = True
-            self.state = dataclasses.replace(
-                self.state,
-                done=jnp.logical_or(self.state.done, jnp.asarray(mask)))
-        return finished
+            self._freeze_rows(freed)
+        if self._snap_every and (self.chunk_dispatches
+                                 - self._snap_last_chunks
+                                 >= self._snap_every):
+            # cadence snapshot at the END of the step: the carry and the
+            # host token buffers agree here (every dispatched chunk's
+            # tokens are already in slot.tokens)
+            self.snapshot(self._snap_dir)
+        return pre + finished
 
-    def drain(self, max_steps: Optional[int] = None) -> Dict[int, Any]:
+    def _freeze_rows(self, rows: Sequence[int]) -> None:
+        """Freeze carry rows until re-admission (freed slots and expired
+        deadlines): they keep riding the batched program, but pinned —
+        their output is discarded. A fixed-shape (B,) mask OR, not a
+        scatter: eager scatters recompile per freed-set shape (~ms each
+        on the host path)."""
+        import jax.numpy as jnp
+        mask = np.zeros(self.num_slots, bool)
+        mask[list(rows)] = True
+        self.state = dataclasses.replace(
+            self.state,
+            done=jnp.logical_or(self.state.done, jnp.asarray(mask)))
+
+    def _enforce_deadlines(self, now: float) -> List[Tuple[int, Any]]:
+        """The two non-submit deadline enforcement points, swept at the
+        top of every step: (a) queued requests whose deadline passed are
+        shed TYPED before they cost a prefill; (b) in-flight rows past
+        their deadline are frozen like EOS and finished PARTIAL, flagged
+        ``deadline_expired`` — the slot frees for the next admission.
+        Returns the ``(request_id, outcome)`` pairs resolved here."""
+        from paddle_tpu.runtime.resilience import DeadlineExceededError
+        out: List[Tuple[int, Any]] = []
+        for req in self.scheduler.shed_expired(now):
+            self._c_shed_queue.inc()
+            err = DeadlineExceededError(
+                f"request {req.id} deadline expired after "
+                f"{now - req.submit_time:.4f}s in queue "
+                f"(budget {req.deadline_s:.4f}s); shed at admission",
+                request_id=req.id)
+            self._results[req.id] = err
+            out.append((req.id, err))
+            obs.tracer.event("serving.request.shed", request=req.id,
+                             reason="queue_deadline")
+        frozen = []
+        for i, slot in self.scheduler.slots.occupied():
+            req = slot.request
+            if req.deadline_at is None or now <= req.deadline_at:
+                continue
+            seq = (np.concatenate(slot.tokens) if slot.tokens
+                   else np.zeros((0,), np.int64))
+            seq = seq[:req.max_new_tokens]
+            self._c_deadline_rows.inc()
+            res = self._finish(slot, seq, i, deadline_expired=True)
+            self._results[req.id] = res
+            out.append((req.id, res))
+            if slot.pinned_slab is not None:
+                self.prefix_cache.unpin(slot.pinned_slab)
+                slot.pinned_slab = None
+            self.scheduler.slots.release(i)
+            frozen.append(i)
+        if frozen:
+            self._freeze_rows(frozen)
+        return out
+
+    def drain(self, max_steps: Optional[int] = None,
+              deadline_s: Optional[float] = None,
+              snapshot_path: Optional[str] = None) -> Dict[int, Any]:
         """Step until the queue and every slot are empty; returns
-        ``{request_id: result}`` for everything finished while draining."""
+        ``{request_id: outcome}`` for everything finished while draining
+        (outcomes are results or typed deadline errors).
+
+        ``deadline_s`` is the GRACEFUL-DRAIN budget: when it runs out
+        with work still in flight, the engine snapshots the carry +
+        bookkeeping to ``snapshot_path`` (or the engine's
+        ``snapshot_dir``) instead of discarding accepted work, and
+        returns what finished — ``restore()`` on a fresh engine resumes
+        the rest bit-exactly. No snapshot destination configured raises
+        ``ValueError`` up front, not after the budget is spent."""
+        if deadline_s is not None and not (snapshot_path
+                                           or self._snap_dir):
+            raise ValueError(
+                "drain(deadline_s=) needs snapshot_path or an engine "
+                "snapshot_dir: a graceful drain SNAPSHOTS unfinished "
+                "work, it never discards it")
+        t0 = time.monotonic()
         out: Dict[int, Any] = {}
         steps = 0
         while len(self.scheduler) or self.scheduler.slots.occupied():
+            if deadline_s is not None \
+                    and time.monotonic() - t0 > deadline_s:
+                self.snapshot(snapshot_path or self._snap_dir)
+                break
             for rid, res in self.step():
                 out[rid] = res
             steps += 1
@@ -790,6 +977,271 @@ class ServingEngine:
 
     def result(self, request_id: int):
         return self._results.get(request_id)
+
+    # -- crash recovery: DecodeState snapshot / restore --------------------
+    _SNAP_DATA = "state.npz"
+    _SNAP_MANIFEST = "manifest.json"
+
+    def snapshot(self, path: str) -> str:
+        """Serialize everything needed to resume THIS engine's accepted
+        work into directory ``path``: the full ``DecodeState`` carry
+        (quantized ``{"q","s"}`` leaves flatten like any other pytree;
+        a mesh-sharded carry is gathered process-locally) plus the slot
+        table's requests-with-tokens-so-far and the queued requests.
+        Written as one npz payload under an atomic sha256 manifest (the
+        PR-3 checkpoint discipline: the digest is hashed from intended
+        bytes BEFORE disk, writes go through ``atomic_write_bytes``, so
+        a torn/flipped file is refused typed at restore, never resumed
+        wrong). Snapshots are taken at chunk boundaries only — the carry
+        and the host token buffers agree there — which makes the greedy
+        continuation after ``restore()`` bit-exact."""
+        import jax
+
+        from paddle_tpu.distributed.checkpoint import _np_storable
+        from paddle_tpu.runtime.resilience import atomic_write_bytes
+        os.makedirs(path, exist_ok=True)
+        st = self.state
+        leaves, _ = jax.tree_util.tree_flatten(
+            (st.logits, st.kc, st.vc, st.pos, st.keys, st.done, st.eos,
+             st.temp))
+        arrays: Dict[str, np.ndarray] = {}
+        leaf_meta = []
+        for i, leaf in enumerate(leaves):
+            store, tag = _np_storable(np.asarray(jax.device_get(leaf)))
+            arrays[f"leaf_{i}"] = store
+            leaf_meta.append({"dtype": tag})
+        now = time.monotonic()
+
+        def req_meta(req):
+            return {
+                "id": req.id, "max_new_tokens": req.max_new_tokens,
+                "eos_token_id": req.eos_token_id,
+                "temperature": req.temperature, "seed": req.seed,
+                "priority": req.priority,
+                "latency_class": req.latency_class,
+                "slo_ttft_s": req.slo_ttft_s,
+                "slo_latency_s": req.slo_latency_s,
+                # deadlines cross the snapshot as REMAINING budget: the
+                # monotonic clock does not survive a process restart
+                "deadline_remaining_s": (
+                    None if req.deadline_at is None
+                    else req.deadline_at - now),
+            }
+
+        slots_meta = []
+        for i, slot in self.scheduler.slots.occupied():
+            arrays[f"slot{i}_prompt"] = np.asarray(slot.request.prompt)
+            for j, piece in enumerate(slot.tokens):
+                arrays[f"slot{i}_piece{j}"] = np.asarray(piece)
+            slots_meta.append({"slot": i,
+                               "request": req_meta(slot.request),
+                               "pieces": len(slot.tokens),
+                               "chunks": slot.chunks})
+        queue_meta = []
+        for j, req in enumerate(self.scheduler.queued()):
+            arrays[f"queue{j}_prompt"] = np.asarray(req.prompt)
+            queue_meta.append(req_meta(req))
+        meta = {
+            "kind": "paddle_tpu.decode_snapshot", "version": 1,
+            "time_unix": time.time(),
+            "num_slots": self.num_slots, "chunk_size": self.chunk_size,
+            "quant": self._b.quant,
+            "mesh_axes": (dict(self._b.sharding.axes)
+                          if self._b.sharding is not None else None),
+            "steps_done": int(st.steps_done),
+            "next_id": self._next_id,
+            "leaves": leaf_meta, "slots": slots_meta,
+            "queue": queue_meta,
+        }
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        manifest = {"kind": meta["kind"], "data": self._SNAP_DATA,
+                    "sha256": hashlib.sha256(payload).hexdigest(),
+                    "bytes": len(payload), "meta": meta}
+        # data first, manifest second: a crash between the two leaves a
+        # digest mismatch -> typed refusal at restore, never a silent
+        # half-new snapshot
+        atomic_write_bytes(os.path.join(path, self._SNAP_DATA), payload)
+        atomic_write_bytes(os.path.join(path, self._SNAP_MANIFEST),
+                           json.dumps(manifest, indent=1).encode())
+        self._c_snapshots.inc()
+        self._snap_last_chunks = self.chunk_dispatches
+        self._last_snapshot = (time.monotonic(), path)
+        obs.tracer.event("serving.snapshot", path=path,
+                         in_flight=len(slots_meta),
+                         queued=len(queue_meta))
+        return path
+
+    def restore(self, path: str) -> Dict[str, int]:
+        """Resume a :meth:`snapshot` on a FRESH engine built over the
+        same-shape backend: verifies the sha256 manifest (typed
+        ``CorruptCheckpointError`` on a torn/flipped/missing file),
+        cross-checks slot count, quant recipe
+        (``QuantMismatchError``) and mesh topology
+        (``MeshMismatchError``), then rebuilds the carry on device —
+        under the backend's NamedShardings when meshed — and the
+        slot/queue bookkeeping. Greedy continuation is bit-exact with
+        the run the snapshot interrupted. Returns
+        ``{"in_flight": n, "queued": m}``."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.checkpoint import _np_restore
+        from paddle_tpu.inference.sharding import MeshMismatchError
+        from paddle_tpu.runtime.resilience import CorruptCheckpointError
+        from paddle_tpu.serving.scheduler import Slot
+        if self._next_id or len(self.scheduler) \
+                or self.scheduler.slots.occupied():
+            raise RuntimeError(
+                "restore() needs a fresh engine (no submissions yet): "
+                "build a new ServingEngine over the same backend shape "
+                "and restore into that")
+        mpath = os.path.join(path, self._SNAP_MANIFEST)
+        dpath = os.path.join(path, self._SNAP_DATA)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CorruptCheckpointError(
+                f"snapshot manifest unreadable at {mpath}: {e}") from e
+        try:
+            with open(dpath, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CorruptCheckpointError(
+                f"snapshot data missing at {dpath}: {e}") from e
+        got = hashlib.sha256(raw).hexdigest()
+        want = manifest.get("sha256", "")
+        if got != want:
+            raise CorruptCheckpointError(
+                f"snapshot data is corrupt: sha256 {got[:16]}… != "
+                f"manifest {want[:16]}… — refusing to resume from a "
+                f"torn/corrupt snapshot")
+        meta = manifest["meta"]
+        if int(meta["num_slots"]) != self.num_slots:
+            raise ValueError(
+                f"snapshot was taken with num_slots="
+                f"{meta['num_slots']}, this engine has "
+                f"{self.num_slots}; the carry rows must map 1:1")
+        if meta.get("quant") != self._b.quant:
+            from paddle_tpu.quantization.kv_cache import \
+                QuantMismatchError
+            raise QuantMismatchError(
+                f"snapshot carries quant recipe "
+                f"{meta.get('quant') or 'none'!r} but this engine's "
+                f"backend serves {self._b.quant or 'none'!r}")
+        have_axes = (dict(self._b.sharding.axes)
+                     if self._b.sharding is not None else None)
+        if meta.get("mesh_axes") != have_axes:
+            raise MeshMismatchError(
+                f"snapshot recorded mesh {meta.get('mesh_axes')} but "
+                f"this engine serves {have_axes}")
+        npz = np.load(io.BytesIO(raw), allow_pickle=False)
+        template = self._b.new_state()
+        tleaves, treedef = jax.tree_util.tree_flatten(
+            (template.logits, template.kc, template.vc, template.pos,
+             template.keys, template.done, template.eos, template.temp))
+        lm = meta["leaves"]
+        if len(lm) != len(tleaves):
+            raise CorruptCheckpointError(
+                f"snapshot carry layout mismatch: {len(lm)} leaves "
+                f"recorded, backend expects {len(tleaves)}")
+        leaves = []
+        for i, (tl, m) in enumerate(zip(tleaves, lm)):
+            arr = _np_restore(npz[f"leaf_{i}"], m["dtype"])
+            if tuple(arr.shape) != tuple(tl.shape):
+                raise CorruptCheckpointError(
+                    f"snapshot leaf {i} has shape {arr.shape}, backend "
+                    f"expects {tuple(tl.shape)}")
+            leaves.append(jnp.asarray(arr))
+        logits, kc, vc, pos, keys, done, eos, temp = \
+            jax.tree_util.tree_unflatten(treedef, leaves)
+        st = dataclasses.replace(
+            template, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
+            done=done, eos=eos, temp=temp,
+            steps_done=int(meta["steps_done"]))
+        if self._b.sharding is not None:
+            st = self._b.sharding.put_state(st, self._b.head_major)
+        self.state = st
+        now = time.monotonic()
+        for sm in meta["slots"]:
+            i = int(sm["slot"])
+            req = self._req_from_meta(sm["request"],
+                                      npz[f"slot{i}_prompt"], now)
+            self.scheduler.slots.entries[i] = Slot(
+                request=req, admitted_at=now, chunks=int(sm["chunks"]),
+                tokens=[np.asarray(npz[f"slot{i}_piece{j}"])
+                        for j in range(int(sm["pieces"]))])
+        for j, qm in enumerate(meta["queue"]):
+            self.scheduler.push(
+                self._req_from_meta(qm, npz[f"queue{j}_prompt"], now))
+        self._next_id = int(meta["next_id"])
+        self._g_qdepth.set(len(self.scheduler))
+        obs.tracer.event("serving.restore", path=path,
+                         in_flight=len(meta["slots"]),
+                         queued=len(meta["queue"]))
+        return {"in_flight": len(meta["slots"]),
+                "queued": len(meta["queue"])}
+
+    @staticmethod
+    def _req_from_meta(m: dict, prompt: np.ndarray, now: float) -> Request:
+        rem = m.get("deadline_remaining_s")
+        return Request(
+            id=int(m["id"]), prompt=np.asarray(prompt),
+            max_new_tokens=int(m["max_new_tokens"]),
+            eos_token_id=m.get("eos_token_id"),
+            temperature=float(m["temperature"]), seed=int(m["seed"]),
+            priority=int(m["priority"]), submit_time=now,
+            latency_class=m.get("latency_class", "default"),
+            slo_ttft_s=m.get("slo_ttft_s"),
+            slo_latency_s=m.get("slo_latency_s"),
+            # a deadline crosses the snapshot as remaining budget; an
+            # already-negative remainder is swept typed on the first
+            # post-restore step (no zombie work)
+            deadline_s=rem,
+            deadline_at=None if rem is None else now + rem)
+
+    # -- replica plumbing (serving/router.py reads these) ------------------
+    def export_inflight(self) -> List[Tuple[Request, np.ndarray, int]]:
+        """``(request, tokens generated so far, chunk pieces)`` per
+        occupied slot — the requeue payload the router reads off a dead
+        replica. Host bookkeeping only: the pieces were harvested chunk
+        by chunk (each exactly once, in order), so replaying them is
+        dedup-safe by construction."""
+        out = []
+        for _, slot in self.scheduler.slots.occupied():
+            toks = (np.concatenate(slot.tokens) if slot.tokens
+                    else np.zeros((0,), np.int64))
+            out.append((slot.request, toks, len(slot.tokens)))
+        return out
+
+    def take_queued(self) -> List[Request]:
+        """Pop every queued request (requeue export of a dead replica)."""
+        taken = self.scheduler.take_all()
+        self._g_qdepth.set(0)
+        return taken
+
+    def clear_inflight(self) -> None:
+        """Release every occupied slot — the dead-replica fence: the
+        work was exported for requeue, so the slot table must not keep
+        claiming it (a later ``unfence`` + ``reset_state`` reuses the
+        engine cleanly)."""
+        for i, slot in self.scheduler.slots.occupied():
+            if slot.pinned_slab is not None:
+                self.prefix_cache.unpin(slot.pinned_slab)
+                slot.pinned_slab = None
+            self.scheduler.slots.release(i)
+
+    def reset_state(self) -> None:
+        """Rebuild a fresh carry (every slot free) — the unfence path:
+        a revived replica must not resume on whatever the dead dispatch
+        left behind."""
+        if self.scheduler.slots.occupied():
+            raise RuntimeError(
+                "reset_state with occupied slots would orphan in-flight "
+                "requests; export/clear them first")
+        self.state = self._b.new_state()
 
     # -- internals ---------------------------------------------------------
     def _admit_all(self, admitted, now: float) -> None:
@@ -957,10 +1409,16 @@ class ServingEngine:
         from paddle_tpu.flags import flags as _flags
         from paddle_tpu.runtime.resilience import (
             DecodeFailedError, DegradationEvent, classify_error,
-            record_event)
+            fault_injector, record_event)
 
         ev0 = self._b.event_count()
         try:
+            if self.replica_tag:
+                # the per-replica fault site: a plan targeting
+                # "serving.<tag>.chunk" kills/hangs THIS replica while
+                # its ReplicaSet peers (different tags) keep serving
+                fault_injector.on_call(
+                    f"serving.{self.replica_tag}.chunk")
             toks, self.state = self._b.decode_chunk(self.state,
                                                     self.chunk_size)
             self._c_chunk.inc()
@@ -969,6 +1427,11 @@ class ServingEngine:
             return np.asarray(toks)
         except Exception as e:
             if classify_error(e) != "transient":
+                # fatal: the router's breaker counts this. Harvest rows
+                # whose HOST tokens already finish them and dump the
+                # postmortem before the error propagates — a finished
+                # request must never ride down with the batch
+                self._harvest_before_raise(e, "serving.chunk_fatal")
                 raise
             if (not _flags.resilience_auto_degrade
                     or not self._b.has_step_rung()):
@@ -976,13 +1439,8 @@ class ServingEngine:
                     f"serving chunk dispatch failed with no per-token "
                     f"rung available: {str(e)[:300]}",
                     events=self._b.events_since(ev0), last_error=e)
-                # the process may die on this: dump the flight recorder
-                # (last spans + resilience timeline + registries) first
-                obs.record_crash(
-                    "serving.chunk_failed_no_rung", error=e,
-                    extra={"site": "serve.chunk",
-                           "in_flight": [s.request.id
-                                         for _, s in occupied]})
+                self._harvest_before_raise(
+                    e, "serving.chunk_failed_no_rung")
                 raise err from e
             ev = DegradationEvent(
                 site="serve.chunk", from_level="chunked",
@@ -995,13 +1453,86 @@ class ServingEngine:
         # execution; the in-process chunk doesn't donate its inputs), so
         # every admitted request rides through the degradation
         parts = []
-        for _ in range(self.chunk_size):
-            toks1, self.state = self._b.decode_step(self.state)
-            self._c_step.inc()
-            parts.append(np.asarray(toks1))
+        try:
+            for _ in range(self.chunk_size):
+                if self.replica_tag:
+                    fault_injector.on_call(
+                        f"serving.{self.replica_tag}.step")
+                toks1, self.state = self._b.decode_step(self.state)
+                self._c_step.inc()
+                parts.append(np.asarray(toks1))
+        except Exception as e2:
+            # the ladder is exhausted mid-rung. Tokens from the steps
+            # that DID run are real — the carry advanced — so absorb
+            # them into the slot buffers first: requests they complete
+            # are harvested below, and a router requeue replays them
+            # instead of re-generating (no token is lost OR re-emitted)
+            if parts:
+                cols = np.concatenate(parts, axis=1)
+                for i, slot in occupied:
+                    slot.tokens.append(cols[i])
+                    slot.chunks += 1
+            err = DecodeFailedError(
+                f"serving per-token rung failed after the chunk rung "
+                f"degraded: {str(e2)[:300]}",
+                events=self._b.events_since(ev0) + [ev], last_error=e2)
+            self._harvest_before_raise(e2, "serving.ladder_exhausted")
+            raise err from e2
         self._c_slot_steps.inc(self.num_slots * self.chunk_size)
         self._note_events(occupied, ev0, [ev])
         return np.concatenate(parts, axis=1)
+
+    def _harvest_before_raise(self, error: BaseException,
+                              reason: str) -> None:
+        """The last act before a serving chunk error propagates: rows
+        whose HOST-side token buffer already satisfies their finish
+        condition (EOS collected in an earlier chunk / budget met by the
+        absorbed rung steps) are harvested into ``_results`` — they are
+        COMPLETE, bit-exact results and must not be lost with the batch
+        — and the genuinely unfinished requests are recorded (id +
+        tokens generated so far) in the flight-recorder postmortem, so a
+        crash dump accounts for every accepted request."""
+        harvested, lost = [], []
+        for i, slot in self.scheduler.slots.occupied():
+            req = slot.request
+            seq = (np.concatenate(slot.tokens) if slot.tokens
+                   else np.zeros((0,), np.int64))
+            fin = False
+            if req.eos_token_id is not None and seq.size:
+                hit = seq == req.eos_token_id
+                if hit.any():
+                    seq = seq[:int(np.argmax(hit)) + 1]
+                    fin = True
+            if len(seq) >= req.max_new_tokens:
+                seq = seq[:req.max_new_tokens]
+                fin = True
+            if fin:
+                res = self._finish(slot, seq, i)
+                self._results[req.id] = res
+                harvested.append(req.id)
+                if slot.pinned_slab is not None:
+                    self.prefix_cache.unpin(slot.pinned_slab)
+                    slot.pinned_slab = None
+                self.scheduler.slots.release(i)
+                try:
+                    # best-effort freeze: the backend may be the thing
+                    # that just died, and the harvest must never mask
+                    # the original error (a fenced replica's carry is
+                    # rebuilt at unfence anyway)
+                    self._freeze_rows([i])
+                except Exception:
+                    pass
+            else:
+                lost.append({"request": req.id,
+                             "prompt_len": int(len(req.prompt)),
+                             "tokens_generated": int(seq.size),
+                             "max_new_tokens": req.max_new_tokens,
+                             "chunks": slot.chunks})
+        obs.record_crash(
+            reason, error=error,
+            extra={"site": "serve.chunk", "replica": self.replica_tag,
+                   "harvested_requests": harvested,
+                   "lost_requests": lost})
 
     def _note_events(self, occupied, ev0: int, degradations) -> None:
         """Attribute THIS dispatch's retry/degradation events to every
@@ -1011,7 +1542,8 @@ class ServingEngine:
         for _, slot in occupied:
             slot.events.extend(new)
 
-    def _finish(self, slot, seq: np.ndarray, slot_idx: int):
+    def _finish(self, slot, seq: np.ndarray, slot_idx: int,
+                deadline_expired: bool = False):
         from paddle_tpu.runtime.resilience import GenerateResult
         req = slot.request
         fin = time.monotonic()       # same clock as submit/admit stamps
@@ -1052,6 +1584,11 @@ class ServingEngine:
                 "prefix_hit": slot.prefix_hit,
                 "prefill_tokens_saved": slot.prefill_tokens_saved,
                 "admission_dispatches": slot.admission_dispatches,
+                # True when the row was frozen at a chunk boundary past
+                # its deadline and returned PARTIAL (tokens so far, not
+                # the full budget) — the caller must be able to tell a
+                # deadline cut from a genuine EOS/budget finish
+                "deadline_expired": bool(deadline_expired),
             },
         }
         # the request's lifetime span (submit -> finished) on the same
@@ -1133,6 +1670,7 @@ class ServingEngine:
             "num_slots": self.num_slots,
             "chunk_size": self.chunk_size,
             "quant": self._b.quant,
+            "replica_tag": self.replica_tag,
             "mesh": self._mesh_status(),
             "slots": slots,
             "occupancy_now": len(occupied) / self.num_slots,
@@ -1149,6 +1687,24 @@ class ServingEngine:
                 "step_dispatches": self.step_dispatches,
             },
             "slo_targets": self.slo_targets,
+            # deadline machinery: every shed class + the expired-row
+            # partial returns — the "is admission control biting" view
+            "shed": {
+                "deadline": int(self._c_shed_deadline.value),
+                "backpressure": int(self._c_shed_backpressure.value),
+                "queue_deadline": int(self._c_shed_queue.value),
+                "expired_rows": int(self._c_deadline_rows.value),
+            },
+            # crash-recovery evidence: when the last resumable snapshot
+            # was written and where (None = never) — a monitoring rule
+            # alerts on age, not existence
+            "snapshot": (None if self._last_snapshot is None else {
+                "path": self._last_snapshot[1],
+                "age_s": round(time.monotonic()
+                               - self._last_snapshot[0], 4),
+                "count": int(self._c_snapshots.value),
+                "every_chunks": self._snap_every or None,
+            }),
             # what the prefix-cache pool holds RIGHT NOW (None =
             # disabled): occupancy, eviction counts and the bounded
             # slab table — also what a flight-recorder postmortem shows
@@ -1250,6 +1806,15 @@ class ServingEngine:
                 self.registry.get(n).value
                 for n in self.registry.names()
                 if ".slo." in n and n.endswith("_violations"))),
+            # deadline machinery + crash-recovery cadence
+            "shed_deadline": int(self._c_shed_deadline.value),
+            "shed_backpressure": int(self._c_shed_backpressure.value),
+            "shed_queue_deadline": int(self._c_shed_queue.value),
+            "deadline_expired_rows": int(self._c_deadline_rows.value),
+            "snapshots": int(self._c_snapshots.value),
+            "snapshot_age_s": (
+                None if self._last_snapshot is None
+                else round(time.monotonic() - self._last_snapshot[0], 4)),
             # admission economics: dispatches avoided (full hits +
             # batched groups), tokens of prefill compute skipped, and
             # per-hit-class admission latency (NaN until a class has a

@@ -72,6 +72,15 @@ class Request:
     latency_class: str = "default"
     slo_ttft_s: Optional[float] = None
     slo_latency_s: Optional[float] = None
+    # hard deadline (distinct from the SLO targets above, which only
+    # count violations): ``deadline_s`` is the budget in seconds from
+    # submit, ``deadline_at`` the absolute ``time.monotonic()`` expiry
+    # (stamped by ``Scheduler.push`` when unset). The engine enforces it
+    # at submit (typed shed), at admission (expired-in-queue shed), at
+    # requeue (no zombie retries) and between chunks (the row is frozen
+    # like EOS and the partial result flagged ``deadline_expired``).
+    deadline_s: Optional[float] = None
+    deadline_at: Optional[float] = None
     # prefix-cache grouping key (the prompt's first block-boundary
     # content digest, stamped by the engine when the cache is on):
     # same-priority requests sharing it are admitted together by the
@@ -190,8 +199,45 @@ class Scheduler:
             # ServingEngine.submit (a 0.0 default subtracted from a
             # monotonic 'now' reported hours of queue delay)
             request.submit_time = time.monotonic()
+        if request.deadline_s is not None and request.deadline_at is None:
+            request.deadline_at = request.submit_time + request.deadline_s
         pr = request.priority if self.policy == "priority" else 0
         heapq.heappush(self._heap, (pr, next(self._seq), request))
+
+    def shed_expired(self, now: float) -> List[Request]:
+        """Drop queued requests whose deadline already passed — checked
+        every admission round BEFORE slot occupancy, so an expired
+        request never wastes a prefill dispatch. Surviving entries keep
+        their original sequence numbers (cross-round order stable)."""
+        if not self._heap:
+            return []
+        keep, out = [], []
+        for e in self._heap:
+            req = e[2]
+            if req.deadline_at is not None and now > req.deadline_at:
+                out.append(req)
+            else:
+                keep.append(e)
+        if out:
+            self._heap = keep
+            heapq.heapify(self._heap)
+        return out
+
+    def queued(self) -> List[Request]:
+        """Non-destructive view of the queue in admission order (the
+        snapshot serializer reads it; (priority, seq) keys are unique so
+        the sort never compares Requests)."""
+        return [e[2] for e in sorted(self._heap,
+                                     key=lambda e: (e[0], e[1]))]
+
+    def take_all(self) -> List[Request]:
+        """Pop EVERY queued request in admission order (the requeue
+        export of a dead replica's queue — the router re-submits them to
+        survivors)."""
+        out = []
+        while self._heap:
+            out.append(heapq.heappop(self._heap)[2])
+        return out
 
     def admissions(self) -> List[Tuple[int, Request]]:
         """Fill every free slot from the queue; returns the

@@ -1,0 +1,626 @@
+"""Fault-isolated replicated serving: router + deadlines + snapshots.
+
+The load-bearing properties (ISSUE 10):
+- replica kill mid-chunk: the circuit breaker opens typed after K
+  consecutive fatal chunks, in-flight AND queued work requeues to
+  survivors with already-generated tokens replayed — greedy outputs
+  stay BIT-EXACT vs an undisturbed run, nothing is lost or re-emitted;
+- deadlines are enforced at all three points: submit (typed shed before
+  any prefill, plus queue-depth backpressure), admission (expired in
+  queue), and between chunks (row frozen like EOS, returned partial and
+  flagged ``deadline_expired``); an expired request is never requeued;
+- ``snapshot()`` -> ``restore()`` resumes accepted work bit-exactly
+  (fp32 and int8wk carries), refuses torn/corrupt files typed
+  (``CorruptCheckpointError``) and mismatched shapes/recipes typed;
+- an exhausted ladder harvests finished-but-uncollected rows into
+  results before ``DecodeFailedError`` propagates, and the flight
+  postmortem records the lost request ids with tokens-so-far;
+- the hung-replica story: delayed heartbeats turn a replica SUSPECT
+  (new submits route around it) and a clean beat recovers it;
+- /metrics carries per-replica labelled blocks, /statusz per-replica
+  status + the router health table — one attachment per replica.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.flags import set_flags
+from paddle_tpu.inference.generate import LlamaDecoder
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.runtime.resilience import (CorruptCheckpointError,
+                                           DeadlineExceededError,
+                                           DecodeFailedError,
+                                           InjectedFault,
+                                           ReplicaDeadError,
+                                           fault_injector)
+from paddle_tpu.serving import ReplicaSet, Router, ServingEngine
+
+pytestmark = pytest.mark.serving
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=4, max_position_embeddings=64)
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+@pytest.fixture(scope="module")
+def dec(model):
+    return LlamaDecoder(model, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def replica_decs(model, dec):
+    """Three decoders over the SAME weights — a replica pool serves one
+    model (requeue parity depends on it)."""
+    return [dec, LlamaDecoder(model, max_len=64),
+            LlamaDecoder(model, max_len=64)]
+
+
+def _workload(dec, n=6, seed=5, budgets=(6, 14)):
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 10)),)),
+             int(rng.integers(*budgets))) for _ in range(n)]
+    solo = [np.asarray(dec.generate(p[None], b)) for p, b in reqs]
+    return reqs, solo
+
+
+@pytest.fixture
+def no_backoff():
+    set_flags({"resilience_backoff_s": 0.0})
+    yield
+    fault_injector.clear()
+    set_flags({"resilience_backoff_s": 0.5})
+
+
+# -- deadline shedding: all three enforcement points ------------------------
+
+def test_deadline_shed_at_submit(dec):
+    """Satellite 1: an already-expired deadline is refused TYPED before
+    any prefill, with the serving.shed.deadline counter bumped."""
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    d0 = eng.prefill_dispatches
+    with pytest.raises(DeadlineExceededError, match="already"):
+        eng.submit(np.arange(4), 4, deadline_s=0.0)
+    with pytest.raises(DeadlineExceededError, match="already"):
+        eng.submit(np.arange(4), 4, deadline_s=-1.5)
+    assert eng.metrics()["shed_deadline"] == 2
+    assert eng.prefill_dispatches == d0        # nothing was dispatched
+    assert len(eng.scheduler) == 0             # nothing was queued
+    # a generous deadline is accepted
+    rid = eng.submit(np.arange(4), 4, deadline_s=60.0)
+    res = eng.drain()[rid]
+    assert not isinstance(res, BaseException)
+    assert res.resilience["serving"]["deadline_expired"] is False
+
+
+def test_deadline_backpressure_shed(dec):
+    """Queue-depth backpressure: once the engine has latency evidence
+    and a deep queue, a submit whose deadline is below the estimated
+    queue delay is shed typed at submit."""
+    eng = ServingEngine(dec, num_slots=1, chunk_size=4)
+    p = np.arange(4) % 64
+    eng.submit(p, 8)
+    eng.drain()                               # latency evidence exists
+    assert eng.estimated_queue_delay_s() == 0.0   # empty queue: no shed
+    for i in range(6):
+        eng.submit(p, 8, seed=i)
+    est = eng.estimated_queue_delay_s()
+    assert est > 0.0
+    with pytest.raises(DeadlineExceededError, match="queue delay"):
+        eng.submit(p, 8, deadline_s=est / 1e3)
+    assert eng.metrics()["shed_backpressure"] == 1
+    # a budget comfortably above the estimate is accepted
+    eng.submit(p, 8, deadline_s=est * 1e3 + 60.0)
+    eng.drain()
+
+
+def test_deadline_expired_in_queue_sheds_at_admission(dec):
+    """A request that expires WHILE QUEUED is shed typed at the next
+    admission round — it never costs a prefill — and resolves in the
+    step/drain output as a typed error value."""
+    eng = ServingEngine(dec, num_slots=1, chunk_size=4)
+    blocker = eng.submit(np.arange(4), 12)
+    # passes the submit check (positive budget), expires ~immediately
+    doomed = eng.submit(np.arange(5), 8, deadline_s=1e-9)
+    d0 = eng.prefill_dispatches
+    out = eng.drain()
+    assert not isinstance(out[blocker], BaseException)
+    assert isinstance(out[doomed], DeadlineExceededError)
+    assert isinstance(eng.result(doomed), DeadlineExceededError)
+    assert eng.metrics()["shed_queue_deadline"] == 1
+    assert eng.prefill_dispatches == d0 + 1    # only the blocker ran
+
+
+def test_deadline_expired_in_flight_returns_partial_flagged(dec):
+    """An in-flight row past its deadline is frozen like EOS at the
+    next chunk boundary: the partial tokens are a bit-exact PREFIX of
+    the undisturbed output and the record is flagged."""
+    p = np.arange(6) % 64
+    solo = np.asarray(dec.generate(p[None], 16))
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    rid = eng.submit(p, 16, deadline_s=60.0)
+    got = dict(eng.step())                     # one chunk: 4 tokens
+    assert rid not in got
+    # force expiry deterministically, then step again
+    slot = next(s for _, s in eng.scheduler.slots.occupied())
+    slot.request.deadline_at = 0.0             # monotonic past
+    got = dict(eng.step())
+    res = got[rid]
+    assert res.resilience["serving"]["deadline_expired"] is True
+    out = np.asarray(res)
+    assert out.shape[1] < solo.shape[1]        # genuinely partial
+    np.testing.assert_array_equal(out[0], solo[0, :out.shape[1]])
+    assert eng.metrics()["deadline_expired_rows"] == 1
+    # the slot was freed: a new request admits into it
+    rid2 = eng.submit(p, 4)
+    assert not isinstance(eng.drain()[rid2], BaseException)
+
+
+# -- snapshot / restore -----------------------------------------------------
+
+def _run_snapshot_roundtrip(dec, tmp_path, tag):
+    reqs, solo = _workload(dec, n=5, seed=11, budgets=(10, 16))
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    ids = [eng.submit(p, b) for p, b in reqs]
+    got = {}
+    for _ in range(2):
+        for rid, res in eng.step():
+            got[rid] = res
+    sdir = str(tmp_path / f"snap_{tag}")
+    eng.snapshot(sdir)
+    assert eng.metrics()["snapshots"] == 1
+    assert eng.status()["snapshot"]["age_s"] >= 0.0
+    fresh = ServingEngine(dec, num_slots=2, chunk_size=4)
+    info = fresh.restore(sdir)
+    assert info["in_flight"] >= 1              # caught rows mid-flight
+    assert info["in_flight"] + info["queued"] + len(got) == len(reqs)
+    got.update(fresh.drain())
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(got[rid]), solo[i],
+                                      err_msg=f"req {i} ({tag})")
+
+
+def test_snapshot_restore_bitexact_fp32(dec, tmp_path):
+    """The crash-recovery tentpole: a mid-flight snapshot restored on a
+    fresh engine continues every request bit-exactly (in-flight rows
+    with generated tokens AND still-queued requests)."""
+    _run_snapshot_roundtrip(dec, tmp_path, "fp32")
+
+
+def test_snapshot_restore_bitexact_int8wk(model, tmp_path):
+    """Same round-trip over the quantized int8 KV carry: the {"q","s"}
+    leaves flatten/restore like any other pytree."""
+    qdec = LlamaDecoder(model, max_len=64, quant="int8wk")
+    _run_snapshot_roundtrip(qdec, tmp_path, "int8wk")
+
+
+def test_snapshot_typed_refusals(dec, model, tmp_path):
+    """Mismatched shape/recipe and corrupt files refuse TYPED."""
+    from paddle_tpu.quantization.kv_cache import QuantMismatchError
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    eng.submit(np.arange(5), 8)
+    eng.step()
+    sdir = str(tmp_path / "snap")
+    eng.snapshot(sdir)
+    # slot-count mismatch: the carry rows must map 1:1
+    with pytest.raises(ValueError, match="num_slots"):
+        ServingEngine(dec, num_slots=4, chunk_size=4).restore(sdir)
+    # quant-recipe mismatch, typed both ways
+    qdec = LlamaDecoder(model, max_len=64, quant="int8wk")
+    with pytest.raises(QuantMismatchError, match="recipe"):
+        ServingEngine(qdec, num_slots=2, chunk_size=4).restore(sdir)
+    # a used engine refuses to restore over itself
+    with pytest.raises(RuntimeError, match="fresh"):
+        eng.restore(sdir)
+    # flipped payload byte: sha256 manifest refusal
+    data = os.path.join(sdir, "state.npz")
+    blob = bytearray(open(data, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    with open(data, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(CorruptCheckpointError, match="sha256"):
+        ServingEngine(dec, num_slots=2, chunk_size=4).restore(sdir)
+    # missing snapshot entirely
+    with pytest.raises(CorruptCheckpointError, match="manifest"):
+        ServingEngine(dec, num_slots=2,
+                      chunk_size=4).restore(str(tmp_path / "nope"))
+
+
+@pytest.mark.faults
+def test_snapshot_torn_write_refused_then_recovers(dec, tmp_path,
+                                                   no_backoff):
+    """The PR-3 corruption machinery applies to snapshots: a torn write
+    (injected crash mid-npz) leaves a snapshot that restore refuses
+    typed; a clean re-snapshot restores and continues bit-exactly."""
+    reqs, solo = _workload(dec, n=3, seed=12, budgets=(10, 14))
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    ids = [eng.submit(p, b) for p, b in reqs]
+    got = dict(eng.step())
+    sdir = str(tmp_path / "torn")
+    fault_injector.configure([{"kind": "torn_write",
+                               "path": "*state.npz", "at_byte": 80}])
+    with pytest.raises(InjectedFault):
+        eng.snapshot(sdir)
+    fault_injector.clear()
+    with pytest.raises(CorruptCheckpointError):
+        ServingEngine(dec, num_slots=2, chunk_size=4).restore(sdir)
+    eng.snapshot(sdir)                         # the engine is still up
+    fresh = ServingEngine(dec, num_slots=2, chunk_size=4)
+    fresh.restore(sdir)
+    got.update(fresh.drain())
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(got[rid]), solo[i])
+
+
+def test_graceful_drain_snapshots_instead_of_discarding(dec, tmp_path):
+    """drain(deadline_s=) is the graceful-drain story: when the budget
+    lapses with work in flight, the engine snapshots (never discards)
+    and a fresh engine finishes the work bit-exactly."""
+    reqs, solo = _workload(dec, n=4, seed=13, budgets=(10, 16))
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    ids = [eng.submit(p, b) for p, b in reqs]
+    sdir = str(tmp_path / "drain_snap")
+    got = eng.drain(deadline_s=0.0, snapshot_path=sdir)  # budget gone
+    assert eng.scheduler.slots.occupied() or len(eng.scheduler)
+    fresh = ServingEngine(dec, num_slots=2, chunk_size=4)
+    fresh.restore(sdir)
+    got.update(fresh.drain())
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(got[rid]), solo[i])
+    # no destination configured: refused up front, work untouched
+    with pytest.raises(ValueError, match="snapshot"):
+        ServingEngine(dec, num_slots=2,
+                      chunk_size=4).drain(deadline_s=1.0)
+
+
+def test_snapshot_cadence(dec, tmp_path):
+    """snapshot_every_chunks writes on chunk-boundary cadence."""
+    sdir = str(tmp_path / "cadence")
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4,
+                        snapshot_dir=sdir, snapshot_every_chunks=2)
+    eng.submit(np.arange(5), 16)
+    eng.drain()
+    m = eng.metrics()
+    assert m["snapshots"] >= 2                 # 4 chunks / every 2
+    assert m["snapshot_age_s"] >= 0.0
+    # and the cadence snapshot is itself restorable
+    fresh = ServingEngine(dec, num_slots=2, chunk_size=4)
+    fresh.restore(sdir)
+    with pytest.raises(ValueError, match="snapshot_dir"):
+        ServingEngine(dec, num_slots=2, chunk_size=4,
+                      snapshot_every_chunks=2)
+
+
+# -- ladder exhaustion harvests finished rows (satellite bugfix) -------------
+
+@pytest.mark.faults
+def test_ladder_exhaustion_harvests_finished_rows(dec, tmp_path,
+                                                  no_backoff):
+    """Satellite 2: when the chunk rung degrades and the per-token rung
+    dies mid-chunk, tokens from the steps that DID run are absorbed;
+    a request they complete is harvested into results (bit-exact, not
+    lost with the batch), and the postmortem records the lost ids with
+    tokens-generated-so-far."""
+    set_flags({"obs_enabled": True, "obs_flight_dir": str(tmp_path)})
+    try:
+        pa, pb = np.arange(4) % 64, (np.arange(5) + 3) % 64
+        solo_a = np.asarray(dec.generate(pa[None], 2))
+        eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+        rid_a = eng.submit(pa, 2)              # done after 2 rung steps
+        rid_b = eng.submit(pb, 12)             # genuinely lost
+        fault_injector.configure([
+            # every chunk dispatch dies transient -> degrade to rung
+            {"kind": "dispatch_error", "site": "decode.chunk",
+             "call": 1, "times": 1000},
+            # the rung survives 2 steps, then dies fatally
+            {"kind": "dispatch_error", "site": "decode.chunk_step",
+             "call": 3, "times": 1000, "code": "INTERNAL"}])
+        with pytest.raises(DecodeFailedError, match="per-token rung"):
+            eng.drain()
+        res = eng.result(rid_a)
+        assert res is not None, "finished row was lost with the batch"
+        np.testing.assert_array_equal(np.asarray(res), solo_a)
+        assert eng.result(rid_b) is None
+        # the postmortem accounts for the lost request
+        import paddle_tpu.obs as obs
+        pm_path = obs.flight_recorder.last_path
+        assert pm_path and os.path.exists(pm_path)
+        pm = json.load(open(pm_path))
+        lost = pm["extra"]["lost_requests"]
+        assert [e["request"] for e in lost] == [rid_b]
+        assert lost[0]["tokens_generated"] == 2
+        assert pm["extra"]["harvested_requests"] == [rid_a]
+    finally:
+        set_flags({"obs_enabled": False, "obs_flight_dir": ""})
+
+
+# -- the router -------------------------------------------------------------
+
+def test_router_replica_kill_requeue_parity(replica_decs, no_backoff):
+    """The tentpole drill: one replica's chunks die fatally mid-serve.
+    Its breaker opens after K strikes, in-flight + queued work requeues
+    to survivors with generated tokens replayed, and EVERY request is
+    greedy-bit-exact vs the undisturbed run — zero loss, zero
+    double-emit."""
+    reqs, solo = _workload(replica_decs[0], n=8, seed=21,
+                           budgets=(6, 14))
+    router = Router(ReplicaSet.from_backends(
+        replica_decs, num_slots=2, chunk_size=4), breaker_threshold=2)
+    fault_injector.configure([
+        {"kind": "dispatch_error", "site": "serving.replica1.chunk",
+         "call": 2, "times": 10**6, "code": "INTERNAL"},
+        {"kind": "dispatch_error", "site": "serving.replica1.step",
+         "call": 1, "times": 10**6, "code": "INTERNAL"}])
+    rids = [router.submit(p, b) for p, b in reqs]
+    outs = router.drain()
+    m = router.metrics()
+    assert m["states"]["replica1"] == "dead"
+    assert m["replica_deaths"] == 1 and m["requeued"] >= 1
+    requeued = 0
+    for i, rid in enumerate(rids):
+        out = outs[rid]
+        assert not isinstance(out, BaseException), f"req {i}: {out!r}"
+        np.testing.assert_array_equal(np.asarray(out), solo[i],
+                                      err_msg=f"req {i}")
+        rtr = out.resilience.get("router", {})
+        if rtr.get("requeues"):
+            requeued += 1
+            assert "replica1" in rtr["replicas"]
+            assert rtr["replicas"][-1] != "replica1"
+    assert requeued >= 1, "the drill never exercised a requeue"
+    # accounting: submitted == completed, no dead letters
+    assert m["submitted"] == m["completed"] == len(reqs)
+    assert m["dead_letter"] == 0
+
+
+def test_router_breaker_trip_fence_unfence(replica_decs, no_backoff):
+    """Breaker lifecycle: strikes below K keep the replica up; K
+    consecutive fatals fence it (submits route around, direct submit to
+    an all-dead set raises typed); unfence rebuilds the carry and the
+    replica serves again."""
+    two = replica_decs[:2]
+    reqs, solo = _workload(two[0], n=4, seed=22)
+    router = Router(ReplicaSet.from_backends(
+        two, num_slots=2, chunk_size=4), breaker_threshold=2)
+    fault_injector.configure([
+        {"kind": "dispatch_error", "site": "serving.replica0.chunk",
+         "call": 1, "times": 10**6, "code": "INTERNAL"},
+        {"kind": "dispatch_error", "site": "serving.replica0.step",
+         "call": 1, "times": 10**6, "code": "INTERNAL"}])
+    rids = [router.submit(p, b) for p, b in reqs]
+    outs = router.drain()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(np.asarray(outs[rid]), solo[i])
+    m = router.metrics()
+    assert m["states"]["replica0"] == "dead"
+    # every NEW submit lands on the survivor
+    rid = router.submit(reqs[0][0], reqs[0][1])
+    assert router._tracked[rid].replica == 1
+    router.drain()
+    # excluding the survivor too: typed refusal, nothing queued
+    with pytest.raises(ReplicaDeadError, match="no routable"):
+        router.submit(reqs[0][0], reqs[0][1], excluded_replicas=[1])
+    # unfence with the fault plan cleared: fresh carry, serves again
+    fault_injector.clear()
+    router.unfence(0)
+    assert router.metrics()["states"]["replica0"] == "healthy"
+    rid = router.submit(reqs[1][0], reqs[1][1], excluded_replicas=[1])
+    assert router._tracked[rid].replica == 0
+    np.testing.assert_array_equal(np.asarray(router.drain()[rid]),
+                                  solo[1])
+    with pytest.raises(ValueError, match="not fenced"):
+        router.unfence(0)
+
+
+def test_router_requeue_respects_deadline_no_zombie(replica_decs,
+                                                    no_backoff):
+    """A request whose deadline expired before requeue resolves to a
+    typed DeadlineExceededError — it is never resubmitted (no zombie
+    retries burning survivor slots)."""
+    two = replica_decs[:2]
+    p = np.arange(6) % 64
+    router = Router(ReplicaSet.from_backends(
+        two, num_slots=1, chunk_size=4), breaker_threshold=1)
+    fault_injector.configure([
+        {"kind": "dispatch_error", "site": "serving.replica0.chunk",
+         "call": 1, "times": 10**6, "code": "INTERNAL"},
+        {"kind": "dispatch_error", "site": "serving.replica0.step",
+         "call": 1, "times": 10**6, "code": "INTERNAL"}])
+    rid = router.submit(p, 12, deadline_s=3600.0,
+                        excluded_replicas=[1])   # pin onto replica0
+    router._tracked[rid].deadline_at = 0.0       # force expiry
+    outs = router.drain()
+    assert isinstance(outs[rid], DeadlineExceededError)
+    assert isinstance(router.outcome(rid), DeadlineExceededError)
+    with pytest.raises(DeadlineExceededError):
+        router.result(rid)
+    assert router.metrics()["shed_requeue_deadline"] == 1
+
+
+def test_router_all_replicas_dead_is_typed(replica_decs, no_backoff):
+    """A request that runs out of replicas resolves typed
+    (ReplicaDeadError) — the 'after exhaustion' arm of the contract."""
+    two = replica_decs[:2]
+    p = np.arange(4) % 64
+    router = Router(ReplicaSet.from_backends(
+        two, num_slots=1, chunk_size=4), breaker_threshold=1)
+    fault_injector.configure([
+        {"kind": "dispatch_error", "site": "serving.replica*.chunk",
+         "call": 1, "times": 10**6, "code": "INTERNAL"},
+        {"kind": "dispatch_error", "site": "serving.replica*.step",
+         "call": 1, "times": 10**6, "code": "INTERNAL"}])
+    rid = router.submit(p, 8)
+    outs = router.drain()
+    assert isinstance(outs[rid], ReplicaDeadError)
+    m = router.metrics()
+    assert m["healthy"] == 0 and m["dead_letter"] >= 1
+    with pytest.raises(ReplicaDeadError):
+        router.submit(p, 8)
+
+
+def test_router_hung_replica_suspect_and_recovery(replica_decs,
+                                                  no_backoff):
+    """Delayed heartbeats (injected skip window) mark a replica suspect
+    — new submits route AROUND it while it keeps serving its in-flight
+    work — and a clean beat recovers it."""
+    two = replica_decs[:2]
+    reqs, solo = _workload(two[0], n=6, seed=23)
+    router = Router(ReplicaSet.from_backends(
+        two, num_slots=2, chunk_size=4), heartbeat_miss_threshold=2)
+    fault_injector.configure([
+        {"kind": "delay_heartbeat", "node": "replica1",
+         "after_beats": 1, "skip_beats": 4}])
+    rids = [router.submit(p, b) for p, b in reqs]
+    saw_suspect = routed_around = False
+    outs = {}
+    while any(r.has_work() for r in router.replicas.live()):
+        for rid, res in router.step():
+            outs[rid] = res
+        rep1 = router.replicas.replicas[1]
+        if rep1.state == "suspect":
+            saw_suspect = True
+            extra = router.submit(np.arange(3), 4)
+            assert router._tracked[extra].replica == 0
+            routed_around = True
+    for _ in range(8):
+        router.step()                          # idle beats -> recovery
+    assert saw_suspect and routed_around
+    assert router.replicas.replicas[1].state == "healthy"
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(np.asarray(outs[rid]), solo[i])
+
+
+def test_router_full_drill_zero_request_loss(replica_decs, no_backoff):
+    """The acceptance drill: N=3 replicas, one killed mid-chunk,
+    another's heartbeat delayed, deadline pressure on top. EVERY
+    accepted request resolves to bit-exact tokens or a typed error —
+    the ledger adds up exactly."""
+    reqs, solo = _workload(replica_decs[0], n=9, seed=24,
+                           budgets=(6, 14))
+    router = Router(ReplicaSet.from_backends(
+        replica_decs, num_slots=2, chunk_size=4), breaker_threshold=2)
+    fault_injector.configure([
+        {"kind": "dispatch_error", "site": "serving.replica1.chunk",
+         "call": 2, "times": 10**6, "code": "INTERNAL"},
+        {"kind": "dispatch_error", "site": "serving.replica1.step",
+         "call": 1, "times": 10**6, "code": "INTERNAL"},
+        {"kind": "delay_heartbeat", "node": "replica2",
+         "after_beats": 2, "skip_beats": 3}])
+    rids = [router.submit(p, b) for p, b in reqs]
+    # deadline pressure: one doomed submit (typed at submit, pre-ledger)
+    with pytest.raises(DeadlineExceededError):
+        router.submit(reqs[0][0], 4, deadline_s=0.0)
+    # and one that expires while queued/in-flight
+    doomed = router.submit(reqs[0][0], reqs[0][1], deadline_s=1e-9)
+    outs = router.drain()
+    bit_exact = typed = 0
+    for i, rid in enumerate(rids):
+        out = outs[rid]
+        if isinstance(out, (DeadlineExceededError, ReplicaDeadError)):
+            typed += 1
+            continue
+        assert not isinstance(out, BaseException), f"untyped: {out!r}"
+        np.testing.assert_array_equal(np.asarray(out), solo[i])
+        bit_exact += 1
+    assert bit_exact + typed == len(reqs), "a request was lost"
+    assert isinstance(outs[doomed],
+                      (DeadlineExceededError, ReplicaDeadError))
+    m = router.metrics()
+    assert m["states"]["replica1"] == "dead"
+    assert m["requeued"] >= 1
+
+
+def test_router_cache_affinity_routing(replica_decs):
+    """A prompt whose prefix digest is live in a replica's prefix cache
+    routes there (guaranteed slab hit) even when another replica is
+    less loaded."""
+    two = replica_decs[:2]
+    router = Router(ReplicaSet.from_backends(
+        two, num_slots=2, chunk_size=4, prefix_cache=True))
+    p = np.arange(8) % 64
+    # seed the slab into replica1 (replica0 would win the idle tie)
+    rid = router.submit(p, 4, excluded_replicas=[0])
+    assert router._tracked[rid].replica == 1
+    router.drain()                             # slab now cached in r1
+    # idle tie: without affinity the lower index (replica0) would win —
+    # the cached digest pulls the prompt to replica1
+    rid2 = router.submit(p, 4)
+    assert router._tracked[rid2].replica == 1
+    # and affinity outranks load: make replica1 strictly busier
+    filler = router.submit(np.arange(5) + 1, 10,
+                           excluded_replicas=[0])
+    assert router._tracked[filler].replica == 1
+    rid3 = router.submit(p, 4)
+    assert router._tracked[rid3].replica == 1
+    # an uncached prompt falls back to least-loaded (replica0)
+    rid4 = router.submit(np.arange(7) + 9, 4)
+    assert router._tracked[rid4].replica == 0
+    router.drain()
+
+
+# -- observability ----------------------------------------------------------
+
+def test_router_exporter_per_replica_blocks(replica_decs):
+    """One attach per replica: /metrics carries every replica's
+    registry labelled {replica="..."} plus the router registry, and
+    /statusz a block per replica plus the router health table."""
+    two = replica_decs[:2]
+    router = Router(ReplicaSet.from_backends(
+        two, num_slots=2, chunk_size=4))
+    rid = router.submit(np.arange(4) % 64, 4)
+    router.drain()
+    port = router.start_exporter(port=0)
+    try:
+        assert port > 0
+        exp = router._exporter
+        text = exp.metrics_text()
+        assert 'replica="replica0"' in text
+        assert 'replica="replica1"' in text
+        assert "serving_router_submitted 1" in text
+        # same metric name appears once per replica, disambiguated by
+        # the label — a well-formed multi-replica exposition
+        assert text.count("serving_prefill_dispatches{") == 2
+        st = exp.statusz()
+        assert st["replica0"]["replica_tag"] == "replica0"
+        assert st["replica1"]["slots"]
+        health = st["router"]["replicas"]
+        assert [h["name"] for h in health] == ["replica0", "replica1"]
+        assert all(h["state"] == "healthy" for h in health)
+        assert st["router"]["requests"]["submitted"] == 1
+    finally:
+        router.stop_exporter()
+    assert router.outcome(rid) is not None
+
+
+def test_router_status_and_flight_state(replica_decs):
+    """Router.status() is the per-replica health table, and the flight
+    recorder's add_state hook serves the same shape (postmortems gain
+    per-replica state)."""
+    router = Router(ReplicaSet.from_backends(
+        replica_decs[:2], num_slots=2, chunk_size=4))
+    st = router.status()
+    assert len(st["replicas"]) == 2
+    assert st["replicas"][0]["heartbeat_age_s"] >= 0.0
+    assert st["breaker_threshold"] == router.breaker_threshold
+    snap = router.snapshot()                   # the add_state hook
+    assert snap.keys() == st.keys()
+    assert [r["name"] for r in snap["replicas"]] == \
+        [r["name"] for r in st["replicas"]]
+    # engine status carries the new deadline/snapshot blocks
+    est = router.replicas.replicas[0].engine.status()
+    assert est["shed"] == {"deadline": 0, "backpressure": 0,
+                           "queue_deadline": 0, "expired_rows": 0}
+    assert est["snapshot"] is None

@@ -6,7 +6,7 @@ output parity vs the no-fault run (and the retry/degradation counters
 say how), or raises a TYPED, documented error — never a raw traceback,
 never a silent wrong answer.
 
-Classes swept (decode + checkpoint + bundle + elastic paths):
+Classes swept (decode + checkpoint + bundle + elastic + serving paths):
   transient_dispatch    one UNAVAILABLE on the fused decode dispatch ->
                         retried, bit-exact, retries==1, no degradation
   spec_verify_dispatch  speculative decode program dead -> automatic
@@ -18,6 +18,19 @@ Classes swept (decode + checkpoint + bundle + elastic paths):
                         refuses it with CorruptBundleError
   dead_elastic          member's heartbeat dies (injected) -> survivor
                         TTL-detects it on the monotonic clock
+  replica_kill          one ReplicaSet replica's chunk dispatches die
+                        fatally mid-serve -> breaker opens typed, every
+                        in-flight/queued request requeues to survivors
+                        with its generated tokens replayed, greedy
+                        outputs bit-exact vs the undisturbed run
+  hung_replica          a replica's heartbeat is delayed (injected
+                        skip window) -> router marks it suspect, routes
+                        around it, recovers it on the next clean beat;
+                        all requests complete bit-exact
+  snapshot_torn_write   DecodeState snapshot torn mid-write (injected
+                        crash) -> restore refuses typed
+                        CorruptCheckpointError; a clean re-snapshot
+                        restores and continues generation bit-exactly
 
 Prints one human line per class to stderr and ONE parseable JSON line
 to stdout (the bench.py last-line contract); exit code 0 iff all pass.
@@ -165,6 +178,132 @@ def drill_dead_elastic():
         victim.stop()
 
 
+def _replica_workload(n=6, seed=5, n_replicas=1):
+    """A tiny model, ``n_replicas`` decoders over the SAME weights (a
+    replica pool serves one model), a mixed workload and its undisturbed
+    solo-greedy reference outputs."""
+    import numpy as np
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    decs = [LlamaDecoder(model, max_len=64) for _ in range(n_replicas)]
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 10)),)),
+             int(rng.integers(6, 14))) for _ in range(n)]
+    solo = [np.asarray(decs[0].generate(p[None], n_))
+            for p, n_ in reqs]
+    return decs, reqs, solo
+
+
+def drill_replica_kill():
+    import numpy as np
+    from paddle_tpu.serving import ReplicaSet, Router
+    from paddle_tpu.runtime.resilience import fault_injector
+    decs, reqs, solo = _replica_workload(n_replicas=3)
+    router = Router(ReplicaSet.from_backends(decs, num_slots=2,
+                                             chunk_size=4),
+                    breaker_threshold=2)
+    fault_injector.configure([
+        {"kind": "dispatch_error", "site": "serving.replica1.chunk",
+         "call": 2, "times": 1000000, "code": "INTERNAL"},
+        {"kind": "dispatch_error", "site": "serving.replica1.step",
+         "call": 1, "times": 1000000, "code": "INTERNAL"}])
+    rids = [router.submit(p, n) for p, n in reqs]
+    outs = router.drain()
+    for i, rid in enumerate(rids):
+        out = outs[rid]
+        assert not isinstance(out, BaseException), \
+            f"request {i} lost to the dead replica: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged after requeue"
+    m = router.metrics()
+    assert m["states"]["replica1"] == "dead", m
+    assert m["requeued"] >= 1 and m["replica_deaths"] == 1, m
+    return (f"breaker opened, {m['requeued']} requests requeued to "
+            f"survivors, all {len(reqs)} bit-exact")
+
+
+def drill_hung_replica():
+    import numpy as np
+    from paddle_tpu.serving import ReplicaSet, Router
+    from paddle_tpu.runtime.resilience import fault_injector
+    decs, reqs, solo = _replica_workload(seed=6, n_replicas=2)
+    router = Router(ReplicaSet.from_backends(decs, num_slots=2,
+                                             chunk_size=4),
+                    heartbeat_miss_threshold=2)
+    fault_injector.configure([
+        {"kind": "delay_heartbeat", "node": "replica1",
+         "after_beats": 1, "skip_beats": 4}])
+    rids = [router.submit(p, n) for p, n in reqs]
+    saw_suspect = False
+    outs = {}
+    while any(r.has_work() for r in router.replicas.live()):
+        for rid, res in router.step():
+            outs[rid] = res
+        states = {r.name: r.state for r in router.replicas}
+        saw_suspect = saw_suspect or states.get("replica1") == "suspect"
+    for i, rid in enumerate(rids):
+        assert np.array_equal(np.asarray(outs[rid]), solo[i]), \
+            f"request {i} diverged under the delayed heartbeat"
+    assert saw_suspect, "delayed heartbeat never marked the replica " \
+                        "suspect"
+    assert router.metrics()["heartbeat_suspects"] >= 1
+    # the router loop keeps polling idle replicas in production: a few
+    # idle steps let the skip window lapse and the recovery beat land
+    for _ in range(8):
+        router.step()
+    states = {r.name: r.state for r in router.replicas}
+    assert states["replica1"] == "healthy", \
+        f"replica never recovered after the skip window: {states}"
+    return ("suspect during the skip window, recovered on a clean "
+            "beat, all requests bit-exact")
+
+
+def drill_snapshot_torn_write(tmp):
+    import numpy as np
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.runtime.resilience import (CorruptCheckpointError,
+                                               InjectedFault,
+                                               fault_injector)
+    decs, reqs, solo = _replica_workload(n=4, seed=7)
+    dec = decs[0]
+    sdir = os.path.join(tmp, "serve_snap")
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    rids = [eng.submit(p, n) for p, n in reqs]
+    got = {}
+    for _ in range(2):
+        for rid, res in eng.step():
+            got[rid] = res
+    fault_injector.configure([{"kind": "torn_write",
+                               "path": "*state.npz", "at_byte": 100}])
+    try:
+        eng.snapshot(sdir)
+        raise AssertionError("torn-write injection did not fire")
+    except InjectedFault:
+        pass                      # the simulated crash mid-snapshot
+    fault_injector.clear()
+    fresh = ServingEngine(dec, num_slots=2, chunk_size=4)
+    try:
+        fresh.restore(sdir)
+        raise AssertionError("torn snapshot restored silently")
+    except CorruptCheckpointError as e:
+        typed = str(e)[:60]
+    # the engine is still alive: a clean re-snapshot must restore and
+    # continue bit-exactly (recover-bit-exact-OR-typed-error, both arms)
+    eng.snapshot(sdir)
+    fresh = ServingEngine(dec, num_slots=2, chunk_size=4)
+    fresh.restore(sdir)
+    got.update(fresh.drain())
+    for i, rid in enumerate(rids):
+        assert np.array_equal(np.asarray(got[rid]), solo[i]), \
+            f"request {i} diverged after snapshot->restore"
+    return f"typed refusal ({typed}…), clean re-snapshot bit-exact"
+
+
 def main():
     import tempfile
 
@@ -177,6 +316,9 @@ def main():
         ("torn_checkpoint", drill_torn_checkpoint, True),
         ("corrupt_bundle", drill_corrupt_bundle, True),
         ("dead_elastic", drill_dead_elastic, False),
+        ("replica_kill", drill_replica_kill, False),
+        ("hung_replica", drill_hung_replica, False),
+        ("snapshot_torn_write", drill_snapshot_torn_write, True),
     ]
     results = {}
     ok = True

@@ -52,6 +52,16 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      engine's per-request parity and dispatch accounting hard-assert
      against the quantized carry
 
+ 13. serve_replicated (`bench.py --serve --replicas 3 --faults`): the
+     fault-isolated replicated-serving gate — one replica's chunk
+     dispatches are killed fatally mid-serve (its breaker must open and
+     its work requeue to survivors, tokens replayed) while another's
+     heartbeat is delayed (suspect -> recovered); ZERO lost accepted
+     requests (bit-exact or typed error, accounting hard-asserted
+     in-bench), p99 under failure reported, and the
+     snapshot()->restore() round-trip continues bit-exactly on fp32 AND
+     int8wk carries
+
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
 """
@@ -109,6 +119,13 @@ STEPS = [
                       "int8w"], None),
     ("serve_quant", [sys.executable, "bench.py", "--serve", "--quant",
                      "int8wk"], None),
+    # replicated-serving gate: replica-kill + delayed-heartbeat fault
+    # plan against a 3-replica Router — zero lost accepted requests
+    # (every one bit-exact or a typed error), breaker/requeue/suspect
+    # accounting and the fp32+int8wk snapshot->restore round-trip are
+    # ALL hard-asserted inside the bench (rc != 0 on any violation)
+    ("serve_replicated", [sys.executable, "bench.py", "--serve",
+                          "--replicas", "3", "--faults"], None),
 ]
 
 
