@@ -1664,6 +1664,218 @@ def bench_serve_replicated(n_requests=None, replicas=3, slots=None,
     return line
 
 
+def bench_serve_cluster(spec="prefill:1,decode:2", n_requests=None,
+                        slots=None, chunk=None, faults=False):
+    """``--serve --cluster prefill:1,decode:2 [--faults]``: the
+    multi-process disaggregated serving benchmark — REAL OS processes,
+    REAL SIGKILL.
+
+    ``launch_cluster`` spawns one worker process per spec entry (>=3
+    processes counting the frontend's pool), ships the model weights
+    once as an npz, and fronts them with the ``ClusterRouter``:
+    admission prefills run on the PREFILL pool and ship to a DECODE
+    worker as a KV slab (the DistServe/Splitwise split), so decode-pool
+    admission is one row-scatter. With ``--faults`` the drill is a real
+    ``SIGKILL`` of a decode worker mid-run: its accepted work must
+    requeue to survivors as ``prompt + tokens_so_far`` replay. Hard
+    asserts, in-bench:
+
+    - every worker is a DISTINCT live OS process (not the bench pid);
+    - ZERO lost accepted requests: submitted == bit-exact (vs an
+      undisturbed in-process solo generate over the same weights) +
+      typed errors, even under the SIGKILL;
+    - per-worker accounting split: prefill dispatches ONLY on the
+      prefill pool, chunk dispatches ONLY on the decode pool, every
+      delivered request a FULL prefix hit with zero admission
+      dispatches decode-side;
+    - the fleet /metrics (one frontend exposition, live-scraped from
+      every worker's own exporter) carries per-worker-labelled samples.
+
+    Reports tokens/s and p99 under (injected) process failure."""
+    import os as _os
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.runtime.resilience import (DeadlineExceededError,
+                                               ReplicaDeadError)
+    from paddle_tpu.serving import launch_cluster, parse_cluster_spec
+
+    roles = parse_cluster_spec(spec)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    n_req = n_requests or 12
+    slots = slots or 2
+    chunk = chunk or 4
+    prompt_len, len_pool = 8, (4, 8, 12)
+    model = LlamaForCausalLM(cfg)
+    max_len = prompt_len + max(len_pool) + 8
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+    lens = rng.choice(len_pool, n_req)
+    # the undisturbed reference: the SAME weights decoded in-process
+    solo_dec = LlamaDecoder(model, max_len=max_len)
+    solo = [np.asarray(solo_dec.generate(prompts[i][None], int(lens[i])))
+            for i in range(n_req)]
+
+    workdir = tempfile.mkdtemp(prefix="bench_cluster_")
+    t0 = time.perf_counter()
+    with launch_cluster(
+            model, workdir, prefill=roles["prefill"],
+            decode=roles["decode"], unified=roles["unified"],
+            max_len=max_len,
+            engine_kw={"num_slots": slots, "chunk_size": chunk},
+            heartbeat_s=0.4, ttl_s=2.0,
+            rpc_timeout_s=30.0) as cl:
+        router = cl.router
+        obs_port = router.start_exporter(port=0)
+
+        # >=3 REAL processes, none of them this one
+        pids = {h.name: h.pid for h in router.workers}
+        assert len(pids) >= 3, \
+            f"the cluster drill needs >=3 worker processes, got {pids}"
+        assert _os.getpid() not in pids.values(), \
+            "worker 'process' is the bench process itself"
+        for name, pid in pids.items():
+            _os.kill(pid, 0)      # raises if the process does not exist
+
+        rids = [router.submit(prompts[i], int(lens[i]))
+                for i in range(n_req)]
+        outcomes, finish_at = {}, {}
+        steps, killed_pid, fleet_text = 0, None, None
+        victim = next((h.name for h in router.workers
+                       if h.role == "decode"),
+                      next(h.name for h in router.workers
+                           if h.serves_decode))
+        while router.in_flight():
+            for rid, res in router.step():
+                outcomes[rid] = res
+                finish_at[rid] = time.perf_counter() - t0
+            steps += 1
+            if fleet_text is None and steps >= 2:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{obs_port}/metrics",
+                        timeout=10.0) as r:
+                    fleet_text = r.read().decode()
+            if (faults and killed_pid is None and steps >= 3
+                    and router.in_flight() > 1):
+                killed_pid = cl.kill(victim)
+        wall = time.perf_counter() - t0
+        m = router.metrics()
+        wm = router.worker_metrics()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{obs_port}/statusz",
+                timeout=10.0) as r:
+            statusz = json.loads(r.read().decode())
+
+    # -- the zero-loss ledger (hard-asserted) -------------------------------
+    disaggregated = roles["prefill"] > 0 \
+        and m["disaggregation_fallbacks"] == 0
+    bit_exact, typed, requeued_ok = 0, 0, 0
+    for i, rid in enumerate(rids):
+        out = outcomes.get(rid)
+        assert out is not None, \
+            f"request {i} vanished: submitted but never resolved"
+        if isinstance(out, (DeadlineExceededError, ReplicaDeadError)):
+            typed += 1
+            continue
+        assert not isinstance(out, BaseException), \
+            f"request {i} resolved to an UNtyped error: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged from the undisturbed in-process run"
+        bit_exact += 1
+        resil = getattr(out, "resilience", None) or {}
+        srv = resil.get("serving", {})
+        if disaggregated:
+            assert srv.get("prefix_hit") == "full", \
+                f"request {i} admitted decode-side despite the prefill " \
+                f"pool: prefix_hit={srv.get('prefix_hit')!r}"
+            assert int(srv.get("admission_dispatches") or 0) == 0, \
+                f"request {i} issued {srv['admission_dispatches']} " \
+                f"admission dispatches on a decode worker"
+        if resil.get("cluster", {}).get("requeues"):
+            requeued_ok += 1
+    assert bit_exact + typed == n_req, \
+        f"loss: {n_req} submitted, {bit_exact} exact + {typed} typed"
+
+    # -- per-worker accounting: the disaggregation split --------------------
+    for name, w in wm.items():
+        assert "error" not in w, f"worker {name} metrics RPC: {w}"
+        if w["role"] == "prefill":
+            assert w["chunk_dispatches"] == 0, \
+                f"prefill worker {name} ran decode chunks: {w}"
+            assert w["prefill_dispatches"] > 0, \
+                f"prefill worker {name} never prefilled: {w}"
+        elif w["role"] == "decode" and disaggregated:
+            assert w["prefill_dispatches"] == 0, \
+                f"decode worker {name} ran its own prefills: {w}"
+    assert any(w.get("chunk_dispatches", 0) > 0 for w in wm.values()
+               if "error" not in w), "no live worker ran decode chunks"
+    if disaggregated:
+        assert m["disaggregated_admissions"] >= n_req, m
+
+    # -- fleet observability: per-worker-labelled live scrape ---------------
+    assert fleet_text is not None, "fleet /metrics was never scraped"
+    for name in pids:
+        assert f'worker="{name}"' in fleet_text, \
+            f"fleet /metrics missing worker-labelled samples for {name}"
+    assert "serving_cluster_submitted" in fleet_text, \
+        "fleet /metrics missing the frontend's own registry"
+    assert "cluster" in statusz and any(
+        k.startswith("worker:") for k in statusz), \
+        f"fleet /statusz missing per-worker blocks: {list(statusz)}"
+
+    if faults:
+        assert killed_pid is not None, \
+            "fault drill never fired: the run finished too quickly"
+        assert m["worker_deaths"] >= 1 and m["requeued"] >= 1, m
+        assert requeued_ok >= 1, \
+            "no request survived the SIGKILL requeue bit-exactly"
+        states = m["states"]
+        assert states[victim] == "dead", states
+
+    useful = int(lens.sum())
+    lat = np.asarray([finish_at[r] for r in rids if r in finish_at
+                      and not isinstance(outcomes[r], BaseException)])
+    p99 = float(np.percentile(lat, 99)) if lat.size else float("nan")
+    print(f"serve-cluster: spec {spec} ({len(pids)} worker processes), "
+          f"{n_req} requests, faults={'on' if faults else 'off'} — "
+          f"{bit_exact} bit-exact + {typed} typed = ZERO lost; "
+          f"{m['requeued']} requeued, deaths {m['worker_deaths']}, "
+          f"{m['disaggregated_admissions']} disaggregated admissions, "
+          f"{useful / wall:.0f} tok/s, p99 {p99 * 1e3:.0f}ms",
+          file=sys.stderr)
+    line = _emit("serving_cluster_tokens_per_sec",
+                 round(useful / wall, 1), "tokens/sec")
+    line["serve_cluster"] = {
+        "spec": spec, "workers": {n: {"pid": p} for n, p in pids.items()},
+        "slots_per_decode": slots, "chunk_size": chunk,
+        "requests": n_req, "sigkill": killed_pid,
+        "bit_exact": bit_exact, "typed_errors": typed,
+        "lost": n_req - bit_exact - typed,
+        "requeued": m["requeued"],
+        "requeued_bit_exact": requeued_ok,
+        "worker_deaths": m["worker_deaths"],
+        "disaggregated_admissions": m["disaggregated_admissions"],
+        "disaggregation_fallbacks": m["disaggregation_fallbacks"],
+        "worker_states": m["states"],
+        "worker_dispatches": {
+            n: {"prefill": w.get("prefill_dispatches"),
+                "chunk": w.get("chunk_dispatches")}
+            for n, w in wm.items() if "error" not in w},
+        "latency_p99_s": round(p99, 4),
+        "wall_s": round(wall, 3),
+    }
+    print(json.dumps(line))
+    return line
+
+
 def bench_serve_prefix(n_groups=None, slots=None, chunk=None, mesh=None):
     """``--serve --prefix-mix``: the prefix-cache serving benchmark.
 
@@ -2024,10 +2236,21 @@ def main():
                          "Router — hard-asserts zero lost accepted "
                          "requests (bit-exact or typed error) and the "
                          "snapshot->restore round-trip")
+    ap.add_argument("--cluster", default=None,
+                    help="with --serve: multi-process disaggregated "
+                         "serving over REAL OS worker processes, e.g. "
+                         "'prefill:1,decode:2' — admission prefills on "
+                         "the prefill pool ship to decode workers as KV "
+                         "slabs; hard-asserts bit-exact parity vs an "
+                         "in-process solo decode, the per-worker "
+                         "dispatch split, and (with --faults) zero lost "
+                         "requests under a mid-run SIGKILL of a decode "
+                         "worker")
     ap.add_argument("--faults", action="store_true",
                     help="with --serve --replicas: inject the replica-"
-                         "kill + delayed-heartbeat fault plan and "
-                         "report p99 under failure")
+                         "kill + delayed-heartbeat fault plan; with "
+                         "--serve --cluster: SIGKILL a decode worker "
+                         "process mid-run; report p99 under failure")
     ap.add_argument("--prefix-mix", action="store_true",
                     help="with --serve: the prefix-cache benchmark — a "
                          "shared-prompt arrival mix served cold vs "
@@ -2074,6 +2297,12 @@ def main():
     except Exception as e:
         _emit_failure("backend_init", e)
         sys.exit(1)
+    if args.serve and args.cluster:
+        _run_guarded("serve_cluster", lambda: bench_serve_cluster(
+            spec=args.cluster, n_requests=args.serve_requests,
+            slots=args.serve_slots, chunk=args.serve_chunk,
+            faults=args.faults))
+        return
     if args.serve and args.replicas:
         _run_guarded("serve_replicated", lambda: bench_serve_replicated(
             n_requests=args.serve_requests, replicas=args.replicas,

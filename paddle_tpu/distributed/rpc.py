@@ -28,6 +28,14 @@ __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
 
 _DEFAULT_TIMEOUT = 30.0
 
+# TCPStore replies are read into a fixed 1 MiB client buffer
+# (native/tcp_store.py): payloads above this ride multiple part keys
+# written BEFORE the header value, so a reader that sees the header can
+# fetch every part without waiting. 512 KiB leaves headroom for the
+# pickle framing and key names.
+_CHUNK_BYTES = 512 * 1024
+_CHUNK_MAGIC = b"__chunked__:"
+
 
 @dataclass(frozen=True)
 class WorkerInfo:
@@ -63,7 +71,7 @@ class RpcAgent:
 
     def __init__(self, name: str, rank: int, world_size: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 is_master: Optional[bool] = None):
+                 is_master: Optional[bool] = None, resume: bool = False):
         # port=0: the master picks a free port (TCPStore default); workers
         # must pass the master's advertised host/port
         self.name = name
@@ -74,7 +82,16 @@ class RpcAgent:
                                          else is_master),
                               world_size=world_size)
         self.store.set(f"rpc/worker/{rank}", name.encode())
-        self._served = 0
+        # resume=True: this agent REUSES a dead incarnation's rank (a
+        # restarted worker). The request/reply counters live in the
+        # store and survive the process, so a fresh agent starting at 0
+        # would re-serve every request the dead incarnation already
+        # consumed. Skip to the current high-water marks instead: calls
+        # addressed to the dead incarnation stay unanswered (the caller's
+        # future times out — its signal the worker died mid-call).
+        self._served = self.store.add(f"rpc/cnt/{rank}", 0) if resume else 0
+        self._seen = (self.store.add(f"rpc/rescnt/{rank}", 0)
+                      if resume else 0)
         self._next_reply: Dict[int, Future] = {}
         self._seq_lock = threading.Lock()
         self._stop = threading.Event()
@@ -101,6 +118,29 @@ class RpcAgent:
     def all_worker_info(self):
         return [self.worker_info(r) for r in range(self.world_size)]
 
+    # -- chunked store values ----------------------------------------------
+    def _put(self, key: str, payload: bytes) -> None:
+        """Store ``payload`` under ``key``, splitting values past the
+        TCPStore client-buffer limit across ``{key}/part{i}`` keys. The
+        parts land BEFORE the header, so any reader that observes the
+        header value can fetch every part immediately."""
+        if len(payload) <= _CHUNK_BYTES:
+            self.store.set(key, payload)
+            return
+        n = (len(payload) + _CHUNK_BYTES - 1) // _CHUNK_BYTES
+        for i in range(n):
+            self.store.set(f"{key}/part{i}",
+                           payload[i * _CHUNK_BYTES:(i + 1) * _CHUNK_BYTES])
+        self.store.set(key, _CHUNK_MAGIC + str(n).encode())
+
+    def _fetch(self, key: str, timeout: float) -> bytes:
+        raw = self.store.wait(key, timeout=timeout)
+        if not raw.startswith(_CHUNK_MAGIC):
+            return raw
+        n = int(raw[len(_CHUNK_MAGIC):])
+        return b"".join(self.store.get(f"{key}/part{i}")
+                        for i in range(n))
+
     # -- client ------------------------------------------------------------
     def call(self, to, fn: Callable, args=(), kwargs=None,
              timeout: float = _DEFAULT_TIMEOUT) -> Future:
@@ -110,23 +150,22 @@ class RpcAgent:
             seq = self.store.add(f"rpc/cnt/{dst}", 1)
             self._next_reply[(dst, seq)] = fut  # noqa: consumed by _collect
         payload = pickle.dumps((self.rank, seq, fn, args, kwargs or {}))
-        self.store.set(f"rpc/req/{dst}/{seq}", payload)
+        self._put(f"rpc/req/{dst}/{seq}", payload)
         return fut
 
     def _collect(self):
         """Wait for replies addressed to this rank, in arrival order."""
-        seen = 0
         while not self._stop.is_set():
             try:
-                raw = self.store.wait(f"rpc/res/{self.rank}/{seen + 1}",
-                                      timeout=0.25)
+                raw = self._fetch(f"rpc/res/{self.rank}/{self._seen + 1}",
+                                  timeout=0.25)
             except TimeoutError:
                 continue
             except Exception:
                 if self._stop.is_set():
                     return
                 continue
-            seen += 1
+            self._seen += 1
             dst, seq, ok, payload = pickle.loads(raw)
             fut = self._next_reply.pop((dst, seq), None)
             if fut is not None:
@@ -137,8 +176,8 @@ class RpcAgent:
         while not self._stop.is_set():
             nxt = self._served + 1
             try:
-                raw = self.store.wait(f"rpc/req/{self.rank}/{nxt}",
-                                      timeout=0.25)
+                raw = self._fetch(f"rpc/req/{self.rank}/{nxt}",
+                                  timeout=0.25)
             except TimeoutError:
                 continue
             except Exception:
@@ -159,7 +198,7 @@ class RpcAgent:
                      RuntimeError(f"rpc result not picklable: {e}")))
             # reply stream is indexed by the CALLER's arrival order
             ridx = self.store.add(f"rpc/rescnt/{src}", 1)
-            self.store.set(f"rpc/res/{src}/{ridx}", payload)
+            self._put(f"rpc/res/{src}/{ridx}", payload)
 
     def shutdown(self):
         self._stop.set()

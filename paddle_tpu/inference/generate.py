@@ -884,19 +884,30 @@ class LlamaDecoder:
         return np.concatenate(out, axis=1)
 
     # -- speculative decoding ---------------------------------------------
-    def _spec_engine(self, draft_model):
+    def _spec_engine(self, draft_model, draft_quant: Optional[str] = None):
         """Prepare (and cache) the draft side of speculative decoding.
         ``draft_model``: a LlamaForCausalLM with the same vocab (its
         weights are snapshotted exactly like the target's), or 'skip:N'
         — a layer-skip view that reuses the TARGET's first N layers plus
-        its final norm/head as the draft, zero extra weights."""
+        its final norm/head as the draft, zero extra weights.
+        ``draft_quant``: 'int8w' quantizes the DRAFT's weights only —
+        the target keeps its own dtype, the verify pass stays exact, so
+        a wrong draft only costs acceptance length, never correctness."""
         import dataclasses
         cfg, max_len = self.cfg, self.max_len
+        if draft_quant not in (None, "int8w"):
+            raise ValueError(
+                f"draft_quant must be None or 'int8w', got {draft_quant!r}")
         if isinstance(draft_model, str):
             if not draft_model.startswith("skip:"):
                 raise ValueError(
                     "draft_model must be a LlamaForCausalLM or 'skip:N' "
                     f"(layer-skip view of the target), got {draft_model!r}")
+            if draft_quant is not None:
+                raise ValueError(
+                    "draft_quant does not compose with 'skip:N' drafts: "
+                    "the layer-skip view reuses the TARGET's params, so "
+                    "quantize the target (quant='int8w') instead")
             n = int(draft_model.split(":", 1)[1])
             if not 0 < n < cfg.num_hidden_layers:
                 raise ValueError(
@@ -904,7 +915,7 @@ class LlamaDecoder:
                     f"({cfg.num_hidden_layers})")
             ekey = ("skip", n)
         else:
-            ekey = ("model", id(draft_model))
+            ekey = ("model", id(draft_model), draft_quant)
         eng = self._spec_engines.get(ekey)
         if eng is not None:
             return eng
@@ -917,7 +928,8 @@ class LlamaDecoder:
                 raise ValueError(
                     f"draft vocab_size {dcfg.vocab_size} != target "
                     f"vocab_size {cfg.vocab_size}")
-            dp = _build_params(draft_model, max_len, self.weight_dtype)
+            dp = _build_params(draft_model, max_len,
+                               "int8" if draft_quant else self.weight_dtype)
 
         def draft_prefill(dp_, ids, dkc, dvc):
             self.trace_count += 1
@@ -1010,6 +1022,7 @@ class LlamaDecoder:
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  seed: int = 0, draft_model=None,
                  num_speculative_tokens: Optional[int] = None,
+                 draft_quant: Optional[str] = None,
                  chunk_size: Optional[int] = None) -> np.ndarray:
         """Decode. input_ids: (B, S) ints. Returns (B, S + new).
 
@@ -1023,7 +1036,10 @@ class LlamaDecoder:
         ``flags.decode_speculative_tokens``) draft proposals per target
         verify, still one decode dispatch after the two prefills, with
         the target distribution preserved exactly (greedy: exact-match
-        accept; sampling: Leviathan rejection rule). ``eos_token_id``
+        accept; sampling: Leviathan rejection rule).
+        ``draft_quant='int8w'`` additionally quantizes the DRAFT
+        model's weights to int8 (target untouched — the verify pass
+        stays exact, so a worse draft only costs acceptance length). ``eos_token_id``
         accepts ``None`` or any negative id (the bundles' ``-1``
         convention) as "no eos". Set the ``decode_fallback`` flag or
         ``PADDLE_TPU_DECODE_FALLBACK=1`` to debug against the per-token
@@ -1096,7 +1112,7 @@ class LlamaDecoder:
                     f"K={K} slots: prompt {S} + {max_new_tokens} new + {K} "
                     f"exceeds max_len {self.max_len}; build the decoder "
                     f"with more slack")
-            eng = self._spec_engine(draft_model)
+            eng = self._spec_engine(draft_model, draft_quant)
             gen = (self._generate_speculative_fallback if fallback
                    else self._generate_speculative)
             ladder.append(("speculative", lambda: gen(
@@ -1105,6 +1121,8 @@ class LlamaDecoder:
         elif num_speculative_tokens is not None:
             raise ValueError("num_speculative_tokens requires a "
                              "draft_model")
+        elif draft_quant is not None:
+            raise ValueError("draft_quant requires a draft_model")
         if chunk_size is not None:
             if draft_model is not None:
                 raise ValueError(

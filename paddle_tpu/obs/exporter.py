@@ -102,6 +102,7 @@ class ObsExporter:
         self._lock = threading.Lock()
         self._registries: Dict[str, Any] = {}
         self._status: Dict[str, Callable[[], dict]] = {}
+        self._text: Dict[str, Callable[[], str]] = {}
 
     # -- composition --------------------------------------------------------
     def add_registry(self, name: str, registry,
@@ -121,6 +122,17 @@ class ObsExporter:
         /statusz. Provider errors are reported in-band, never a 500."""
         with self._lock:
             self._status[name] = fn
+        return self
+
+    def add_text_provider(self, name: str,
+                          fn: Callable[[], str]) -> "ObsExporter":
+        """Attach a callable returning raw Prometheus exposition text,
+        appended verbatim to every /metrics scrape — how a cluster
+        frontend folds its workers' live (already per-worker-labelled)
+        /metrics into ONE fleet exposition. A provider that raises
+        contributes a comment line, never a failed scrape."""
+        with self._lock:
+            self._text[name] = fn
         return self
 
     def add_engine(self, engine, name: str = "serving",
@@ -239,6 +251,14 @@ class ObsExporter:
                 parts.append(reg.to_prometheus(labels=labels or None))
             except Exception:
                 pass
+        with self._lock:
+            texts = list(self._text.items())
+        for name, fn in texts:
+            try:
+                parts.append(fn())
+            except Exception as e:
+                parts.append(f"# text provider {name} unavailable: "
+                             f"{type(e).__name__}\n")
         return "".join(p for p in parts if p)
 
     def statusz(self) -> dict:

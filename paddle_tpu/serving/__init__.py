@@ -10,6 +10,13 @@ independently. Serves either an in-process ``LlamaDecoder`` or an AOT
 bundle exported with ``chunk_sizes=`` (``decode_mode.chunked``).
 """
 
+from paddle_tpu.serving.cluster import (  # noqa: F401
+    Cluster,
+    ClusterRouter,
+    WorkerHandle,
+    launch_cluster,
+    parse_cluster_spec,
+)
 from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
 from paddle_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixCache,
@@ -33,4 +40,5 @@ from paddle_tpu.serving.scheduler import (  # noqa: F401
 __all__ = ["ServingEngine", "PrefixCache", "PrefixLookup", "PrefixSlab",
            "prefix_digests", "Replica", "ReplicaSet", "Router",
            "Request", "Scheduler", "Slot", "SlotTable",
-           "bucket_length"]
+           "bucket_length", "Cluster", "ClusterRouter", "WorkerHandle",
+           "launch_cluster", "parse_cluster_spec"]

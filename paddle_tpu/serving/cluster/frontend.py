@@ -1,0 +1,715 @@
+"""ClusterRouter: the frontend of the multi-process serving cluster.
+
+The in-process ``Router`` (serving/router.py) re-derived over RPC, with
+the health signals made REAL: a replica here is an OS process, a missed
+heartbeat is the ``ElasticManager`` TTL expiring on the frontend's own
+monotonic clock, and a dead socket is an RPC future timing out — both
+mean the process is gone (SIGKILL, OOM, hang), not that an in-process
+breaker flag flipped.
+
+Routing: disaggregated admission first — when a prefill pool exists,
+the prompt is prefilled on the least-loaded PREFILL worker
+(``prefill_extract``: the KV rows leave through the prefix-slab path),
+shipped to the chosen DECODE worker and ingested there
+(``load_prefix_slab``), so the decode worker admits with ONE
+row-scatter and zero prefill dispatches (the DistServe/Splitwise
+split). Decode placement is least-loaded over the frontend's own
+assignment table, FIFO by rank on ties — deterministic, so fault
+drills replay.
+
+Crash recovery, two modes per the recover= knob:
+
+- ``"replay"`` (default): the dead worker's accepted requests re-enter
+  a survivor as ``prompt + tokens_so_far`` with the dead worker
+  excluded. The ledger replayed is the frontend's OWN copy — ``step``
+  ships every occupied slot's tokens-so-far each iteration, so the
+  frontend never has to ask a corpse. Greedy replay is bit-exact
+  (teacher-forcing the same tokens reproduces the same logits); sampled
+  replay is bit-exact too when the decode pool runs
+  ``request_keyed_rng`` (the router id + tokens-emitted count derive
+  the identical stream on any worker).
+- ``"restart"``: the launcher's respawn hook brings the SAME rank back
+  (``resume=True`` RPC counters — the dead incarnation's calls stay
+  unanswered), the new process restores the worker's last atomic
+  snapshot, and the frontend reconciles: engine ids the restored
+  incarnation knows resume in place (their post-snapshot tokens re-emit
+  deterministically — delivery is per-request-once, so nothing
+  double-emits); ids accepted after the snapshot are replayed from the
+  frontend ledger. Respawn/restore failure falls back to replay — a
+  crashed worker never takes accepted work down with it either way.
+
+Fleet observability: ``start_exporter`` serves ONE /metrics that
+scrapes every live worker's own exporter at request time and
+concatenates the (per-worker-labelled) expositions after the
+frontend's registry, and a /statusz whose per-worker blocks are
+fetched live; an unreachable worker degrades to a comment line /
+error block, never a failed scrape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import paddle_tpu.obs as obs
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.runtime.resilience import (DeadlineExceededError,
+                                           GenerateResult,
+                                           ReplicaDeadError, ReplicaEvent,
+                                           record_event)
+from paddle_tpu.serving.cluster.worker import worker_op
+
+__all__ = ["ClusterRouter", "WorkerHandle"]
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    """One worker process as the frontend sees it."""
+    name: str
+    rank: int
+    role: str                        # prefill | decode | unified
+    pid: int
+    obs_port: int = 0
+    snapshot_dir: Optional[str] = None
+    state: str = "healthy"           # healthy | suspect | dead
+    consecutive_fatal: int = 0
+    missed_beats: int = 0
+    deaths: int = 0
+    last_error: Optional[str] = None
+    queued: int = 0                  # last observed over RPC
+    occupied: int = 0
+
+    @property
+    def serves_decode(self) -> bool:
+        return self.role in ("decode", "unified")
+
+    @property
+    def serves_prefill(self) -> bool:
+        return self.role in ("prefill", "unified")
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """Frontend bookkeeping for one accepted request. ``prompt`` and
+    ``max_new_tokens`` are the CURRENT submission's view (a requeue
+    folds the replayed ledger into the prompt); ``ledger`` holds the
+    tokens the current worker has produced so far — the replay payload
+    for that worker's next crash."""
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    temperature: float
+    seed: int
+    priority: int
+    latency_class: str
+    deadline_at: Optional[float]
+    worker: int                      # rank
+    engine_rid: int
+    ledger: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int64))
+    excluded: Set[int] = dataclasses.field(default_factory=set)
+    attempts: List[str] = dataclasses.field(default_factory=list)
+    replayed_tokens: int = 0
+
+
+class ClusterRouter:
+    """Health-checked router over a pool of worker PROCESSES.
+
+    ``agent`` is the frontend's master ``RpcAgent`` (rank 0);
+    ``elastic`` its started ``ElasticManager`` over the same store
+    (worker heartbeats land there); ``workers`` the registered
+    handles. ``respawn`` (from the launcher) restarts a dead worker's
+    rank and returns its fresh registration dict — required for
+    ``recover="restart"``."""
+
+    def __init__(self, agent, workers: Sequence[WorkerHandle], elastic,
+                 rpc_timeout_s: float = 60.0,
+                 breaker_threshold: int = 1,
+                 heartbeat_miss_threshold: int = 3,
+                 recover: str = "replay",
+                 respawn: Optional[Callable[[WorkerHandle], dict]] = None):
+        if recover not in ("replay", "restart"):
+            raise ValueError(
+                f"recover must be 'replay' or 'restart', got {recover!r}")
+        if not any(h.serves_decode for h in workers):
+            raise ValueError("the cluster needs at least one decode or "
+                             "unified worker")
+        self.agent = agent
+        self.elastic = elastic
+        self.workers: List[WorkerHandle] = list(workers)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.heartbeat_miss_threshold = int(heartbeat_miss_threshold)
+        self.recover = recover
+        self._respawn = respawn
+        self._tracked: Dict[int, _Tracked] = {}
+        self._by_engine: Dict[int, Dict[int, int]] = {
+            h.rank: {} for h in self.workers}
+        self._results: Dict[int, Any] = {}
+        self._errors: Dict[int, BaseException] = {}
+        self._next_id = 0
+        self._exporter = None
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self._c_submitted = r.counter(
+            "serving.cluster.submitted", "requests accepted and routed")
+        self._c_completed = r.counter(
+            "serving.cluster.completed", "requests resolved with tokens")
+        self._c_requeued = r.counter(
+            "serving.cluster.requeued",
+            "requests replayed onto a survivor off a dead worker")
+        self._c_deaths = r.counter(
+            "serving.cluster.worker_deaths",
+            "workers declared dead (heartbeat TTL or RPC socket)")
+        self._c_restarts = r.counter(
+            "serving.cluster.worker_restarts",
+            "dead workers respawned and restored from their snapshot")
+        self._c_resumed = r.counter(
+            "serving.cluster.requests_resumed",
+            "requests resumed IN PLACE on a restarted worker (known to "
+            "its restored snapshot — no replay needed)")
+        self._c_dead_letter = r.counter(
+            "serving.cluster.dead_letter",
+            "requests resolved as typed ReplicaDeadError: no surviving "
+            "decode worker")
+        self._c_shed_requeue = r.counter(
+            "serving.cluster.shed_requeue_deadline",
+            "requests whose deadline expired before requeue")
+        self._c_disagg = r.counter(
+            "serving.cluster.disaggregated_admissions",
+            "requests whose prefill ran on the prefill pool and shipped "
+            "to a decode worker as a slab")
+        self._c_disagg_fallback = r.counter(
+            "serving.cluster.disaggregation_fallbacks",
+            "requests admitted with a decode-side prefill because the "
+            "prefill pool was unavailable")
+        self._g_healthy = r.gauge(
+            "serving.cluster.healthy_workers", "workers taking traffic")
+        self._g_healthy.set(len(self.workers))
+        obs.flight_recorder.add_state("serving.cluster", self)
+
+    # -- pools -------------------------------------------------------------
+    def _decode_pool(self, excluded: Set[int]) -> List[WorkerHandle]:
+        cand = [h for h in self.workers
+                if h.serves_decode and h.state == "healthy"
+                and h.rank not in excluded]
+        return sorted(cand, key=lambda h: (self._load(h), h.rank))
+
+    def _prefill_pool(self) -> List[WorkerHandle]:
+        cand = [h for h in self.workers
+                if h.role == "prefill" and h.state == "healthy"]
+        return sorted(cand, key=lambda h: (self._load(h), h.rank))
+
+    def _load(self, h: WorkerHandle) -> int:
+        # the frontend's OWN assignment table: live even when the worker
+        # hasn't been stepped yet (RPC-observed depth lags a step)
+        return len(self._by_engine[h.rank])
+
+    def _handle(self, rank: int) -> WorkerHandle:
+        for h in self.workers:
+            if h.rank == rank:
+                return h
+        raise ValueError(f"no worker with rank {rank}")
+
+    # -- RPC ---------------------------------------------------------------
+    def _call(self, h: WorkerHandle, op: str, *args,
+              timeout: Optional[float] = None, **kwargs):
+        fut = self.agent.call(h.rank, worker_op, (op,) + args, kwargs)
+        return fut.wait(self.rpc_timeout_s if timeout is None
+                        else timeout)
+
+    # -- routing -----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token_id: Optional[int] = None,
+               temperature: float = 1.0, seed: int = 0,
+               priority: int = 0, latency_class: str = "default",
+               deadline_s: Optional[float] = None) -> int:
+        """Route one request; returns the cluster request id. When a
+        prefill pool exists the admission prefill runs THERE and ships
+        to the decode worker as a slab (full prefix hit: zero decode
+        prefill dispatches); prefill-pool failure degrades to a decode-
+        side prefill, never a refused request. Raises typed
+        ``ReplicaDeadError`` with no routable decode worker and the
+        last ``DeadlineExceededError`` when every candidate sheds."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        cand = self._decode_pool(set())
+        if not cand:
+            raise ReplicaDeadError(
+                f"no routable decode worker "
+                f"(states={[(h.name, h.state) for h in self.workers]})")
+        rid = self._next_id
+        payload = self._disaggregate(prompt)
+        last_shed: Optional[BaseException] = None
+        for h in cand:
+            try:
+                if payload is not None:
+                    self._call(h, "load_slab", payload)
+                erid = self._call(
+                    h, "submit", prompt,
+                    max_new_tokens=int(max_new_tokens),
+                    eos_token_id=eos_token_id,
+                    temperature=float(temperature), seed=int(seed),
+                    priority=int(priority),
+                    latency_class=str(latency_class),
+                    deadline_s=deadline_s, rng_request_id=rid,
+                    rng_tokens_emitted=0)
+            except DeadlineExceededError as e:
+                last_shed = e
+                continue
+            self._next_id += 1
+            now = time.monotonic()
+            self._tracked[rid] = _Tracked(
+                rid=rid, prompt=prompt,
+                max_new_tokens=int(max_new_tokens),
+                eos_token_id=eos_token_id,
+                temperature=float(temperature), seed=int(seed),
+                priority=int(priority),
+                latency_class=str(latency_class),
+                deadline_at=(None if deadline_s is None
+                             else now + float(deadline_s)),
+                worker=h.rank, engine_rid=erid, attempts=[h.name])
+            self._by_engine[h.rank][erid] = rid
+            self._c_submitted.inc()
+            return rid
+        raise last_shed
+
+    def _disaggregate(self, prompt: np.ndarray) -> Optional[dict]:
+        """Run the admission prefill on the prefill pool; None = no
+        pool / pool unavailable (the decode worker prefills itself)."""
+        pool = self._prefill_pool()
+        if not pool:
+            return None
+        for h in pool:
+            try:
+                payload = self._call(h, "prefill", prompt)
+            except Exception as e:
+                self._strike(h, e, [])
+                continue
+            h.consecutive_fatal = 0
+            self._c_disagg.inc()
+            return payload
+        self._c_disagg_fallback.inc()
+        return None
+
+    # -- the serving loop --------------------------------------------------
+    def step(self) -> List[Tuple[int, Any]]:
+        """One iteration: heartbeat sweep over the elastic membership,
+        then one RPC ``step`` per decode worker with assigned work.
+        Returns the ``(cluster_rid, outcome)`` pairs resolved —
+        results or typed errors."""
+        finished: List[Tuple[int, Any]] = []
+        members = set(self.elastic.members)
+        for h in list(self.workers):
+            if h.state == "dead":
+                continue
+            if h.name not in members:
+                h.missed_beats += 1
+                if h.missed_beats >= self.heartbeat_miss_threshold:
+                    self._declare_dead(
+                        h, f"heartbeat expired ({h.missed_beats} "
+                           f"missed beats)", finished)
+                    continue
+                if h.state == "healthy":
+                    h.state = "suspect"
+                    self._sync_healthy()
+                    record_event(ReplicaEvent(
+                        site="serving.cluster", replica=h.name,
+                        action="suspect",
+                        detail=f"{h.missed_beats} missed process "
+                               f"heartbeats"))
+            else:
+                h.missed_beats = 0
+                if h.state == "suspect":
+                    h.state = "healthy"
+                    self._sync_healthy()
+                    record_event(ReplicaEvent(
+                        site="serving.cluster", replica=h.name,
+                        action="recovered",
+                        detail="process heartbeat resumed"))
+            if not h.serves_decode or not self._by_engine[h.rank]:
+                continue
+            try:
+                r = self._call(h, "step")
+            except Exception as e:
+                self._strike(h, e, finished)
+                continue
+            h.consecutive_fatal = 0
+            h.queued = int(r.get("queued", 0))
+            h.occupied = int(r.get("occupied", 0))
+            for erid, toks in r.get("inflight", {}).items():
+                rid = self._by_engine[h.rank].get(int(erid))
+                if rid is not None:
+                    self._tracked[rid].ledger = np.asarray(toks)
+            for erid, kind, payload, resil in r.get("finished", []):
+                out = self._deliver(h, int(erid), kind, payload, resil)
+                if out is not None:
+                    finished.append(out)
+        return finished
+
+    def drain(self, max_steps: Optional[int] = None) -> Dict[int, Any]:
+        """Step until every accepted request is resolved; returns the
+        outcomes resolved while draining (results AND typed errors —
+        the zero-request-loss accounting reads this)."""
+        out: Dict[int, Any] = {}
+        steps = 0
+        while self.in_flight():
+            for rid, res in self.step():
+                out[rid] = res
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"cluster drain did not converge within "
+                    f"{max_steps} steps ({self.in_flight()} in flight)")
+        return out
+
+    def in_flight(self) -> int:
+        return len(self._tracked) - len(self._results) - len(self._errors)
+
+    def outcome(self, rid: int):
+        """The resolved outcome: a ``GenerateResult`` or a typed error
+        VALUE; None while in flight."""
+        if rid in self._results:
+            return self._results[rid]
+        return self._errors.get(rid)
+
+    def result(self, rid: int):
+        """The result array; RAISES the stored typed error."""
+        if rid in self._errors:
+            raise self._errors[rid]
+        return self._results.get(rid)
+
+    def _deliver(self, h: WorkerHandle, erid: int, kind: str, payload,
+                 resil) -> Optional[Tuple[int, Any]]:
+        rid = self._by_engine[h.rank].pop(erid, None)
+        if rid is None:
+            return None
+        t = self._tracked[rid]
+        if kind == "error":
+            self._errors[rid] = payload
+            return rid, payload
+        if resil is not None:
+            resil["cluster"] = {
+                "workers": list(t.attempts),
+                "requeues": len(t.attempts) - 1,
+                "replayed_tokens": t.replayed_tokens,
+            }
+        res = GenerateResult.wrap(np.asarray(payload), resil)
+        self._results[rid] = res
+        self._c_completed.inc()
+        return rid, res
+
+    # -- health / recovery -------------------------------------------------
+    def _sync_healthy(self) -> None:
+        self._g_healthy.set(
+            sum(1 for h in self.workers if h.state == "healthy"))
+
+    def _strike(self, h: WorkerHandle, error: BaseException,
+                finished: List[Tuple[int, Any]]) -> None:
+        h.consecutive_fatal += 1
+        h.last_error = f"{type(error).__name__}: {str(error)[:200]}"
+        record_event(ReplicaEvent(
+            site="serving.cluster", replica=h.name, action="strike",
+            detail=f"rpc failure: {h.last_error} "
+                   f"({h.consecutive_fatal}/{self.breaker_threshold})"))
+        if h.consecutive_fatal >= self.breaker_threshold:
+            self._declare_dead(h, f"dead socket: {h.last_error}",
+                               finished)
+
+    def _declare_dead(self, h: WorkerHandle, reason: str,
+                      finished: List[Tuple[int, Any]]) -> None:
+        """A worker PROCESS is gone (TTL-expired heartbeat or dead
+        socket). Fence it, then recover its accepted work: restart-from-
+        snapshot when configured (falling back to replay on any respawn/
+        restore failure), else replay onto survivors."""
+        h.state = "dead"
+        h.deaths += 1
+        self._c_deaths.inc()
+        self._sync_healthy()
+        dead_err = ReplicaDeadError(
+            f"worker {h.name} (rank {h.rank}, pid {h.pid}) dead: "
+            f"{reason}", replica=h.name)
+        record_event(ReplicaEvent(
+            site="serving.cluster", replica=h.name, action="dead",
+            detail=reason[:300]))
+        obs.record_crash("serving.cluster.worker_dead", error=dead_err,
+                         extra={"worker": h.name, "rank": h.rank,
+                                "pid": h.pid, "reason": reason[:300]})
+        if (self.recover == "restart" and self._respawn is not None
+                and h.snapshot_dir):
+            if self._restart(h, finished):
+                return
+        rids = list(self._by_engine[h.rank].values())
+        self._by_engine[h.rank].clear()
+        for rid in rids:
+            self._requeue(rid, h, dead_err, finished)
+
+    def _restart(self, h: WorkerHandle,
+                 finished: List[Tuple[int, Any]]) -> bool:
+        """Respawn the dead rank, restore its snapshot, reconcile the
+        assignment table. Returns False (caller replays) on any
+        failure."""
+        try:
+            info = self._respawn(h)
+            h.pid = int(info["pid"])
+            h.obs_port = int(info.get("obs_port", h.obs_port))
+            restored = self._call(h, "restore", h.snapshot_dir,
+                                  timeout=self.rpc_timeout_s)
+            known = self._call(h, "known")
+        except Exception as e:
+            record_event(ReplicaEvent(
+                site="serving.cluster", replica=h.name,
+                action="restart_failed",
+                detail=f"{type(e).__name__}: {str(e)[:200]}"))
+            return False
+        h.state = "healthy"
+        h.consecutive_fatal = 0
+        h.missed_beats = 0
+        self._sync_healthy()
+        self._c_restarts.inc()
+        record_event(ReplicaEvent(
+            site="serving.cluster", replica=h.name, action="restarted",
+            detail=f"pid {h.pid}, restored "
+                   f"{restored.get('in_flight', 0)} in-flight + "
+                   f"{restored.get('queued', 0)} queued"))
+        assigned = dict(self._by_engine[h.rank])
+        dead_err = ReplicaDeadError(
+            f"worker {h.name} crashed and restarted", replica=h.name)
+        for erid, rid in assigned.items():
+            if erid in known:
+                # resumes in place; post-snapshot tokens re-emit
+                # deterministically and delivery is per-rid-once. The
+                # ledger resets to the restored engine's view on the
+                # next step's inflight export.
+                res = self._call(h, "result", erid)
+                if res is not None:
+                    # finished between the snapshot and the crash: the
+                    # restored results table already holds the outcome
+                    if isinstance(res, BaseException):
+                        out = self._deliver(h, erid, "error", res, None)
+                    else:
+                        out = self._deliver(h, erid, "tokens", res[0],
+                                            res[1])
+                    if out is not None:
+                        finished.append(out)
+                else:
+                    self._c_resumed.inc()
+                continue
+            # accepted after the snapshot: the restored engine never
+            # heard of it — replay from the frontend ledger (the
+            # restarted worker is NOT excluded: it crashed, it wasn't
+            # wrong)
+            self._by_engine[h.rank].pop(erid, None)
+            self._requeue(rid, h, dead_err, finished, exclude=False)
+        return True
+
+    def _requeue(self, rid: int, dead: WorkerHandle,
+                 dead_err: ReplicaDeadError,
+                 finished: List[Tuple[int, Any]],
+                 exclude: bool = True) -> None:
+        t = self._tracked[rid]
+        if exclude:
+            t.excluded.add(dead.rank)
+        now = time.monotonic()
+        if t.deadline_at is not None and now > t.deadline_at:
+            self._c_shed_requeue.inc()
+            err = DeadlineExceededError(
+                f"request {rid} deadline expired before requeue off "
+                f"dead worker {dead.name}", request_id=rid)
+            self._errors[rid] = err
+            finished.append((rid, err))
+            return
+        # fold the ledger into the prompt: the survivor teacher-forces
+        # the same tokens (same logits — greedy bit-exact), and the
+        # request-keyed RNG derivation resumes the same stream at
+        # replayed_tokens for sampled parity
+        if t.ledger.size:
+            t.prompt = np.concatenate(
+                [np.asarray(t.prompt),
+                 t.ledger.astype(np.asarray(t.prompt).dtype)])
+            t.max_new_tokens -= int(t.ledger.size)
+            t.replayed_tokens += int(t.ledger.size)
+            t.ledger = np.zeros((0,), np.int64)
+        cand = self._decode_pool(t.excluded)
+        if not cand:
+            self._c_dead_letter.inc()
+            err = ReplicaDeadError(
+                f"request {rid}: no surviving decode worker "
+                f"(excluded ranks {sorted(t.excluded)})",
+                replica=dead.name)
+            self._errors[rid] = err
+            finished.append((rid, err))
+            return
+        rem_deadline = (None if t.deadline_at is None
+                        else t.deadline_at - now)
+        # replay admissions disaggregate too: the survivor ingests the
+        # grown prompt as a shipped slab, so prefill dispatches stay on
+        # the prefill pool even across requeues
+        payload = self._disaggregate(t.prompt)
+        for h in cand:
+            try:
+                if payload is not None:
+                    self._call(h, "load_slab", payload)
+                erid = self._call(
+                    h, "submit", t.prompt,
+                    max_new_tokens=t.max_new_tokens,
+                    eos_token_id=t.eos_token_id,
+                    temperature=t.temperature, seed=t.seed,
+                    priority=t.priority, latency_class=t.latency_class,
+                    deadline_s=rem_deadline, rng_request_id=rid,
+                    rng_tokens_emitted=t.replayed_tokens)
+            except DeadlineExceededError as e:
+                self._c_shed_requeue.inc()
+                self._errors[rid] = e
+                finished.append((rid, e))
+                return
+            except Exception as e:
+                self._strike(h, e, finished)
+                continue
+            t.worker = h.rank
+            t.engine_rid = erid
+            t.attempts.append(h.name)
+            self._by_engine[h.rank][erid] = rid
+            self._c_requeued.inc()
+            record_event(ReplicaEvent(
+                site="serving.cluster", replica=h.name,
+                action="requeue",
+                detail=f"request {rid} moved off {dead.name} with "
+                       f"{t.replayed_tokens} tokens replayed"))
+            return
+        self._c_dead_letter.inc()
+        err = ReplicaDeadError(
+            f"request {rid}: every requeue candidate failed",
+            replica=dead.name)
+        self._errors[rid] = err
+        finished.append((rid, err))
+
+    # -- fleet observability -----------------------------------------------
+    def worker_metrics(self) -> Dict[str, dict]:
+        """RPC metrics snapshot per live worker — the bench's
+        accounting source (prefill dispatches live ONLY on the prefill
+        pool, chunk dispatches ONLY on the decode pool)."""
+        out = {}
+        for h in self.workers:
+            if h.state == "dead":
+                continue
+            try:
+                out[h.name] = self._call(h, "metrics")
+            except Exception as e:
+                out[h.name] = {"error": f"{type(e).__name__}: "
+                                        f"{str(e)[:200]}"}
+        return out
+
+    def _scrape_worker_metrics(self) -> str:
+        """Fetch every live worker's own /metrics and concatenate —
+        the samples are already labelled ``{worker="<name>"}`` by each
+        worker's exporter, so verbatim concatenation IS the fleet
+        exposition."""
+        parts = []
+        for h in self.workers:
+            if h.state == "dead" or not h.obs_port:
+                parts.append(f"# worker {h.name} not scraped "
+                             f"(state={h.state})\n")
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{h.obs_port}/metrics",
+                        timeout=2.0) as r:
+                    parts.append(r.read().decode())
+            except Exception as e:
+                parts.append(f"# worker {h.name} unreachable: "
+                             f"{type(e).__name__}\n")
+        return "".join(parts)
+
+    def _worker_statusz(self, h: WorkerHandle) -> dict:
+        if h.state == "dead" or not h.obs_port:
+            return {"state": h.state, "unreachable": True}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{h.obs_port}/statusz",
+                timeout=2.0) as r:
+            return json.loads(r.read().decode())
+
+    def status(self) -> Dict[str, Any]:
+        """The frontend's own /statusz block: per-worker health + the
+        request accounting."""
+        return {
+            "recover": self.recover,
+            "workers": [{
+                "name": h.name, "rank": h.rank, "role": h.role,
+                "pid": h.pid, "state": h.state,
+                "consecutive_fatal": h.consecutive_fatal,
+                "missed_beats": h.missed_beats,
+                "deaths": h.deaths, "last_error": h.last_error,
+                "assigned": len(self._by_engine[h.rank]),
+                "queued": h.queued, "occupied": h.occupied,
+                "obs_port": h.obs_port,
+            } for h in self.workers],
+            "requests": {
+                "submitted": int(self._c_submitted.value),
+                "completed": int(self._c_completed.value),
+                "requeued": int(self._c_requeued.value),
+                "dead_letter": int(self._c_dead_letter.value),
+                "shed_requeue_deadline":
+                    int(self._c_shed_requeue.value),
+                "in_flight": self.in_flight(),
+            },
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Flight-recorder state hook (postmortem view)."""
+        return self.status()
+
+    def metrics(self) -> Dict[str, Any]:
+        """Fleet-level accounting counters."""
+        return {
+            "workers": len(self.workers),
+            "healthy": sum(1 for h in self.workers
+                           if h.state == "healthy"),
+            "states": {h.name: h.state for h in self.workers},
+            "submitted": int(self._c_submitted.value),
+            "completed": int(self._c_completed.value),
+            "requeued": int(self._c_requeued.value),
+            "worker_deaths": int(self._c_deaths.value),
+            "worker_restarts": int(self._c_restarts.value),
+            "requests_resumed": int(self._c_resumed.value),
+            "dead_letter": int(self._c_dead_letter.value),
+            "shed_requeue_deadline": int(self._c_shed_requeue.value),
+            "disaggregated_admissions": int(self._c_disagg.value),
+            "disaggregation_fallbacks":
+                int(self._c_disagg_fallback.value),
+        }
+
+    def start_exporter(self, port: Optional[int] = None) -> int:
+        """ONE fleet /metrics + /statusz: the frontend's registry, a
+        live-scraped concatenation of every worker's (per-worker-
+        labelled) /metrics, and per-worker /statusz blocks fetched at
+        request time. Returns the bound port."""
+        if self._exporter is not None:
+            return self._exporter.port
+        from paddle_tpu.obs.exporter import (ObsExporter,
+                                             resolve_export_port)
+        p = resolve_export_port() if port is None else int(port)
+        if port is None and p == 0:
+            return 0
+        exp = ObsExporter(port=p)
+        exp.add_registry("cluster", self.registry)
+        exp.add_status_provider("cluster", self.status)
+        exp.add_text_provider("workers", self._scrape_worker_metrics)
+        for h in self.workers:
+            exp.add_status_provider(
+                f"worker:{h.name}",
+                lambda h=h: self._worker_statusz(h))
+        self._exporter = exp
+        return exp.start()
+
+    def stop_exporter(self) -> None:
+        exp, self._exporter = self._exporter, None
+        if exp is not None:
+            exp.stop()

@@ -1,0 +1,261 @@
+"""Cluster worker: one OS process, one ServingEngine, RPC-served.
+
+Runnable module (``python -m paddle_tpu.serving.cluster.worker``); the
+launcher passes everything through the ``PADDLE_TPU_CLUSTER_CFG`` env
+var as one JSON document (name/rank/world, the master store endpoint,
+the role, the model config + weights npz path, engine kwargs). The
+process builds its model from the shipped weights — every worker and
+the frontend's in-process reference decode the SAME parameters, the
+greedy-parity precondition — then serves ops over the TCPStore-backed
+``RpcAgent`` request stream:
+
+``submit``/``step``/``result`` — the serving loop, driven entirely by
+the frontend (a worker never steps itself: chunk cadence is a routing
+decision). ``step`` returns the finished outcomes AND the in-flight
+tokens-so-far of every occupied slot — the frontend's replay ledger is
+rebuilt every step, so a SIGKILLed worker's accepted work is already
+in the frontend's hands. ``prefill``/``load_slab`` — the disaggregation
+pair (prefill_extract / load_prefix_slab). ``snapshot``/``restore`` —
+the crash-recovery pair (atomic manifest discipline). ``stall`` — a
+drill hook: the RpcAgent serves ops SERIALLY, so one stalled op makes
+every later future time out (the frontend_rpc_timeout drill).
+
+Liveness: an ``ElasticManager`` heartbeat thread (nonce:seq over the
+shared store, observer-local monotonic TTL) — the frontend treats a
+missed PROCESS heartbeat as real replica death, exactly like the
+reference's elastic fleet. Telemetry: the worker's own ``ObsExporter``
+serves /metrics (engine registry labelled ``{worker="<name>"}``) and
+/statusz on an ephemeral port registered alongside the worker.
+
+A restarted worker (the recover-from-snapshot drill) reuses its dead
+incarnation's rank with ``resume=True`` — the RPC counters skip to the
+store's high-water marks, so calls addressed to the dead incarnation
+stay unanswered instead of being double-served.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["WorkerHost", "worker_op", "main"]
+
+_HOST: Optional["WorkerHost"] = None
+
+
+def worker_op(name: str, *args, **kwargs):
+    """The one RPC entry point (module-level: picklable by reference).
+    Dispatches to the process-singleton :class:`WorkerHost`."""
+    if _HOST is None:
+        raise RuntimeError(
+            "cluster worker not initialized in this process (worker_op "
+            "is served by `python -m paddle_tpu.serving.cluster.worker`)")
+    return _HOST.handle(name, *args, **kwargs)
+
+
+class WorkerHost:
+    """The process-singleton worker state: engine + agent + heartbeat +
+    exporter, with the op table the RPC stream dispatches into."""
+
+    def __init__(self, cfg: Dict[str, Any], resume: bool = False):
+        from paddle_tpu.distributed.elastic import ElasticManager
+        from paddle_tpu.distributed.rpc import RpcAgent
+        from paddle_tpu.inference.generate import LlamaDecoder
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.obs.exporter import ObsExporter
+        from paddle_tpu.serving.engine import ServingEngine
+
+        self.cfg = cfg
+        self.name = str(cfg["name"])
+        self.rank = int(cfg["rank"])
+        self.role = str(cfg.get("role", "unified"))
+        if self.role not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"worker role must be prefill|decode|unified, "
+                f"got {self.role!r}")
+        self._stop = threading.Event()
+
+        # model from the shipped weights: identical params fleet-wide
+        model = LlamaForCausalLM(LlamaConfig(**cfg["model"]))
+        with np.load(cfg["weights"]) as data:
+            missing, unexpected = model.set_state_dict(
+                {k: data[k] for k in data.files})
+        if missing or unexpected:
+            raise ValueError(
+                f"worker {self.name}: weights npz does not match the "
+                f"model (missing={missing[:3]}, "
+                f"unexpected={unexpected[:3]})")
+        dec = LlamaDecoder(model, max_len=int(cfg.get("max_len", 256)),
+                           quant=cfg.get("quant"))
+        ekw = dict(cfg.get("engine") or {})
+        if self.role == "prefill":
+            # a prefill worker only ever runs prefill_extract: no slots
+            # turn over, no prefix cache to ingest into
+            ekw.pop("request_keyed_rng", None)
+            ekw.pop("snapshot_every_chunks", None)
+            ekw.pop("snapshot_dir", None)
+        self.engine = ServingEngine(dec, replica_tag=self.name, **ekw)
+
+        # control plane: RPC stream + heartbeat over the SAME store
+        self.agent = RpcAgent(self.name, self.rank,
+                              int(cfg["world_size"]),
+                              host=str(cfg["master_host"]),
+                              port=int(cfg["master_port"]),
+                              is_master=False, resume=resume)
+        self.elastic = ElasticManager(
+            self.agent.store, node_id=self.name,
+            np_range=f"1:{int(cfg['world_size'])}",
+            heartbeat_s=float(cfg.get("heartbeat_s", 0.5)),
+            ttl_s=float(cfg.get("ttl_s", 3.0))).start()
+
+        # the worker's own pull telemetry: /metrics + /statusz, every
+        # sample line labelled with the worker's name so the frontend
+        # can concatenate N workers into one fleet exposition verbatim
+        self.exporter = ObsExporter(port=int(cfg.get("obs_port", 0)))
+        self.exporter.add_engine(self.engine, name=self.name,
+                                 labels={"worker": self.name})
+        self.exporter.add_status_provider(
+            "worker", lambda: {"name": self.name, "role": self.role,
+                               "rank": self.rank, "pid": os.getpid()})
+        self.obs_port = self.exporter.start()
+
+        # registration: the launcher's readiness barrier
+        self.agent.store.set(
+            f"cluster/worker/{self.rank}",
+            json.dumps({"name": self.name, "role": self.role,
+                        "rank": self.rank, "pid": os.getpid(),
+                        "obs_port": self.obs_port,
+                        "resumed": bool(resume)}).encode())
+
+    # -- op dispatch -------------------------------------------------------
+    def handle(self, name: str, *args, **kwargs):
+        fn = getattr(self, f"op_{name}", None)
+        if fn is None:
+            raise ValueError(f"worker {self.name}: unknown op {name!r}")
+        return fn(*args, **kwargs)
+
+    def op_ping(self):
+        return {"name": self.name, "role": self.role, "pid": os.getpid()}
+
+    def op_submit(self, prompt, **kwargs) -> int:
+        return self.engine.submit(np.asarray(prompt), **kwargs)
+
+    def op_step(self) -> Dict[str, Any]:
+        """One engine iteration. Ships (a) the finished outcomes —
+        tokens ride as a plain array + the resilience dict, re-wrapped
+        frontend-side (a GenerateResult's attribute does not survive
+        pickle) — and (b) every occupied slot's tokens-so-far, the
+        frontend's replay ledger for THIS worker's next crash."""
+        finished = []
+        for erid, res in self.engine.step():
+            if isinstance(res, BaseException):
+                finished.append((int(erid), "error", res, None))
+            else:
+                finished.append((int(erid), "tokens", np.asarray(res),
+                                 getattr(res, "resilience", None)))
+        inflight = {int(req.id): np.asarray(toks)
+                    for req, toks, _ in self.engine.export_inflight()}
+        sch = self.engine.scheduler
+        return {"finished": finished, "inflight": inflight,
+                "queued": len(sch),
+                "occupied": len(sch.slots.occupied())}
+
+    def op_result(self, erid: int):
+        res = self.engine.result(int(erid))
+        if res is None or isinstance(res, BaseException):
+            return res
+        return (np.asarray(res), getattr(res, "resilience", None))
+
+    def op_known(self):
+        """Engine request ids THIS incarnation can still account for
+        (finished results + in-flight slots + queue) — the frontend's
+        restart-recovery reconciliation set. A tracked id absent here
+        was accepted after the snapshot this incarnation restored from:
+        the frontend replays it from its own ledger instead."""
+        ids = {int(k) for k in self.engine._results}
+        for _, slot in self.engine.scheduler.slots.occupied():
+            ids.add(int(slot.request.id))
+        for req in self.engine.scheduler.queued():
+            ids.add(int(req.id))
+        return ids
+
+    def op_prefill(self, prompt) -> Dict[str, Any]:
+        return self.engine.prefill_extract(np.asarray(prompt))
+
+    def op_load_slab(self, payload: Dict[str, Any]) -> bool:
+        self.engine.load_prefix_slab(payload)
+        return True
+
+    def op_snapshot(self, path: str) -> str:
+        return self.engine.snapshot(path)
+
+    def op_restore(self, path: str) -> Dict[str, int]:
+        return self.engine.restore(path)
+
+    def op_metrics(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "role": self.role,
+            "prefill_dispatches": self.engine.prefill_dispatches,
+            "chunk_dispatches": self.engine.chunk_dispatches,
+            "step_dispatches": self.engine.step_dispatches,
+            "engine": self.engine.metrics(),
+        }
+
+    def op_status(self) -> Dict[str, Any]:
+        return {"name": self.name, "role": self.role, "rank": self.rank,
+                "pid": os.getpid(), "obs_port": self.obs_port,
+                "engine": self.engine.status()}
+
+    def op_stall(self, seconds: float) -> bool:
+        # drill hook: RpcAgent serves SERIALLY, so this op stalls every
+        # later op — the frontend sees its futures time out, exactly the
+        # dead-socket signal a hung worker produces
+        time.sleep(float(seconds))
+        return True
+
+    def op_shutdown(self) -> bool:
+        self._stop.set()
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> None:
+        """Block until shutdown; the RPC server + heartbeat threads do
+        the work."""
+        while not self._stop.wait(0.2):
+            pass
+        self.elastic.stop()
+        self.exporter.stop()
+        self.agent.shutdown()
+
+
+def main(argv=None) -> int:
+    global _HOST
+    raw = os.environ.get("PADDLE_TPU_CLUSTER_CFG", "")
+    if not raw:
+        print("PADDLE_TPU_CLUSTER_CFG is not set (the launcher passes "
+              "the worker config JSON through it)", file=sys.stderr)
+        return 2
+    cfg = json.loads(raw)
+    resume = bool(cfg.get("resume"))
+    # SIGTERM = graceful launcher shutdown (SIGKILL is the crash drill)
+    host = WorkerHost(cfg, resume=resume)
+    _HOST = host
+    # under `python -m` this module runs as __main__ while the RPC
+    # stream unpickles worker_op from the CANONICAL import — pin the
+    # singleton there too, or every op sees an uninitialized host
+    import paddle_tpu.serving.cluster.worker as _canonical
+    _canonical._HOST = host
+    signal.signal(signal.SIGTERM, lambda *a: host._stop.set())
+    host.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -422,6 +422,24 @@ class _BundleBackend:
         return self._step_file is not None
 
 
+def derive_row_key(seed: int, request_id: int, tokens_emitted: int):
+    """The request-keyed row RNG stream (``request_keyed_rng=True``):
+    start from ``fold_in(PRNGKey(seed), request_id)`` and advance the
+    key once per already-emitted token with the SAME rule the chunked
+    scan body uses (``next = split(key)[0]``, the sampling sub being
+    ``split(key)[1]``). An admission that replays ``tokens_emitted``
+    teacher-forced tokens therefore resumes the exact key the
+    undisturbed row would hold — sampled requeue/replay on a different
+    engine or worker draws the identical continuation."""
+    import jax.random as jrandom
+    key = jrandom.split(
+        jrandom.fold_in(jrandom.PRNGKey(int(seed)), int(request_id)),
+        1)[0]
+    for _ in range(int(tokens_emitted)):
+        key = jrandom.split(key)[0]
+    return key
+
+
 def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p,
                   mesh=None, quant=None):
     from paddle_tpu.inference.bundle import AotPredictor
@@ -479,7 +497,8 @@ class ServingEngine:
                  = None, cache_aware_admission: Optional[bool] = None,
                  snapshot_dir: Optional[str] = None,
                  snapshot_every_chunks: int = 0,
-                 replica_tag: Optional[str] = None):
+                 replica_tag: Optional[str] = None,
+                 request_keyed_rng: bool = False):
         """``prefix_cache``: ``None`` reads the
         ``FLAGS_serving_prefix_cache_bytes`` /
         ``PADDLE_TPU_PREFIX_CACHE_BYTES`` budget (0 = disabled, the
@@ -508,7 +527,15 @@ class ServingEngine:
         carry snapshot (:meth:`snapshot`) into ``snapshot_dir`` every N
         chunk dispatches (0 = never; the default) — the crash-recovery
         cadence. ``replica_tag``: names this engine as one replica of a
-        router's ``ReplicaSet`` and arms the per-replica fault sites."""
+        router's ``ReplicaSet`` and arms the per-replica fault sites.
+        ``request_keyed_rng``: derive each admitted row's RNG stream
+        from ``(seed, request id, tokens already emitted)`` instead of
+        the seed alone — a sampled request REQUEUED onto another
+        engine/worker with its generated tokens replayed resumes the
+        identical stream, so non-greedy requeue replay is bit-exact
+        too. Off by default: the classic seed-only rule keeps
+        engine-sampled outputs bit-exact with a solo
+        ``generate(do_sample=True)`` of the same seed."""
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
@@ -526,6 +553,7 @@ class ServingEngine:
             prompt_buckets=prompt_buckets or self._b.prompt_buckets,
             dp_size=dp)
         self._admit_fn = _make_admit_fn(srd, self._b.head_major)
+        self.request_keyed_rng = bool(request_keyed_rng)
         self.state = self._b.new_state()
         self._next_id = 0
         self._results: Dict[int, Any] = {}
@@ -730,7 +758,9 @@ class ServingEngine:
                priority: int = 0, latency_class: str = "default",
                slo_ttft_s: Optional[float] = None,
                slo_latency_s: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               rng_request_id: Optional[int] = None,
+               rng_tokens_emitted: int = 0) -> int:
         """Queue one request; returns its id (results key).
         ``latency_class`` + optional per-request SLO targets feed the
         per-class TTFT/latency violation counters. ``deadline_s`` is a
@@ -739,7 +769,11 @@ class ServingEngine:
         :class:`DeadlineExceededError` (``serving.shed.deadline`` /
         ``serving.shed.backpressure``) — the request never costs a
         prefill; a request that expires later is shed at admission or
-        frozen partial between chunks."""
+        frozen partial between chunks. ``rng_request_id`` /
+        ``rng_tokens_emitted`` feed the ``request_keyed_rng`` stream
+        derivation (a router passes its stable request id and, on a
+        replay, how many generated tokens the prompt already carries);
+        ignored under the default seed-only rule."""
         from paddle_tpu.inference.generate import _normalize_eos
         from paddle_tpu.runtime.resilience import DeadlineExceededError
         prompt = np.asarray(prompt)
@@ -792,7 +826,10 @@ class ServingEngine:
             priority=int(priority), submit_time=time.monotonic(),
             latency_class=str(latency_class),
             slo_ttft_s=slo_ttft_s, slo_latency_s=slo_latency_s,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s,
+            rng_request_id=(None if rng_request_id is None
+                            else int(rng_request_id)),
+            rng_tokens_emitted=int(rng_tokens_emitted))
         if self.scheduler.cache_aware:
             # the cache-aware ordering's grouping key: the prompt's
             # FIRST block-boundary digest (the shortest ladder entry) —
@@ -1026,6 +1063,8 @@ class ServingEngine:
                 "deadline_remaining_s": (
                     None if req.deadline_at is None
                     else req.deadline_at - now),
+                "rng_request_id": req.rng_request_id,
+                "rng_tokens_emitted": req.rng_tokens_emitted,
             }
 
         slots_meta = []
@@ -1081,9 +1120,13 @@ class ServingEngine:
         (``QuantMismatchError``) and mesh topology
         (``MeshMismatchError``), then rebuilds the carry on device —
         under the backend's NamedShardings when meshed — and the
-        slot/queue bookkeeping. Greedy continuation is bit-exact with
-        the run the snapshot interrupted. Returns
-        ``{"in_flight": n, "queued": m}``."""
+        slot/queue bookkeeping. A snapshot taken with FEWER slots than
+        this engine row-remaps: its rows land in ``[0:snap_slots]`` and
+        the remaining rows stay free (a survivor absorbing a smaller
+        dead replica's carry); a larger snapshot is refused. Greedy
+        continuation is bit-exact with the run the snapshot
+        interrupted. Returns ``{"in_flight": n, "queued": m,
+        "remapped_rows": r}`` (``r`` = 0 on an exact-shape restore)."""
         import jax
         import jax.numpy as jnp
 
@@ -1119,11 +1162,13 @@ class ServingEngine:
                 f"manifest {want[:16]}… — refusing to resume from a "
                 f"torn/corrupt snapshot")
         meta = manifest["meta"]
-        if int(meta["num_slots"]) != self.num_slots:
+        snap_slots = int(meta["num_slots"])
+        if snap_slots > self.num_slots:
             raise ValueError(
                 f"snapshot was taken with num_slots="
-                f"{meta['num_slots']}, this engine has "
-                f"{self.num_slots}; the carry rows must map 1:1")
+                f"{meta['num_slots']}, this engine has only "
+                f"{self.num_slots}; a snapshot restores 1:1 or INTO a "
+                f"larger batch (row-remapping), never a smaller one")
         if meta.get("quant") != self._b.quant:
             from paddle_tpu.quantization.kv_cache import \
                 QuantMismatchError
@@ -1150,11 +1195,31 @@ class ServingEngine:
         leaves = []
         for i, (tl, m) in enumerate(zip(tleaves, lm)):
             arr = _np_restore(npz[f"leaf_{i}"], m["dtype"])
-            if tuple(arr.shape) != tuple(tl.shape):
+            tshape = tuple(tl.shape)
+            if tuple(arr.shape) == tshape:
+                leaves.append(jnp.asarray(arr))
+                continue
+            # row-remapping restore (snap_slots < num_slots): the ONLY
+            # tolerated shape delta is the batch axis shrinking from
+            # this engine's num_slots to the snapshot's — the smaller
+            # snapshot's rows scatter into [0:snap_slots] and the tail
+            # rows keep the fresh template's free-row state (a survivor
+            # absorbing a smaller dead replica's carry)
+            diff = ([ax for ax, (a, b) in
+                     enumerate(zip(arr.shape, tshape)) if a != b]
+                    if arr.ndim == tl.ndim else [])
+            if (snap_slots == self.num_slots or len(diff) != 1
+                    or arr.shape[diff[0]] != snap_slots
+                    or tshape[diff[0]] != self.num_slots):
                 raise CorruptCheckpointError(
                     f"snapshot leaf {i} has shape {arr.shape}, backend "
-                    f"expects {tuple(tl.shape)}")
-            leaves.append(jnp.asarray(arr))
+                    f"expects {tshape} (snapshot rows {snap_slots}, "
+                    f"engine rows {self.num_slots})")
+            full = np.asarray(jax.device_get(tl)).copy()
+            idx = [slice(None)] * full.ndim
+            idx[diff[0]] = slice(0, snap_slots)
+            full[tuple(idx)] = arr
+            leaves.append(jnp.asarray(full))
         logits, kc, vc, pos, keys, done, eos, temp = \
             jax.tree_util.tree_unflatten(treedef, leaves)
         st = dataclasses.replace(
@@ -1182,7 +1247,9 @@ class ServingEngine:
                          in_flight=len(meta["slots"]),
                          queued=len(meta["queue"]))
         return {"in_flight": len(meta["slots"]),
-                "queued": len(meta["queue"])}
+                "queued": len(meta["queue"]),
+                "remapped_rows": (snap_slots
+                                  if snap_slots != self.num_slots else 0)}
 
     @staticmethod
     def _req_from_meta(m: dict, prompt: np.ndarray, now: float) -> Request:
@@ -1200,7 +1267,9 @@ class ServingEngine:
             # already-negative remainder is swept typed on the first
             # post-restore step (no zombie work)
             deadline_s=rem,
-            deadline_at=None if rem is None else now + rem)
+            deadline_at=None if rem is None else now + rem,
+            rng_request_id=m.get("rng_request_id"),
+            rng_tokens_emitted=int(m.get("rng_tokens_emitted") or 0))
 
     # -- replica plumbing (serving/router.py reads these) ------------------
     def export_inflight(self) -> List[Tuple[Request, np.ndarray, int]]:
@@ -1242,6 +1311,78 @@ class ServingEngine:
                 "reset_state with occupied slots would orphan in-flight "
                 "requests; export/clear them first")
         self.state = self._b.new_state()
+
+    # -- disaggregated prefill/decode (serving/cluster) --------------------
+    def prefill_extract(self, prompt) -> Dict[str, Any]:
+        """The PREFILL-pool side of disaggregated serving: run ONE
+        admission prefill for ``prompt`` outside the slot table and
+        return its row state — the bucketed KV rows plus the resume
+        logits — as a serializable prefix-slab payload (host numpy
+        pytrees, dtype-tagged by the backend's quant recipe). A decode
+        engine admits the shipped payload via :meth:`load_prefix_slab`;
+        the prompt then resolves as a FULL prefix hit whose admission is
+        the one-row scatter alone, bit-exact with a local cold
+        admission (the slab rows ARE the cold prefill's row state).
+        Counts on this engine's ``prefill_dispatches`` ledger — the
+        per-pool accounting the cluster bench asserts on."""
+        import jax
+
+        prompt = np.asarray(prompt)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1:
+            raise ValueError(
+                f"prefill_extract takes one (S,) prompt, got shape "
+                f"{prompt.shape}")
+        S = len(prompt)
+        bucket = self.scheduler.bucket(S)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :S] = prompt
+        logitsN, kcN, vcN = self._b.admit_prefill(
+            ids, np.asarray([S], np.int32), np.asarray([0], np.int32))
+        self._c_prefill.inc()
+        ops = self._slab_ops
+        if ops is None:
+            from paddle_tpu.serving.prefix_cache import SlabOps
+            ops = self._slab_ops = SlabOps(self._b.sharding,
+                                           self._b.head_major)
+        skc, svc, slg = ops.extract(kcN, vcN, logitsN, 0, bucket)
+
+        def host(t):
+            return jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a)), t)
+
+        return {"prompt": prompt, "bucket": int(bucket),
+                "kc": host(skc), "vc": host(svc),
+                "logits": host(slg), "quant": self._b.quant}
+
+    def load_prefix_slab(self, payload: Dict[str, Any]):
+        """The DECODE-pool side: admit a :meth:`prefill_extract` payload
+        into this engine's prefix cache. The next ``submit`` of the same
+        prompt admits as a full hit — zero prefill dispatches on the
+        decode pool. A quant-recipe mismatch between the pools is
+        refused typed (``QuantMismatchError``): int8 KV rows scattered
+        into an fp32 carry would decode garbage silently."""
+        import jax
+        import jax.numpy as jnp
+        if self.prefix_cache is None:
+            raise ValueError(
+                "load_prefix_slab needs the prefix cache enabled: the "
+                "shipped slab admits through the full-hit path")
+        if payload.get("quant") != self._b.quant:
+            from paddle_tpu.quantization.kv_cache import QuantMismatchError
+            raise QuantMismatchError(
+                f"shipped slab carries quant recipe "
+                f"{payload.get('quant') or 'none'!r} but this engine's "
+                f"backend serves {self._b.quant or 'none'!r}")
+
+        def dev(t):
+            return jax.tree_util.tree_map(jnp.asarray, t)
+
+        return self.prefix_cache.insert(
+            np.asarray(payload["prompt"]), dev(payload["kc"]),
+            dev(payload["vc"]), dev(payload["logits"]),
+            int(payload["bucket"]))
 
     # -- internals ---------------------------------------------------------
     def _admit_all(self, admitted, now: float) -> None:
@@ -1348,10 +1489,20 @@ class ServingEngine:
         import jax.numpy as jnp
         import jax.random as jrandom
 
-        # the SAME row-key rule as generate(chunk_size=) at B=1: the
-        # request's stream is keyed by its seed alone
-        key1 = jnp.asarray(jrandom.split(jrandom.PRNGKey(req.seed), 1)[0],
-                           jnp.uint32)
+        if self.request_keyed_rng:
+            # request-keyed stream: a requeued row that replays T
+            # teacher-forced tokens resumes at the key the undisturbed
+            # row would hold after T advances (sampled replay parity)
+            rng_id = (req.rng_request_id if req.rng_request_id is not None
+                      else req.id)
+            key1 = jnp.asarray(
+                derive_row_key(req.seed, rng_id, req.rng_tokens_emitted),
+                jnp.uint32)
+        else:
+            # the SAME row-key rule as generate(chunk_size=) at B=1: the
+            # request's stream is keyed by its seed alone
+            key1 = jnp.asarray(
+                jrandom.split(jrandom.PRNGKey(req.seed), 1)[0], jnp.uint32)
         st = self.state
         (logits, kc, vc, pos, keys, done, eos, temp) = self._admit_fn(
             st.logits, st.kc, st.vc, st.pos, st.keys, st.done, st.eos,

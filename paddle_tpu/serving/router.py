@@ -246,19 +246,23 @@ class Router:
                 f"no routable replica (excluded={sorted(excluded)}, "
                 f"states={[r.state for r in self.replicas]})")
         last_shed: Optional[BaseException] = None
+        # the router id is the request's STABLE identity across
+        # requeues: allocated up front so the engines' request-keyed RNG
+        # streams (request_keyed_rng) fold in the same id on every
+        # replica the request ever lands on
+        rid = self._next_id
         for rep in cand:
             try:
                 erid = rep.engine.submit(
                     prompt, max_new_tokens, eos_token_id=eos_token_id,
                     temperature=temperature, seed=seed,
                     priority=priority, latency_class=latency_class,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, rng_request_id=rid)
             except DeadlineExceededError as e:
                 # this replica's queue blows the budget — try the next
                 # candidate before giving up (per-replica load shedding)
                 last_shed = e
                 continue
-            rid = self._next_id
             self._next_id += 1
             now = time.monotonic()
             self._tracked[rid] = _Tracked(
@@ -498,7 +502,8 @@ class Router:
                 prompt, remaining, eos_token_id=t.eos_token_id,
                 temperature=t.temperature, seed=t.seed,
                 priority=t.priority, latency_class=t.latency_class,
-                deadline_s=rem_deadline)
+                deadline_s=rem_deadline, rng_request_id=rid,
+                rng_tokens_emitted=t.replayed_tokens)
         except DeadlineExceededError as e:
             self._c_shed_requeue.inc()
             self._errors[rid] = e

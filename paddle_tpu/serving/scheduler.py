@@ -86,6 +86,13 @@ class Request:
     # same-priority requests sharing it are admitted together by the
     # cache-aware ordering so their admissions reuse one slab
     prefix_group: Optional[str] = None
+    # request-keyed RNG stream inputs (engine ``request_keyed_rng``):
+    # the STABLE id the row key folds in (a router's request id survives
+    # requeues; None = this engine's own id) and how many generated
+    # tokens the prompt already replays — the admission key advances
+    # that many steps so a sampled replay resumes the identical stream
+    rng_request_id: Optional[int] = None
+    rng_tokens_emitted: int = 0
 
 
 @dataclasses.dataclass

@@ -377,3 +377,78 @@ def test_int8wk_mesh_refused_typed(model):
         LlamaDecoder(model, max_len=32, quant="int8wk", mesh=_mesh())
     # fatal for the resilience classifier: never a retry/degrade
     assert classify_error(ei.value) != "transient"
+
+
+# -- speculative decode under quantization -----------------------------------
+
+def test_spec_draft_quant_int8w_greedy_invisible(model, prompt):
+    """``draft_quant='int8w'`` quantizes ONLY the draft: the verify pass
+    runs the fp32 target exactly, so greedy speculative output == the
+    plain fused greedy decode — a worse draft can only shorten the
+    acceptance length, never change a token."""
+    draft = _model(21, dict(GQA, num_hidden_layers=1))
+    dec = LlamaDecoder(model, max_len=40)
+    plain = dec.generate(prompt, max_new_tokens=8)
+    d0 = dec.dispatch_count
+    fused = dec.generate(prompt, max_new_tokens=8, draft_model=draft,
+                         num_speculative_tokens=2, draft_quant="int8w")
+    assert dec.dispatch_count - d0 == 3, \
+        "expected 2 prefills + ONE decode dispatch"
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(plain))
+    stats = dec.last_spec_stats
+    assert stats["num_speculative_tokens"] == 2
+    assert 0.0 <= stats["acceptance_len_mean"] <= 2.0
+
+
+def test_spec_draft_quant_sampled_matches_unquantized_target_stream(
+        model, prompt):
+    """Sampled speculative decode with a quantized draft still follows
+    the TARGET's keyed sampling stream: rejection sampling corrects the
+    draft's proposal distribution, and the quantized draft only shifts
+    WHICH tokens get proposed. The output must stay a valid sample of
+    the target — here pinned by seed against the same-seed plain run
+    shape/vocab contract."""
+    draft = _model(22, dict(GQA, num_hidden_layers=1))
+    dec = LlamaDecoder(model, max_len=40)
+    out = dec.generate(prompt, max_new_tokens=8, draft_model=draft,
+                       num_speculative_tokens=2, draft_quant="int8w",
+                       do_sample=True, temperature=0.8, top_k=8, seed=7)
+    arr = np.asarray(out)
+    assert arr.shape == (prompt.shape[0], prompt.shape[1] + 8)
+    assert arr.max() < 64 and arr.min() >= 0
+    # determinism under a fixed seed: the quantized-draft stream replays
+    again = dec.generate(prompt, max_new_tokens=8, draft_model=draft,
+                         num_speculative_tokens=2, draft_quant="int8w",
+                         do_sample=True, temperature=0.8, top_k=8,
+                         seed=7)
+    np.testing.assert_array_equal(arr, np.asarray(again))
+
+
+def test_spec_skip_draft_under_int8w_target(model, prompt):
+    """The layer-skip draft under a QUANTIZED target: 'skip:N' reuses
+    the target's int8 params, so the whole speculative stack runs
+    quantized — greedy speculation stays invisible vs the plain int8w
+    decode."""
+    qdec = LlamaDecoder(model, max_len=40, quant="int8w")
+    plain = qdec.generate(prompt, max_new_tokens=8)
+    d0 = qdec.dispatch_count
+    fused = qdec.generate(prompt, max_new_tokens=8,
+                          draft_model="skip:1",
+                          num_speculative_tokens=2)
+    assert qdec.dispatch_count - d0 == 3
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(plain))
+
+
+def test_spec_draft_quant_refusals(model, prompt):
+    """Typed refusals: draft_quant without a draft, draft_quant over a
+    layer-skip view (quantize the target instead), unknown recipe."""
+    dec = LlamaDecoder(model, max_len=40)
+    draft = _model(23, dict(GQA, num_hidden_layers=1))
+    with pytest.raises(ValueError, match="requires a draft_model"):
+        dec.generate(prompt, max_new_tokens=4, draft_quant="int8w")
+    with pytest.raises(ValueError, match="quantize the target"):
+        dec.generate(prompt, max_new_tokens=4, draft_model="skip:1",
+                     num_speculative_tokens=2, draft_quant="int8w")
+    with pytest.raises(ValueError, match="draft_quant"):
+        dec.generate(prompt, max_new_tokens=4, draft_model=draft,
+                     num_speculative_tokens=2, draft_quant="int4")

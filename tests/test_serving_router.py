@@ -215,9 +215,10 @@ def test_snapshot_typed_refusals(dec, model, tmp_path):
     eng.step()
     sdir = str(tmp_path / "snap")
     eng.snapshot(sdir)
-    # slot-count mismatch: the carry rows must map 1:1
+    # a LARGER snapshot refuses (rows cannot shrink); a smaller one
+    # row-remaps into the free rows instead (covered below)
     with pytest.raises(ValueError, match="num_slots"):
-        ServingEngine(dec, num_slots=4, chunk_size=4).restore(sdir)
+        ServingEngine(dec, num_slots=1, chunk_size=4).restore(sdir)
     # quant-recipe mismatch, typed both ways
     qdec = LlamaDecoder(model, max_len=64, quant="int8wk")
     with pytest.raises(QuantMismatchError, match="recipe"):
@@ -237,6 +238,82 @@ def test_snapshot_typed_refusals(dec, model, tmp_path):
     with pytest.raises(CorruptCheckpointError, match="manifest"):
         ServingEngine(dec, num_slots=2,
                       chunk_size=4).restore(str(tmp_path / "nope"))
+
+
+def test_snapshot_restore_row_remap_into_larger(dec, tmp_path):
+    """A snapshot taken with FEWER slots restores INTO a larger batch:
+    the survivor absorbs a smaller dead replica's carry — its rows land
+    in ``[0:snap_slots]``, the rest stay free for new admissions, and
+    every resumed request continues bit-exactly."""
+    reqs, solo = _workload(dec, n=4, seed=11)
+    eng = ServingEngine(dec, num_slots=2, chunk_size=4)
+    rids = [eng.submit(p, b) for p, b in reqs]
+    got = {}
+    for _ in range(2):
+        for rid, res in eng.step():
+            got[rid] = res
+    sdir = str(tmp_path / "snap_grow")
+    eng.snapshot(sdir)
+    big = ServingEngine(dec, num_slots=4, chunk_size=4)
+    info = big.restore(sdir)
+    assert info["in_flight"] >= 1, info
+    assert info["remapped_rows"] >= 1, info
+    # the larger engine still has free rows to admit NEW work into
+    extra_p = np.arange(5) % 64
+    extra_ref = np.asarray(dec.generate(extra_p[None], 6))
+    extra = big.submit(extra_p, 6)
+    got.update(big.drain())
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(
+            np.asarray(got[rid]), solo[i],
+            err_msg=f"request {i} diverged after the row-remap restore")
+    np.testing.assert_array_equal(np.asarray(got[extra]), extra_ref)
+
+
+def test_request_keyed_rng_sampled_requeue_parity(dec):
+    """Satellite: bit-exact SAMPLED requeue. With ``request_keyed_rng``
+    every row's stream is derived from (seed, router request id, tokens
+    emitted), so a replay of ``prompt + tokens_so_far`` on any engine
+    resumes the IDENTICAL stream — the cross-worker requeue contract."""
+    ekw = dict(num_slots=2, chunk_size=4, do_sample=True, top_k=8,
+               request_keyed_rng=True)
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, 64, (6,))
+    budget, rid_key, seed, temp = 10, 42, 5, 0.8
+
+    # the undisturbed run
+    eng_a = ServingEngine(dec, **ekw)
+    ra = eng_a.submit(prompt, budget, temperature=temp, seed=seed,
+                      rng_request_id=rid_key)
+    ref = np.asarray(eng_a.drain()[ra])
+
+    # the interrupted run: a few chunks on engine B, then the frontend
+    # replays prompt+tokens onto engine C with the emitted count
+    eng_b = ServingEngine(dec, **ekw)
+    rb = eng_b.submit(prompt, budget, temperature=temp, seed=seed,
+                      rng_request_id=rid_key)
+    for _ in range(1):
+        eng_b.step()
+    emitted = {int(r.id): np.asarray(t)
+               for r, t, _ in eng_b.export_inflight()}[rb]
+    assert emitted.size >= 1, "interruption caught no tokens mid-flight"
+    grown = np.concatenate([prompt, emitted.astype(prompt.dtype)])
+    eng_c = ServingEngine(dec, **ekw)
+    rc = eng_c.submit(grown, budget - emitted.size, temperature=temp,
+                      seed=seed, rng_request_id=rid_key,
+                      rng_tokens_emitted=int(emitted.size))
+    out = np.asarray(eng_c.drain()[rc])
+    np.testing.assert_array_equal(out, ref)
+
+    # negative control: losing the emitted-count offset shifts the
+    # stream — the derivation really is (seed, rid, tokens_emitted)
+    eng_d = ServingEngine(dec, **ekw)
+    rd = eng_d.submit(grown, budget - emitted.size, temperature=temp,
+                      seed=seed, rng_request_id=rid_key,
+                      rng_tokens_emitted=0)
+    shifted = np.asarray(eng_d.drain()[rd])
+    assert not np.array_equal(shifted, ref), \
+        "stream ignored rng_tokens_emitted"
 
 
 @pytest.mark.faults

@@ -31,6 +31,16 @@ Classes swept (decode + checkpoint + bundle + elastic + serving paths):
                         crash) -> restore refuses typed
                         CorruptCheckpointError; a clean re-snapshot
                         restores and continues generation bit-exactly
+  worker_process_kill   a cluster decode worker PROCESS is SIGKILLed
+                        mid-run (REAL OS kill, not injection) -> the
+                        frontend heartbeat-TTL-detects the death and
+                        replays its accepted work onto the survivor
+                        bit-exactly — zero lost requests
+  frontend_rpc_timeout  a cluster worker HANGS (stalled op on its
+                        serial RPC serve thread; heartbeats keep
+                        flowing) -> the frontend's step future times
+                        out, the breaker opens as a dead socket, the
+                        hung worker's work requeues bit-exactly
 
 Prints one human line per class to stderr and ONE parseable JSON line
 to stdout (the bench.py last-line contract); exit code 0 iff all pass.
@@ -304,6 +314,102 @@ def drill_snapshot_torn_write(tmp):
     return f"typed refusal ({typed}…), clean re-snapshot bit-exact"
 
 
+def _cluster_workload(n=5, seed=8):
+    """A tiny model for the multi-process drills + its undisturbed
+    in-process solo-greedy references (the SAME weights every worker
+    process rebuilds from the shipped npz)."""
+    import numpy as np
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    dec = LlamaDecoder(model, max_len=48)
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, 64, (6,)), int(rng.integers(6, 12)))
+            for _ in range(n)]
+    solo = [np.asarray(dec.generate(p[None], n_)) for p, n_ in reqs]
+    return model, reqs, solo
+
+
+def drill_worker_process_kill(tmp):
+    import numpy as np
+    from paddle_tpu.serving import launch_cluster
+    model, reqs, solo = _cluster_workload(seed=8)
+    with launch_cluster(model, os.path.join(tmp, "kill_cluster"),
+                        prefill=0, decode=2, max_len=48,
+                        engine_kw={"num_slots": 2, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=2.0,
+                        heartbeat_miss_threshold=1,
+                        rpc_timeout_s=60.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, n) for p, n in reqs]
+        outs = {}
+        for _ in range(2):                   # let work start flowing
+            for rid, res in router.step():
+                outs[rid] = res
+        pid = cl.kill("decode0")             # REAL SIGKILL, no injection
+        # let the TTL lapse so the heartbeat sweep (not a long socket
+        # timeout) is what sees the death
+        time.sleep(2.5)
+        outs.update(router.drain())
+        m = router.metrics()
+    for i, rid in enumerate(rids):
+        out = outs.get(rid)
+        assert out is not None and not isinstance(out, BaseException), \
+            f"request {i} lost to the SIGKILLed worker: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged after the cross-process requeue"
+    assert m["states"]["decode0"] == "dead", m
+    assert m["worker_deaths"] >= 1 and m["requeued"] >= 1, m
+    return (f"SIGKILLed pid {pid} heartbeat-TTL-detected, "
+            f"{m['requeued']} requests replayed, all bit-exact")
+
+
+def drill_frontend_rpc_timeout(tmp):
+    import numpy as np
+    from paddle_tpu.serving import launch_cluster
+    from paddle_tpu.serving.cluster.worker import worker_op
+    model, reqs, solo = _cluster_workload(seed=9)
+    # ttl_s is LONG on purpose: the hung worker's heartbeat thread keeps
+    # beating, so only the dead-socket (RPC timeout) path can catch it
+    with launch_cluster(model, os.path.join(tmp, "hang_cluster"),
+                        prefill=0, decode=2, max_len=48,
+                        engine_kw={"num_slots": 2, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=30.0,
+                        rpc_timeout_s=60.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, n) for p, n in reqs]
+        outs = {}
+        for _ in range(2):                   # compiles land inside the
+            for rid, res in router.step():   # generous warmup timeout
+                outs[rid] = res
+        victim = cl.handle("decode0")
+        # fire-and-forget: the stall occupies the worker's SERIAL serve
+        # thread, so every later op's future just never resolves
+        router.agent.call(victim.rank, worker_op, ("stall", 12.0), {})
+        router.rpc_timeout_s = 5.0
+        outs.update(router.drain())
+        m = router.metrics()
+        dead = next(w for w in router.status()["workers"]
+                    if w["name"] == "decode0")
+        router.rpc_timeout_s = 60.0
+    for i, rid in enumerate(rids):
+        out = outs.get(rid)
+        assert out is not None and not isinstance(out, BaseException), \
+            f"request {i} lost to the hung worker: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged after the hung-worker requeue"
+    assert m["states"]["decode0"] == "dead", m
+    assert m["worker_deaths"] >= 1 and m["requeued"] >= 1, m
+    assert dead["last_error"], "dead-socket strike recorded no error"
+    return (f"hung worker dead-socket-detected "
+            f"({dead['last_error'][:60]}), {m['requeued']} requests "
+            f"requeued, all bit-exact")
+
+
 def main():
     import tempfile
 
@@ -319,6 +425,8 @@ def main():
         ("replica_kill", drill_replica_kill, False),
         ("hung_replica", drill_hung_replica, False),
         ("snapshot_torn_write", drill_snapshot_torn_write, True),
+        ("worker_process_kill", drill_worker_process_kill, True),
+        ("frontend_rpc_timeout", drill_frontend_rpc_timeout, True),
     ]
     results = {}
     ok = True

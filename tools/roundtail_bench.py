@@ -62,6 +62,14 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      snapshot()->restore() round-trip continues bit-exactly on fp32 AND
      int8wk carries
 
+ 14. serve_cluster (`bench.py --serve --cluster prefill:1,decode:2
+     --faults`): the multi-process disaggregated-serving gate — a REAL
+     OS worker-process pool (prefill extraction ships KV slabs to the
+     decode pool) with a REAL SIGKILL of a decode worker mid-run; zero
+     lost accepted requests (bit-exact vs an in-process solo decode or
+     a typed error), the per-worker dispatch split and the per-worker-
+     labelled fleet /metrics are hard-asserted inside the bench
+
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
 """
@@ -126,6 +134,16 @@ STEPS = [
     # ALL hard-asserted inside the bench (rc != 0 on any violation)
     ("serve_replicated", [sys.executable, "bench.py", "--serve",
                           "--replicas", "3", "--faults"], None),
+    # multi-process disaggregated-serving gate: a REAL worker-process
+    # pool (prefill:1,decode:2 — 3 OS processes + the frontend) with a
+    # REAL SIGKILL of a decode worker mid-run — bit-exact parity vs an
+    # in-process solo decode, the prefill/decode dispatch split, the
+    # per-worker-labelled fleet /metrics scrape, and zero lost accepted
+    # requests are ALL hard-asserted inside the bench (rc != 0 on any
+    # violation)
+    ("serve_cluster", [sys.executable, "bench.py", "--serve",
+                       "--cluster", "prefill:1,decode:2", "--faults"],
+     None),
 ]
 
 
