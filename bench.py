@@ -1876,6 +1876,294 @@ def bench_serve_cluster(spec="prefill:1,decode:2", n_requests=None,
     return line
 
 
+def bench_serve_rolling(spec="prefill:1,decode:2", n_requests=None,
+                        slots=None, chunk=None):
+    """``--serve --cluster prefill:1,decode:2 --rolling-restart``: the
+    zero-downtime fleet-operations gate — REAL OS worker processes,
+    live DecodeState migration, a rolling restart of EVERY worker while
+    the fleet keeps serving, and a proactive SUSPECT evacuation.
+
+    Three drills, all hard-asserted in-bench:
+
+    - greedy pass: a delayed-heartbeat fault plan (inherited by the
+      decode1 worker process through the environment) makes its
+      heartbeat go stale mid-run WITHOUT dying — the router must mark
+      it SUSPECT and migrate its in-flight rows to peers BEFORE any
+      TTL fires (``proactive_evacuations >= 1``, ``worker_deaths ==
+      0``); then ``rolling_restart()`` cycles every worker under load.
+      Every accepted request must resolve bit-exact vs an undisturbed
+      in-process solo decode: ZERO lost, zero typed errors.
+    - sampled pass: the same rolling restart over a
+      ``request_keyed_rng`` + ``do_sample`` decode pool — migration
+      ships the live per-row RNG key, so sampled continuations are
+      bit-exact vs an undisturbed solo ServingEngine too.
+    - hot-reload epilogue: new weights are staged versioned, ONE
+      worker is respawned onto them (content-derived version changes),
+      migration between the mixed-version workers is refused typed
+      (``WeightVersionError``), and the reloaded worker serves the NEW
+      parameters bit-exactly."""
+    import os as _os
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.runtime.resilience import WeightVersionError
+    from paddle_tpu.serving import launch_cluster, parse_cluster_spec
+    from paddle_tpu.serving.engine import ServingEngine
+
+    roles = parse_cluster_spec(spec)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    n_req = n_requests or 8
+    slots = slots or 8
+    chunk = chunk or 4
+    prompt_len, len_pool = 8, (6, 10, 14)
+    model = LlamaForCausalLM(cfg)
+    max_len = prompt_len + max(len_pool) + 8
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+    lens = rng.choice(len_pool, n_req)
+    solo_dec = LlamaDecoder(model, max_len=max_len)
+    solo = [np.asarray(solo_dec.generate(prompts[i][None], int(lens[i])))
+            for i in range(n_req)]
+
+    # -- pass A: greedy + proactive SUSPECT + rolling restart ---------------
+    # the stale-heartbeat drill rides the environment into the decode1
+    # worker process: beat normally ~1.2s, then go silent for ~3.6s —
+    # stale past suspect_after_s but far inside the 12s TTL, then resume
+    plan = json.dumps([{"kind": "delay_heartbeat", "node": "decode1",
+                        "after_beats": 4, "skip_beats": 12}])
+    old_plan = _os.environ.get("PADDLE_TPU_FAULT_PLAN")
+    _os.environ["PADDLE_TPU_FAULT_PLAN"] = plan
+    workdir = tempfile.mkdtemp(prefix="bench_rolling_")
+    t0 = time.perf_counter()
+    try:
+        cl = launch_cluster(
+            model, workdir, prefill=roles["prefill"],
+            decode=roles["decode"], unified=roles["unified"],
+            max_len=max_len,
+            engine_kw={"num_slots": slots, "chunk_size": chunk},
+            heartbeat_s=0.3, ttl_s=12.0, suspect_after_s=1.8,
+            rpc_timeout_s=30.0)
+    finally:
+        if old_plan is None:
+            _os.environ.pop("PADDLE_TPU_FAULT_PLAN", None)
+        else:
+            _os.environ["PADDLE_TPU_FAULT_PLAN"] = old_plan
+    with cl:
+        router = cl.router
+        live = [h.name for h in router.workers]
+        assert all(h.weights_version for h in router.workers), \
+            f"workers registered without a weights version: " \
+            f"{[(h.name, h.weights_version) for h in router.workers]}"
+        rids = [router.submit(prompts[i], int(lens[i]))
+                for i in range(n_req)]
+        restart_report, waves = None, 0
+        while router.in_flight():
+            router.step()
+            m = router.metrics()
+            # the rolling restart fires ONCE, mid-run, only after the
+            # proactive drill has been observed — both must land while
+            # requests are genuinely in flight
+            if (restart_report is None
+                    and m["proactive_evacuations"] >= 1
+                    and router.in_flight() >= 2):
+                restart_report = router.rolling_restart()
+            if not router.in_flight() and restart_report is None:
+                # the drill outran the queue: keep the fleet busy with
+                # another wave of the SAME requests (same rng ids are
+                # irrelevant under greedy)
+                waves += 1
+                assert waves <= 30, \
+                    "proactive SUSPECT drill never fired in 30 waves"
+                extra = [router.submit(prompts[i], int(lens[i]))
+                         for i in range(n_req)]
+                rids.extend(extra)
+                solo.extend(solo[:n_req])
+        wall_a = time.perf_counter() - t0
+        m = router.metrics()
+        assert restart_report is not None, \
+            "rolling restart never fired: proactive evacuation was " \
+            f"not observed while requests were in flight ({m})"
+        restarted = [r["name"] for r in restart_report["restarted"]]
+        assert sorted(restarted) == sorted(live), \
+            f"rolling restart skipped workers: {restarted} vs {live}"
+        assert m["proactive_evacuations"] >= 1, m
+        assert m["migrations"] >= 1, m
+        assert m["worker_deaths"] == 0, \
+            f"the proactive drill leaked into a real death: {m}"
+        for i, rid in enumerate(rids):
+            out = router.outcome(rid)
+            assert out is not None and not isinstance(out, BaseException), \
+                f"greedy request {i} lost or errored: {out!r}"
+            assert np.array_equal(np.asarray(out), solo[i]), \
+                f"greedy request {i} diverged across migration/restart"
+
+        # -- hot weight reload epilogue ---------------------------------
+        model2 = LlamaForCausalLM(cfg)  # fresh init = different params
+        staged = cl.stage_weights(model2)
+        d0 = next(h for h in router.workers if h.name == "decode0")
+        d1 = next(h for h in router.workers if h.name == "decode1")
+        v_old = d0.weights_version
+        d0.state = "restarting"
+        router._sync_healthy()
+        try:
+            router._call(d0, "shutdown", timeout=5.0)
+        except Exception:
+            pass
+        info = cl.respawn(d0)
+        d0.pid = int(info["pid"])
+        d0.obs_port = int(info.get("obs_port", d0.obs_port))
+        d0.weights_version = info.get("weights_version")
+        d0.state = "healthy"
+        router._sync_healthy()
+        assert d0.weights_version and d0.weights_version != v_old, \
+            f"hot reload did not change the content version " \
+            f"({v_old} -> {d0.weights_version})"
+        # settle the fleet first: a worker still marked suspect from a
+        # late stale-heartbeat window (first-chunk compile stalls the
+        # worker GIL) recovers on the next idle sweep — migrate's
+        # health validation must not mask the version refusal
+        settle_by = time.monotonic() + 60.0
+        while any(h.state == "suspect" for h in router.workers):
+            assert time.monotonic() < settle_by, \
+                f"fleet never settled: " \
+                f"states={[(h.name, h.state) for h in router.workers]} " \
+                f"ages={[(h.name, router.elastic.beat_age(h.name)) for h in router.workers]} " \
+                f"members={router.elastic.members} " \
+                f"procs={[(r, p.poll()) for r, p in cl.procs.items()]}"
+            router.step()
+            time.sleep(0.2)
+        # mixed-version fleet: migration must refuse typed. Routing
+        # happens at submit and queued requests are migratable, so no
+        # step() runs between submit and the refusal (a step could
+        # flip fleet states mid-check)
+        solo2_dec = LlamaDecoder(model2, max_len=max_len)
+        solo2 = np.asarray(solo2_dec.generate(prompts[0][None],
+                                              int(lens[0])))
+        rid2 = router.submit(prompts[0], int(lens[0]))
+        src = router._handle(router._tracked[rid2].worker)
+        dst = d1 if src.rank == d0.rank else d0
+        try:
+            router.migrate([rid2], src, dst)
+            raise AssertionError(
+                "mixed-version migrate was not refused")
+        except WeightVersionError:
+            pass
+        router.drain(max_steps=500)
+        out2 = router.outcome(rid2)
+        assert out2 is not None and not isinstance(out2, BaseException), \
+            f"hot-reload request lost: {out2!r}"
+        if src.rank == d0.rank:
+            # served by the reloaded worker: the NEW parameters decode.
+            # The prefill pool still runs v1 here, so the router's
+            # cross-version slab guard must have refused disaggregation
+            # (local prefill fallback) — otherwise v1 prefill KV would
+            # silently corrupt a v2 decode
+            assert np.array_equal(np.asarray(out2), solo2), \
+                "hot-reloaded worker did not serve the staged weights"
+            if any(h.role == "prefill" for h in router.workers):
+                assert (router.metrics()["disaggregation_fallbacks"]
+                        >= 1), \
+                    "cross-version slab was shipped without fallback"
+        reload_info = {"staged": _os.path.basename(staged),
+                       "version_old": v_old,
+                       "version_new": d0.weights_version,
+                       "served_by_reloaded": src.rank == d0.rank}
+        m_a = router.metrics()
+
+    # -- pass B: request-keyed sampled bit-exactness ------------------------
+    n_s = max(4, n_req // 2)
+    temps = [0.7 + 0.1 * (i % 3) for i in range(n_s)]
+    ref_dec = LlamaDecoder(model, max_len=max_len)
+    ref_eng = ServingEngine(ref_dec, num_slots=slots, chunk_size=chunk,
+                            do_sample=True, request_keyed_rng=True)
+    ref_ids = [ref_eng.submit(prompts[i], int(lens[i]),
+                              temperature=temps[i], seed=7,
+                              rng_request_id=i)
+               for i in range(n_s)]
+    ref_out = {}
+    while len(ref_out) < n_s:
+        for rid, res in ref_eng.step():
+            ref_out[rid] = np.asarray(res)
+    sampled_ref = [ref_out[r] for r in ref_ids]
+
+    t1 = time.perf_counter()
+    workdir_b = tempfile.mkdtemp(prefix="bench_rolling_s_")
+    with launch_cluster(
+            model, workdir_b, prefill=0, decode=2, max_len=max_len,
+            engine_kw={"num_slots": slots, "chunk_size": chunk,
+                       "do_sample": True},
+            request_keyed_rng=True, heartbeat_s=0.3, ttl_s=12.0,
+            rpc_timeout_s=30.0) as cl2:
+        router2 = cl2.router
+        rids_s = [router2.submit(prompts[i], int(lens[i]),
+                                 temperature=temps[i], seed=7)
+                  for i in range(n_s)]
+        restarted_s = None
+        steps = 0
+        while router2.in_flight():
+            router2.step()
+            steps += 1
+            if restarted_s is None and steps >= 2 \
+                    and router2.in_flight() >= 2:
+                restarted_s = router2.rolling_restart()
+        wall_b = time.perf_counter() - t1
+        m_b = router2.metrics()
+        assert restarted_s is not None and \
+            len(restarted_s["restarted"]) == 2, restarted_s
+        assert m_b["migrations"] >= 1, \
+            f"sampled rolling restart moved nothing live: {m_b}"
+        assert m_b["worker_deaths"] == 0, m_b
+        for i, rid in enumerate(rids_s):
+            out = router2.outcome(rid)
+            assert out is not None and not isinstance(out, BaseException), \
+                f"sampled request {i} lost or errored: {out!r}"
+            assert np.array_equal(np.asarray(out), sampled_ref[i]), \
+                f"sampled request {i} diverged across migration/restart " \
+                f"(the live RNG key did not ride the payload)"
+
+    useful = int(lens.sum())
+    print(f"serve-rolling: spec {spec} — greedy: {len(rids)} requests "
+          f"bit-exact through {m_a['rolling_restarts']} rolling "
+          f"restarts + {m_a['proactive_evacuations']} proactive "
+          f"evacuations ({m_a['migrations']} rows migrated, 0 deaths, "
+          f"{wall_a:.1f}s); sampled: {n_s} requests bit-exact through "
+          f"{m_b['rolling_restarts']} restarts ({m_b['migrations']} "
+          f"migrated, {wall_b:.1f}s); hot reload {reload_info['version_old']}"
+          f" -> {reload_info['version_new']}, mixed-version migrate "
+          f"refused typed", file=sys.stderr)
+    line = _emit("serving_rolling_restart_workers",
+                 float(m_a["rolling_restarts"]), "workers")
+    line["serve_rolling"] = {
+        "spec": spec,
+        "greedy": {
+            "requests": len(rids), "bit_exact": len(rids), "lost": 0,
+            "rolling_restarts": m_a["rolling_restarts"],
+            "proactive_evacuations": m_a["proactive_evacuations"],
+            "evacuations": m_a["evacuations"],
+            "migrations": m_a["migrations"],
+            "worker_deaths": m_a["worker_deaths"],
+            "slab_retries": m_a["slab_retries"],
+            "wall_s": round(wall_a, 3),
+        },
+        "sampled": {
+            "requests": n_s, "bit_exact": n_s, "lost": 0,
+            "rolling_restarts": m_b["rolling_restarts"],
+            "migrations": m_b["migrations"],
+            "wall_s": round(wall_b, 3),
+        },
+        "hot_reload": reload_info,
+    }
+    print(json.dumps(line))
+    return line
+
+
 def bench_serve_prefix(n_groups=None, slots=None, chunk=None, mesh=None):
     """``--serve --prefix-mix``: the prefix-cache serving benchmark.
 
@@ -2246,6 +2534,16 @@ def main():
                          "dispatch split, and (with --faults) zero lost "
                          "requests under a mid-run SIGKILL of a decode "
                          "worker")
+    ap.add_argument("--rolling-restart", action="store_true",
+                    help="with --serve --cluster: the zero-downtime "
+                         "fleet-operations gate — live DecodeState "
+                         "migration, a proactive SUSPECT evacuation "
+                         "(stale-heartbeat fault plan), a rolling "
+                         "restart of EVERY worker under load, and a "
+                         "hot weight reload with typed mixed-version "
+                         "migration refusal; greedy AND request-keyed "
+                         "sampled bit-exactness vs undisturbed runs "
+                         "are hard-asserted in-bench")
     ap.add_argument("--faults", action="store_true",
                     help="with --serve --replicas: inject the replica-"
                          "kill + delayed-heartbeat fault plan; with "
@@ -2297,6 +2595,11 @@ def main():
     except Exception as e:
         _emit_failure("backend_init", e)
         sys.exit(1)
+    if args.serve and args.cluster and args.rolling_restart:
+        _run_guarded("serve_rolling", lambda: bench_serve_rolling(
+            spec=args.cluster, n_requests=args.serve_requests,
+            slots=args.serve_slots, chunk=args.serve_chunk))
+        return
     if args.serve and args.cluster:
         _run_guarded("serve_cluster", lambda: bench_serve_cluster(
             spec=args.cluster, n_requests=args.serve_requests,

@@ -110,6 +110,17 @@ class ElasticManager:
     def members(self) -> List[str]:
         return list(self._members)
 
+    def beat_age(self, node_id: str) -> Optional[float]:
+        """Seconds (this observer's monotonic clock) since ``node_id``'s
+        heartbeat value last CHANGED — the early-warning signal between
+        "beating normally" and "TTL-expired dead". None for a node this
+        observer has never seen beat. Refreshes the observation first,
+        so a caller polling between sweep intervals sees a just-landed
+        beat, not the stale age from the last sweep."""
+        self._alive_nodes()
+        prev = self._seen.get(node_id)
+        return None if prev is None else time.monotonic() - prev[1]
+
     def status(self) -> str:
         n = len(self._members)
         if n < self.np_min:

@@ -14,6 +14,8 @@ RPC peers must be the trusted training cluster, never untrusted input.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import pickle
 import threading
 import time
@@ -93,6 +95,11 @@ class RpcAgent:
         self._seen = (self.store.add(f"rpc/rescnt/{rank}", 0)
                       if resume else 0)
         self._next_reply: Dict[int, Future] = {}
+        # integrity accounting for the chunked bulk channel: per-part
+        # sha256 mismatches that a re-fetch healed (a second mismatch is
+        # a typed SlabTransferError, not a count). The cluster frontend
+        # mirrors this into its /metrics as serving.cluster.slab_retries.
+        self.transfer_retries = 0
         self._seq_lock = threading.Lock()
         self._stop = threading.Event()
         self._server = threading.Thread(target=self._serve, daemon=True)
@@ -123,23 +130,55 @@ class RpcAgent:
         """Store ``payload`` under ``key``, splitting values past the
         TCPStore client-buffer limit across ``{key}/part{i}`` keys. The
         parts land BEFORE the header, so any reader that observes the
-        header value can fetch every part immediately."""
+        header value can fetch every part immediately. The header
+        carries each part's sha256 — the slab/migration bulk channel
+        verifies every part on fetch (a flipped bit in a shipped KV row
+        must never scatter into a live carry)."""
         if len(payload) <= _CHUNK_BYTES:
             self.store.set(key, payload)
             return
         n = (len(payload) + _CHUNK_BYTES - 1) // _CHUNK_BYTES
+        sha = []
         for i in range(n):
-            self.store.set(f"{key}/part{i}",
-                           payload[i * _CHUNK_BYTES:(i + 1) * _CHUNK_BYTES])
-        self.store.set(key, _CHUNK_MAGIC + str(n).encode())
+            part = payload[i * _CHUNK_BYTES:(i + 1) * _CHUNK_BYTES]
+            sha.append(hashlib.sha256(part).hexdigest())
+            self.store.set(f"{key}/part{i}", part)
+        self.store.set(key, _CHUNK_MAGIC
+                       + json.dumps({"n": n, "sha": sha}).encode())
 
     def _fetch(self, key: str, timeout: float) -> bytes:
+        from paddle_tpu.runtime.resilience import SlabTransferError
         raw = self.store.wait(key, timeout=timeout)
         if not raw.startswith(_CHUNK_MAGIC):
             return raw
-        n = int(raw[len(_CHUNK_MAGIC):])
-        return b"".join(self.store.get(f"{key}/part{i}")
-                        for i in range(n))
+        hdr = raw[len(_CHUNK_MAGIC):]
+        try:
+            # pre-integrity header format: just the part count (a
+            # resumed incarnation may still read a value its
+            # predecessor wrote) — fetched unverified
+            n, sha = int(hdr), None
+        except ValueError:
+            meta = json.loads(hdr)
+            n, sha = int(meta["n"]), meta["sha"]
+        parts = []
+        for i in range(n):
+            part = self.store.get(f"{key}/part{i}")
+            if sha is not None \
+                    and hashlib.sha256(part).hexdigest() != sha[i]:
+                # one typed retry: a torn read re-fetches clean; real
+                # corruption (the stored bytes themselves are wrong)
+                # mismatches again and is refused typed
+                self.transfer_retries += 1
+                part = self.store.get(f"{key}/part{i}")
+                got = hashlib.sha256(part).hexdigest()
+                if got != sha[i]:
+                    raise SlabTransferError(
+                        f"chunked transfer {key}/part{i} failed sha256 "
+                        f"verification after retry ({got[:16]}… != "
+                        f"{sha[i][:16]}…) — refusing the corrupt "
+                        f"payload", key=key, part=i)
+            parts.append(part)
+        return b"".join(parts)
 
     # -- client ------------------------------------------------------------
     def call(self, to, fn: Callable, args=(), kwargs=None,

@@ -20,6 +20,10 @@ MetricsRegistry.to_prometheus()"):
 - ``/tracez`` — the newest completed spans from the tracer ring as JSON
   (``?limit=N``, default 256, plus the drop count), the "what just
   happened" debugging view.
+- ``/healthz`` — the fleet prober's liveness/readiness verdict: 200
+  with the attached health provider's JSON when it answers ``ok``,
+  503 when it answers not-ok or raises (a broken health check IS the
+  unhealthy signal).
 
 One daemon ``ThreadingHTTPServer`` thread; ``start()`` binds (port 0 =
 ephemeral, the test mode) and returns the actual port, ``stop()`` shuts
@@ -103,6 +107,7 @@ class ObsExporter:
         self._registries: Dict[str, Any] = {}
         self._status: Dict[str, Callable[[], dict]] = {}
         self._text: Dict[str, Callable[[], str]] = {}
+        self._health: Optional[Callable[[], dict]] = None
 
     # -- composition --------------------------------------------------------
     def add_registry(self, name: str, registry,
@@ -133,6 +138,17 @@ class ObsExporter:
         contributes a comment line, never a failed scrape."""
         with self._lock:
             self._text[name] = fn
+        return self
+
+    def set_health_provider(self, fn: Callable[[], dict]
+                            ) -> "ObsExporter":
+        """Attach the /healthz verdict callable: its dict must carry a
+        truthy ``"ok"`` for a 200; a falsy ``"ok"`` — or the provider
+        raising — answers 503 (an unreachable or broken health check IS
+        the unhealthy signal a fleet prober wants). Without a provider
+        /healthz answers ``{"ok": true}`` while the server runs."""
+        with self._lock:
+            self._health = fn
         return self
 
     def add_engine(self, engine, name: str = "serving",
@@ -215,6 +231,15 @@ class ObsExporter:
             body = json.dumps(json_safe(self.statusz()), indent=1,
                               default=str).encode()
             ctype = "application/json"
+        elif url.path == "/healthz":
+            ok, payload = self.healthz()
+            body = json.dumps(json_safe(payload), default=str).encode()
+            req.send_response(200 if ok else 503)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+            return
         elif url.path == "/tracez":
             q = parse_qs(url.query)
             try:
@@ -226,7 +251,8 @@ class ObsExporter:
             ctype = "application/json"
         else:
             req.send_error(
-                404, "unknown path (serving /metrics /statusz /tracez)")
+                404, "unknown path (serving /metrics /statusz /tracez "
+                     "/healthz)")
             return
         req.send_response(200)
         req.send_header("Content-Type", ctype)
@@ -283,6 +309,21 @@ class ObsExporter:
                 out[name] = {"error": f"{type(e).__name__}: "
                                       f"{str(e)[:200]}"}
         return out
+
+    def healthz(self):
+        """The /healthz verdict as ``(ok, payload)`` — public so tests
+        and the cluster frontend can probe without HTTP."""
+        with self._lock:
+            fn = self._health
+        if fn is None:
+            return True, {"ok": True}
+        try:
+            payload = dict(fn())
+        except Exception as e:
+            return False, {"ok": False,
+                           "error": f"{type(e).__name__}: "
+                                    f"{str(e)[:200]}"}
+        return bool(payload.get("ok")), payload
 
     def tracez(self, limit: int = 256) -> dict:
         spans = _tracer.spans()

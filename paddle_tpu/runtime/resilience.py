@@ -53,6 +53,7 @@ __all__ = [
     "RetryEvent", "DegradationEvent", "FaultEvent", "ReplicaEvent",
     "InjectedFault", "CorruptCheckpointError", "CorruptBundleError",
     "DecodeFailedError", "DeadlineExceededError", "ReplicaDeadError",
+    "SlabTransferError", "WeightVersionError",
     "classify_error", "resilient_call",
     "FaultInjector", "fault_injector", "atomic_write_bytes",
     "record_event", "drain_events", "recent_events",
@@ -239,6 +240,35 @@ class ReplicaDeadError(RuntimeError):
         super().__init__(message)
         self.replica = replica
         self.last_error = last_error
+
+
+class SlabTransferError(RuntimeError):
+    """A bulk slab/migration transfer failed integrity verification:
+    a chunked RPC part's sha256 did not match its header digest after
+    the one retry, or a shipped row-migration payload's end-to-end
+    digest did not match. The transfer is refused rather than absorbed
+    — corrupt KV rows scattered into a live carry would decode garbage
+    silently."""
+
+    def __init__(self, message: str, key: Optional[str] = None,
+                 part: Optional[int] = None):
+        super().__init__(message)
+        self.key = key
+        self.part = part
+
+
+class WeightVersionError(RuntimeError):
+    """A fleet operation would mix weight versions: migrating live
+    decode rows between workers built from DIFFERENT ``weights.npz``
+    versions (mid hot-reload) is refused typed — a KV cache computed
+    under v1 continued under v2 weights is neither v1 nor v2 output.
+    Carries both versions so the operator can tell which side lags."""
+
+    def __init__(self, message: str, src_version: Optional[str] = None,
+                 dst_version: Optional[str] = None):
+        super().__init__(message)
+        self.src_version = src_version
+        self.dst_version = dst_version
 
 
 # ---------------------------------------------------------------------------

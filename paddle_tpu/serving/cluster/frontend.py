@@ -38,6 +38,29 @@ Crash recovery, two modes per the recover= knob:
   frontend ledger. Respawn/restore failure falls back to replay — a
   crashed worker never takes accepted work down with it either way.
 
+Fleet operations (planned churn, not just crash recovery):
+
+- ``migrate(request_ids, src, dst)`` — at a chunk boundary the source
+  engine row-subset-extracts the selected requests (carry rows + KV +
+  live RNG keys + token ledger), the payload ships over the chunked
+  sha256-verified RPC channel, and the destination absorbs it via the
+  fused admission scatter. Greedy AND request-keyed-sampled streams
+  continue bit-exactly (the raw key rides along). Ownership leaves the
+  source the moment extraction succeeds — a later source death can
+  never double-requeue migrated rows — and an absorb failure falls
+  back to the frontend's own replay ledger: exactly-once either way.
+- ``evacuate(worker)`` — drain a worker NOW by migrating all its
+  assigned work to weight-version-compatible peers.
+- ``rolling_restart()`` — evacuate -> graceful shutdown -> respawn ->
+  re-admit, one worker at a time, while the fleet keeps serving. A
+  respawned worker rebuilds from whatever versioned weights the
+  launcher currently stages (hot weight reload); migration between
+  mixed weight versions is refused typed (``WeightVersionError``).
+- proactive SUSPECT evacuation — with ``suspect_after_s`` set, a
+  worker whose heartbeat goes stale (but has NOT yet TTL-expired) is
+  marked suspect, stops taking submits, and its in-flight work is
+  evacuated to peers BEFORE the TTL declares it dead.
+
 Fleet observability: ``start_exporter`` serves ONE /metrics that
 scrapes every live worker's own exporter at request time and
 concatenates the (per-worker-labelled) expositions after the
@@ -61,6 +84,7 @@ from paddle_tpu.obs.metrics import MetricsRegistry
 from paddle_tpu.runtime.resilience import (DeadlineExceededError,
                                            GenerateResult,
                                            ReplicaDeadError, ReplicaEvent,
+                                           WeightVersionError,
                                            record_event)
 from paddle_tpu.serving.cluster.worker import worker_op
 
@@ -76,7 +100,8 @@ class WorkerHandle:
     pid: int
     obs_port: int = 0
     snapshot_dir: Optional[str] = None
-    state: str = "healthy"           # healthy | suspect | dead
+    weights_version: Optional[str] = None
+    state: str = "healthy"       # healthy | suspect | restarting | dead
     consecutive_fatal: int = 0
     missed_beats: int = 0
     deaths: int = 0
@@ -115,6 +140,7 @@ class _Tracked:
         default_factory=lambda: np.zeros((0,), np.int64))
     excluded: Set[int] = dataclasses.field(default_factory=set)
     attempts: List[str] = dataclasses.field(default_factory=list)
+    migrations: List[str] = dataclasses.field(default_factory=list)
     replayed_tokens: int = 0
 
 
@@ -133,7 +159,8 @@ class ClusterRouter:
                  breaker_threshold: int = 1,
                  heartbeat_miss_threshold: int = 3,
                  recover: str = "replay",
-                 respawn: Optional[Callable[[WorkerHandle], dict]] = None):
+                 respawn: Optional[Callable[[WorkerHandle], dict]] = None,
+                 suspect_after_s: Optional[float] = None):
         if recover not in ("replay", "restart"):
             raise ValueError(
                 f"recover must be 'replay' or 'restart', got {recover!r}")
@@ -148,6 +175,11 @@ class ClusterRouter:
         self.heartbeat_miss_threshold = int(heartbeat_miss_threshold)
         self.recover = recover
         self._respawn = respawn
+        # proactive SUSPECT window: a heartbeat older than this (but not
+        # yet TTL-dead) marks the worker suspect and evacuates it; None
+        # disables the early warning (TTL death is then the only signal)
+        self.suspect_after_s = (None if suspect_after_s is None
+                                else float(suspect_after_s))
         self._tracked: Dict[int, _Tracked] = {}
         self._by_engine: Dict[int, Dict[int, int]] = {
             h.rank: {} for h in self.workers}
@@ -189,6 +221,27 @@ class ClusterRouter:
             "serving.cluster.disaggregation_fallbacks",
             "requests admitted with a decode-side prefill because the "
             "prefill pool was unavailable")
+        self._c_migrations = r.counter(
+            "serving.cluster.migrations",
+            "requests live-migrated between workers at a chunk "
+            "boundary (carry rows + KV + RNG keys shipped, bit-exact)")
+        self._c_evacuations = r.counter(
+            "serving.cluster.evacuations",
+            "workers drained by migrating their assigned work to "
+            "weight-version-compatible peers")
+        self._c_proactive = r.counter(
+            "serving.cluster.proactive_evacuations",
+            "SUSPECT workers (stale heartbeat, not yet TTL-dead) "
+            "evacuated before the TTL declared them dead")
+        self._c_rolling = r.counter(
+            "serving.cluster.rolling_restarts",
+            "workers restarted by rolling_restart while the fleet "
+            "kept serving")
+        self._c_slab_retries = r.counter(
+            "serving.cluster.slab_retries",
+            "chunked slab/migration transfer parts whose sha256 "
+            "mismatched once and re-fetched clean (a second mismatch "
+            "is a typed SlabTransferError)")
         self._g_healthy = r.gauge(
             "serving.cluster.healthy_workers", "workers taking traffic")
         self._g_healthy.set(len(self.workers))
@@ -246,12 +299,11 @@ class ClusterRouter:
                 f"no routable decode worker "
                 f"(states={[(h.name, h.state) for h in self.workers]})")
         rid = self._next_id
-        payload = self._disaggregate(prompt)
+        pf = self._disaggregate(prompt)
         last_shed: Optional[BaseException] = None
         for h in cand:
             try:
-                if payload is not None:
-                    self._call(h, "load_slab", payload)
+                self._load_slab(h, pf)
                 erid = self._call(
                     h, "submit", prompt,
                     max_new_tokens=int(max_new_tokens),
@@ -281,8 +333,12 @@ class ClusterRouter:
             return rid
         raise last_shed
 
-    def _disaggregate(self, prompt: np.ndarray) -> Optional[dict]:
-        """Run the admission prefill on the prefill pool; None = no
+    def _disaggregate(
+            self, prompt: np.ndarray
+    ) -> Optional[Tuple[dict, Optional[str]]]:
+        """Run the admission prefill on the prefill pool; returns the
+        slab payload tagged with the prefill worker's weights version
+        (``_load_slab`` refuses cross-version shipping). None = no
         pool / pool unavailable (the decode worker prefills itself)."""
         pool = self._prefill_pool()
         if not pool:
@@ -294,10 +350,27 @@ class ClusterRouter:
                 self._strike(h, e, [])
                 continue
             h.consecutive_fatal = 0
-            self._c_disagg.inc()
-            return payload
+            return payload, h.weights_version
         self._c_disagg_fallback.inc()
         return None
+
+    def _load_slab(self, h: WorkerHandle,
+                   pf: Optional[Tuple[dict, Optional[str]]]) -> None:
+        """Ship a disaggregated prefill slab to the admission target —
+        UNLESS the prefill ran under a different weights version (a
+        mid-hot-reload fleet where only part of the pool has restarted
+        onto the staged file). Cross-version KV is silent numerical
+        corruption, so the decode worker prefills locally instead,
+        counted as a disaggregation fallback."""
+        if pf is None:
+            return
+        payload, version = pf
+        if (version and h.weights_version
+                and version != h.weights_version):
+            self._c_disagg_fallback.inc()
+            return
+        self._call(h, "load_slab", payload)
+        self._c_disagg.inc()
 
     # -- the serving loop --------------------------------------------------
     def step(self) -> List[Tuple[int, Any]]:
@@ -306,9 +379,12 @@ class ClusterRouter:
         Returns the ``(cluster_rid, outcome)`` pairs resolved —
         results or typed errors."""
         finished: List[Tuple[int, Any]] = []
+        self._sync_slab_retries()
         members = set(self.elastic.members)
         for h in list(self.workers):
-            if h.state == "dead":
+            if h.state in ("dead", "restarting"):
+                # restarting = intentionally down (rolling restart owns
+                # its lifecycle); the death machinery must not fire
                 continue
             if h.name not in members:
                 h.missed_beats += 1
@@ -327,7 +403,36 @@ class ClusterRouter:
                                f"heartbeats"))
             else:
                 h.missed_beats = 0
-                if h.state == "suspect":
+                age = (self.elastic.beat_age(h.name)
+                       if self.suspect_after_s is not None else None)
+                if (h.state == "healthy" and age is not None
+                        and age > self.suspect_after_s):
+                    # proactive SUSPECT: the heartbeat is stale but the
+                    # TTL has not expired — stop routing to the worker
+                    # and move its work out BEFORE it dies, shrinking
+                    # the blast radius to zero if it does
+                    h.state = "suspect"
+                    self._sync_healthy()
+                    self._c_proactive.inc()
+                    record_event(ReplicaEvent(
+                        site="serving.cluster", replica=h.name,
+                        action="suspect",
+                        detail=f"stale heartbeat ({age:.2f}s > "
+                               f"{self.suspect_after_s:.2f}s): "
+                               f"proactive evacuation"))
+                    if h.serves_decode and self._by_engine[h.rank]:
+                        try:
+                            self.evacuate(h)
+                        except Exception as e:
+                            # a hung worker fails the extract: its rows
+                            # stay put and the TTL death replays them
+                            record_event(ReplicaEvent(
+                                site="serving.cluster", replica=h.name,
+                                action="evacuate_failed",
+                                detail=f"{type(e).__name__}: "
+                                       f"{str(e)[:200]}"))
+                elif h.state == "suspect" and (
+                        age is None or age <= self.suspect_after_s):
                     h.state = "healthy"
                     self._sync_healthy()
                     record_event(ReplicaEvent(
@@ -396,9 +501,12 @@ class ClusterRouter:
             self._errors[rid] = payload
             return rid, payload
         if resil is not None:
+            # attempts counts every worker that held the request;
+            # migrations are planned moves, not crash requeues
             resil["cluster"] = {
                 "workers": list(t.attempts),
-                "requeues": len(t.attempts) - 1,
+                "requeues": len(t.attempts) - 1 - len(t.migrations),
+                "migrations": list(t.migrations),
                 "replayed_tokens": t.replayed_tokens,
             }
         res = GenerateResult.wrap(np.asarray(payload), resil)
@@ -552,11 +660,10 @@ class ClusterRouter:
         # replay admissions disaggregate too: the survivor ingests the
         # grown prompt as a shipped slab, so prefill dispatches stay on
         # the prefill pool even across requeues
-        payload = self._disaggregate(t.prompt)
+        pf = self._disaggregate(t.prompt)
         for h in cand:
             try:
-                if payload is not None:
-                    self._call(h, "load_slab", payload)
+                self._load_slab(h, pf)
                 erid = self._call(
                     h, "submit", t.prompt,
                     max_new_tokens=t.max_new_tokens,
@@ -590,6 +697,250 @@ class ClusterRouter:
             replica=dead.name)
         self._errors[rid] = err
         finished.append((rid, err))
+
+    # -- fleet operations: migrate / evacuate / rolling restart ------------
+    def _resolve(self, worker) -> WorkerHandle:
+        """Accept a WorkerHandle, a rank, or a worker name."""
+        if isinstance(worker, WorkerHandle):
+            return worker
+        if isinstance(worker, int):
+            return self._handle(worker)
+        for h in self.workers:
+            if h.name == worker:
+                return h
+        raise ValueError(f"no worker named {worker!r}")
+
+    def migrate(self, request_ids: Sequence[int], src, dst,
+                timeout: Optional[float] = None,
+                _on_extracted: Optional[Callable[[], None]] = None
+                ) -> List[int]:
+        """Live-migrate in-flight requests from ``src`` to ``dst`` at a
+        chunk boundary: the source engine row-subset-extracts the carry
+        rows + KV + live RNG keys + token ledgers, the payload ships
+        over the sha256-verified chunked RPC channel, the destination
+        absorbs via the fused admission scatter. Greedy and request-
+        keyed-sampled continuations are bit-exact (the raw per-row key
+        rides along — no re-derivation).
+
+        Exactly-once discipline: frontend ownership leaves ``src`` the
+        moment extraction succeeds (the source engine has ALREADY
+        released the rows), so a later source death cannot double-
+        requeue them; if the destination absorb then fails, the rows
+        fall back to the frontend's own replay ledger — which is
+        current as of the extraction boundary. ``_on_extracted`` is the
+        fault-drill hook fired between the two phases.
+
+        Raises ``WeightVersionError`` when both workers report weight
+        versions and they differ (a migrated carry row decoded under
+        different parameters would silently diverge)."""
+        src_h, dst_h = self._resolve(src), self._resolve(dst)
+        if src_h.rank == dst_h.rank:
+            raise ValueError("migrate: src and dst are the same worker")
+        if not (src_h.serves_decode and dst_h.serves_decode):
+            raise ValueError(
+                f"migrate needs decode-capable workers "
+                f"(src={src_h.role}, dst={dst_h.role})")
+        if dst_h.state != "healthy":
+            raise ValueError(
+                f"migrate: destination {dst_h.name} is {dst_h.state}")
+        if src_h.state == "dead":
+            raise ValueError(
+                f"migrate: source {src_h.name} is dead (use the crash-"
+                f"recovery replay path instead)")
+        if (src_h.weights_version and dst_h.weights_version
+                and src_h.weights_version != dst_h.weights_version):
+            raise WeightVersionError(
+                f"migrate {src_h.name} -> {dst_h.name} refused: mixed "
+                f"weight versions ({src_h.weights_version} vs "
+                f"{dst_h.weights_version})",
+                src_version=src_h.weights_version,
+                dst_version=dst_h.weights_version)
+        rids = [int(r) for r in request_ids]
+        erids = []
+        for rid in rids:
+            t = self._tracked.get(rid)
+            if t is None:
+                raise ValueError(f"migrate: unknown request {rid}")
+            if rid in self._results or rid in self._errors:
+                raise ValueError(f"migrate: request {rid} already "
+                                 f"resolved")
+            if t.worker != src_h.rank:
+                raise ValueError(
+                    f"migrate: request {rid} is on rank {t.worker}, "
+                    f"not {src_h.name} (rank {src_h.rank})")
+            erids.append(t.engine_rid)
+        if not erids:
+            return []
+        payload = self._call(src_h, "extract_rows", erids,
+                             timeout=timeout)
+        # ownership has left the source: the engine released the rows,
+        # so the frontend's table must drop them NOW — a source death
+        # after this point must not requeue what the payload carries
+        for rid, erid in zip(rids, erids):
+            self._by_engine[src_h.rank].pop(erid, None)
+        if _on_extracted is not None:
+            _on_extracted()
+        sink: List[Tuple[int, Any]] = []
+        try:
+            mapping = self._call(dst_h, "absorb_rows", payload,
+                                 timeout=timeout)
+        except Exception as e:
+            # the payload is lost but the frontend ledger is current as
+            # of the extraction boundary: replay wins, zero loss. The
+            # destination is NOT struck — a mid-absorb integrity error
+            # says nothing about its socket.
+            record_event(ReplicaEvent(
+                site="serving.cluster", replica=dst_h.name,
+                action="migrate_absorb_failed",
+                detail=f"{type(e).__name__}: {str(e)[:200]} — "
+                       f"replaying {len(rids)} requests from the "
+                       f"frontend ledger"))
+            fail_err = ReplicaDeadError(
+                f"migration absorb failed on {dst_h.name}",
+                replica=dst_h.name)
+            for rid in rids:
+                self._requeue(rid, src_h, fail_err, sink, exclude=False)
+            return []
+        mapping = {int(k): int(v) for k, v in mapping.items()}
+        for rid, erid in zip(rids, erids):
+            t = self._tracked[rid]
+            t.worker = dst_h.rank
+            t.engine_rid = mapping[erid]
+            t.attempts.append(dst_h.name)
+            t.migrations.append(dst_h.name)
+            self._by_engine[dst_h.rank][mapping[erid]] = rid
+        self._c_migrations.inc(len(rids))
+        record_event(ReplicaEvent(
+            site="serving.cluster", replica=src_h.name,
+            action="migrate",
+            detail=f"{len(rids)} requests -> {dst_h.name} "
+                   f"(rids {rids[:8]}{'...' if len(rids) > 8 else ''})"))
+        return rids
+
+    def evacuate(self, worker, timeout: Optional[float] = None
+                 ) -> Dict[str, Any]:
+        """Drain a worker by migrating ALL its assigned requests to
+        weight-version-compatible decode peers, least-loaded first.
+        Never raises for an individual failed group — those rids simply
+        stay on the worker (``unmoved``) where the ordinary death
+        machinery replays them if the worker does die. Returns
+        ``{"worker", "moved", "unmoved"}``."""
+        src_h = self._resolve(worker)
+        rids = list(self._by_engine[src_h.rank].values())
+        report = {"worker": src_h.name, "moved": [], "unmoved": []}
+        if not rids:
+            return report
+        peers = [h for h in self._decode_pool(set())
+                 if h.rank != src_h.rank
+                 and not (src_h.weights_version and h.weights_version
+                          and h.weights_version != src_h.weights_version)]
+        if not peers:
+            report["unmoved"] = rids
+            return report
+        # greedy least-loaded assignment with live load updates: the
+        # pool sort is a snapshot, so account for rows we place
+        loads = {h.rank: self._load(h) for h in peers}
+        groups: Dict[int, List[int]] = {}
+        for rid in rids:
+            dst = min(peers, key=lambda h: (loads[h.rank], h.rank))
+            groups.setdefault(dst.rank, []).append(rid)
+            loads[dst.rank] += 1
+        for dst_rank, group in groups.items():
+            try:
+                moved = self.migrate(group, src_h, dst_rank,
+                                     timeout=timeout)
+                report["moved"].extend(moved)
+                if not moved:
+                    report["unmoved"].extend(
+                        r for r in group
+                        if r in self._tracked
+                        and r not in self._results
+                        and r not in self._errors)
+            except Exception as e:
+                record_event(ReplicaEvent(
+                    site="serving.cluster", replica=src_h.name,
+                    action="evacuate_group_failed",
+                    detail=f"{len(group)} rids -> rank {dst_rank}: "
+                           f"{type(e).__name__}: {str(e)[:200]}"))
+                report["unmoved"].extend(group)
+        self._c_evacuations.inc()
+        record_event(ReplicaEvent(
+            site="serving.cluster", replica=src_h.name,
+            action="evacuated",
+            detail=f"{len(report['moved'])} moved, "
+                   f"{len(report['unmoved'])} left in place"))
+        return report
+
+    def rolling_restart(self, drain_steps: int = 200) -> Dict[str, Any]:
+        """Restart every live worker in sequence while the fleet keeps
+        serving: evacuate its in-flight work to peers, drain whatever
+        could not move, gracefully shut the process down, respawn it
+        (the new process loads whatever versioned weights the launcher
+        currently stages — the hot-weight-reload path), and re-admit it
+        to the pool. Requires the launcher's respawn hook."""
+        if self._respawn is None:
+            raise RuntimeError(
+                "rolling_restart needs the launcher's respawn hook "
+                "(launch_cluster wires it)")
+        report = {"restarted": [], "skipped": []}
+        for h in list(self.workers):
+            if h.state == "dead":
+                report["skipped"].append(h.name)
+                continue
+            if h.serves_decode and self._by_engine[h.rank]:
+                self.evacuate(h)
+                steps = 0
+                while self._by_engine[h.rank]:
+                    # unmovable rows (no peer / all-busy): serve them
+                    # out IN PLACE before taking the worker down
+                    self.step()
+                    steps += 1
+                    if steps > drain_steps:
+                        raise RuntimeError(
+                            f"rolling_restart: {h.name} did not drain "
+                            f"within {drain_steps} steps "
+                            f"({len(self._by_engine[h.rank])} left)")
+            h.state = "restarting"
+            self._sync_healthy()
+            record_event(ReplicaEvent(
+                site="serving.cluster", replica=h.name,
+                action="restarting",
+                detail=f"rolling restart: pid {h.pid} going down"))
+            try:
+                self._call(h, "shutdown", timeout=5.0)
+            except Exception:
+                pass    # the respawn hook SIGKILLs a hung process
+            old_version = h.weights_version
+            info = self._respawn(h)
+            h.pid = int(info["pid"])
+            h.obs_port = int(info.get("obs_port", h.obs_port))
+            h.weights_version = info.get("weights_version",
+                                         h.weights_version)
+            h.state = "healthy"
+            h.consecutive_fatal = 0
+            h.missed_beats = 0
+            self._sync_healthy()
+            self._c_rolling.inc()
+            record_event(ReplicaEvent(
+                site="serving.cluster", replica=h.name,
+                action="restarted",
+                detail=f"rolling restart: pid {h.pid}, weights "
+                       f"{old_version} -> {h.weights_version}"))
+            report["restarted"].append(
+                {"name": h.name, "pid": h.pid,
+                 "weights_version": h.weights_version})
+            # keep the fleet moving between workers
+            self.step()
+        return report
+
+    def _sync_slab_retries(self) -> None:
+        """Fold the frontend agent's chunked-transfer retry count into
+        the fleet counter (the worker-side agents' retries surface via
+        their own /metrics expositions)."""
+        delta = int(self.agent.transfer_retries) \
+            - int(self._c_slab_retries.value)
+        if delta > 0:
+            self._c_slab_retries.inc(delta)
 
     # -- fleet observability -----------------------------------------------
     def worker_metrics(self) -> Dict[str, dict]:
@@ -644,6 +995,7 @@ class ClusterRouter:
             "workers": [{
                 "name": h.name, "rank": h.rank, "role": h.role,
                 "pid": h.pid, "state": h.state,
+                "weights_version": h.weights_version,
                 "consecutive_fatal": h.consecutive_fatal,
                 "missed_beats": h.missed_beats,
                 "deaths": h.deaths, "last_error": h.last_error,
@@ -668,6 +1020,7 @@ class ClusterRouter:
 
     def metrics(self) -> Dict[str, Any]:
         """Fleet-level accounting counters."""
+        self._sync_slab_retries()
         return {
             "workers": len(self.workers),
             "healthy": sum(1 for h in self.workers
@@ -684,6 +1037,11 @@ class ClusterRouter:
             "disaggregated_admissions": int(self._c_disagg.value),
             "disaggregation_fallbacks":
                 int(self._c_disagg_fallback.value),
+            "migrations": int(self._c_migrations.value),
+            "evacuations": int(self._c_evacuations.value),
+            "proactive_evacuations": int(self._c_proactive.value),
+            "rolling_restarts": int(self._c_rolling.value),
+            "slab_retries": int(self._c_slab_retries.value),
         }
 
     def start_exporter(self, port: Optional[int] = None) -> int:

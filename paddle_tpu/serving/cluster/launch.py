@@ -22,6 +22,15 @@ The :class:`Cluster` handle keeps the process table for the fault
 drills (``kill(name)`` is a REAL ``SIGKILL``) and tears everything
 down in ``shutdown()`` (graceful RPC shutdown, then SIGTERM, then
 SIGKILL — bounded, never hangs a bench).
+
+Weights are staged VERSIONED (``weights_v1.npz``, ``weights_v2.npz``,
+…): every worker config points at a staged file, and
+``Cluster.stage_weights(model)`` writes the next version and repoints
+the configs — the next respawn (a ``rolling_restart`` leg, or a crash
+restart) rebuilds from the new file. That is the whole hot-weight-
+reload mechanism: no push protocol, the worker lifecycle IS the reload.
+Each worker reports a content-derived ``weights_version`` at
+registration, which the router uses to refuse mixed-version migration.
 """
 
 from __future__ import annotations
@@ -67,13 +76,16 @@ class Cluster:
 
     def __init__(self, router: ClusterRouter, agent, elastic,
                  procs: Dict[int, subprocess.Popen],
-                 configs: Dict[int, dict], spawn_timeout_s: float):
+                 configs: Dict[int, dict], spawn_timeout_s: float,
+                 workdir: Optional[str] = None, weights_seq: int = 1):
         self.router = router
         self.agent = agent
         self.elastic = elastic
         self.procs = procs
         self.configs = configs
         self._spawn_timeout_s = float(spawn_timeout_s)
+        self.workdir = workdir
+        self._weights_seq = int(weights_seq)
 
     # -- fault drills ------------------------------------------------------
     def handle(self, name: str) -> WorkerHandle:
@@ -109,6 +121,25 @@ class Cluster:
                                 self._spawn_timeout_s,
                                 self.procs[h.rank])
         return info
+
+    def stage_weights(self, model) -> str:
+        """Write the model's parameters as the NEXT versioned weights
+        file and repoint every worker config at it. Nothing restarts
+        here: each worker picks the staged file up on its next respawn
+        — ``router.rolling_restart()`` right after this call IS the
+        zero-downtime hot weight reload. Returns the staged path."""
+        if self.workdir is None:
+            raise RuntimeError(
+                "stage_weights needs the launch workdir (clusters built "
+                "by launch_cluster have it)")
+        self._weights_seq += 1
+        path = os.path.join(self.workdir,
+                            f"weights_v{self._weights_seq}.npz")
+        np.savez(path, **{k: np.asarray(v.numpy())
+                          for k, v in model.state_dict().items()})
+        for cfg in self.configs.values():
+            cfg["weights"] = path
+        return path
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self) -> None:
@@ -185,6 +216,7 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
                    rpc_timeout_s: float = 60.0,
                    breaker_threshold: int = 1,
                    heartbeat_miss_threshold: int = 3,
+                   suspect_after_s: Optional[float] = None,
                    spawn_timeout_s: float = 180.0) -> Cluster:
     """Spawn ``prefill + decode + unified`` worker processes serving
     ``model`` and return the routed :class:`Cluster`.
@@ -194,6 +226,9 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
     (they only ever ``prefill_extract``). ``snapshot_every_chunks > 0``
     arms per-decode-worker snapshot cadence under
     ``workdir/snap_<name>`` — the ``recover="restart"`` substrate.
+    ``suspect_after_s`` arms proactive evacuation: a worker whose
+    heartbeat goes stale past it (but is not yet TTL-dead) is marked
+    suspect and its in-flight work migrated to peers.
     """
     import dataclasses as _dc
 
@@ -201,7 +236,7 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
     from paddle_tpu.distributed.rpc import RpcAgent
 
     os.makedirs(workdir, exist_ok=True)
-    weights = os.path.join(workdir, "weights.npz")
+    weights = os.path.join(workdir, "weights_v1.npz")
     np.savez(weights, **{k: np.asarray(v.numpy())
                          for k, v in model.state_dict().items()})
     model_cfg = _dc.asdict(model.config)
@@ -255,7 +290,8 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
                 name=info["name"], rank=rank, role=info["role"],
                 pid=int(info["pid"]),
                 obs_port=int(info.get("obs_port", 0)),
-                snapshot_dir=configs[rank]["engine"].get("snapshot_dir")))
+                snapshot_dir=configs[rank]["engine"].get("snapshot_dir"),
+                weights_version=info.get("weights_version")))
     except Exception:
         for p in procs.values():
             if p.poll() is None:
@@ -268,8 +304,8 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
         agent, handles, elastic, rpc_timeout_s=rpc_timeout_s,
         breaker_threshold=breaker_threshold,
         heartbeat_miss_threshold=heartbeat_miss_threshold,
-        recover=recover)
+        recover=recover, suspect_after_s=suspect_after_s)
     cluster = Cluster(router, agent, elastic, procs, configs,
-                      spawn_timeout_s)
+                      spawn_timeout_s, workdir=workdir, weights_seq=1)
     router._respawn = cluster.respawn
     return cluster

@@ -82,7 +82,15 @@ class WorkerHost:
                 f"got {self.role!r}")
         self._stop = threading.Event()
 
-        # model from the shipped weights: identical params fleet-wide
+        # model from the shipped weights: identical params fleet-wide.
+        # The version is content-derived (digest of the npz bytes), so a
+        # hot-reloaded worker reports a NEW version without any registry
+        # — the frontend's mixed-version migration check keys on it.
+        import hashlib
+        with open(cfg["weights"], "rb") as f:
+            self.weights_version = ("sha256:"
+                                    + hashlib.sha256(f.read())
+                                      .hexdigest()[:12])
         model = LlamaForCausalLM(LlamaConfig(**cfg["model"]))
         with np.load(cfg["weights"]) as data:
             missing, unexpected = model.set_state_dict(
@@ -123,7 +131,9 @@ class WorkerHost:
                                  labels={"worker": self.name})
         self.exporter.add_status_provider(
             "worker", lambda: {"name": self.name, "role": self.role,
-                               "rank": self.rank, "pid": os.getpid()})
+                               "rank": self.rank, "pid": os.getpid(),
+                               "weights_version": self.weights_version})
+        self.exporter.set_health_provider(self._health)
         self.obs_port = self.exporter.start()
 
         # registration: the launcher's readiness barrier
@@ -132,7 +142,17 @@ class WorkerHost:
             json.dumps({"name": self.name, "role": self.role,
                         "rank": self.rank, "pid": os.getpid(),
                         "obs_port": self.obs_port,
+                        "weights_version": self.weights_version,
                         "resumed": bool(resume)}).encode())
+
+    def _health(self) -> Dict[str, Any]:
+        """/healthz verdict: serving until shutdown flips the flag."""
+        sch = self.engine.scheduler
+        return {"ok": not self._stop.is_set(), "name": self.name,
+                "role": self.role,
+                "weights_version": self.weights_version,
+                "queued": len(sch),
+                "occupied": len(sch.slots.occupied())}
 
     # -- op dispatch -------------------------------------------------------
     def handle(self, name: str, *args, **kwargs):
@@ -142,7 +162,8 @@ class WorkerHost:
         return fn(*args, **kwargs)
 
     def op_ping(self):
-        return {"name": self.name, "role": self.role, "pid": os.getpid()}
+        return {"name": self.name, "role": self.role, "pid": os.getpid(),
+                "weights_version": self.weights_version}
 
     def op_submit(self, prompt, **kwargs) -> int:
         return self.engine.submit(np.asarray(prompt), **kwargs)
@@ -193,6 +214,17 @@ class WorkerHost:
         self.engine.load_prefix_slab(payload)
         return True
 
+    def op_extract_rows(self, request_ids) -> Dict[str, Any]:
+        """Live-migration source: serialize + RELEASE the selected
+        requests (engine ownership leaves with the payload; the chunked
+        RPC reply channel sha256-verifies every part in transit)."""
+        return self.engine.extract_rows(request_ids)
+
+    def op_absorb_rows(self, payload: Dict[str, Any]) -> Dict[int, int]:
+        """Live-migration destination: scatter the shipped rows into
+        free slots; returns {source engine id: this engine's id}."""
+        return self.engine.absorb_rows(payload)
+
     def op_snapshot(self, path: str) -> str:
         return self.engine.snapshot(path)
 
@@ -202,6 +234,7 @@ class WorkerHost:
     def op_metrics(self) -> Dict[str, Any]:
         return {
             "name": self.name, "role": self.role,
+            "weights_version": self.weights_version,
             "prefill_dispatches": self.engine.prefill_dispatches,
             "chunk_dispatches": self.engine.chunk_dispatches,
             "step_dispatches": self.engine.step_dispatches,
@@ -211,6 +244,7 @@ class WorkerHost:
     def op_status(self) -> Dict[str, Any]:
         return {"name": self.name, "role": self.role, "rank": self.rank,
                 "pid": os.getpid(), "obs_port": self.obs_port,
+                "weights_version": self.weights_version,
                 "engine": self.engine.status()}
 
     def op_stall(self, seconds: float) -> bool:

@@ -694,6 +694,19 @@ class ServingEngine:
         self._c_snapshots = r.counter(
             "serving.snapshots", "resumable DecodeState snapshots "
             "written (crash-recovery cadence + graceful drain)")
+        # fleet operations: live row migration + the finite guard
+        self._c_corrupt_rows = r.counter(
+            "serving.corrupt_rows",
+            "rows whose harvested logits went NaN/Inf: frozen ALONE "
+            "and returned partial (flagged corrupt_row) — the poison "
+            "never spreads to the rest of the batch")
+        self._c_migrated_out = r.counter(
+            "serving.rows_migrated_out",
+            "requests extracted off this engine by a live migration "
+            "(ownership leaves with the payload)")
+        self._c_migrated_in = r.counter(
+            "serving.rows_migrated_in",
+            "requests absorbed into this engine by a live migration")
         # crash recovery / replica identity
         self.replica_tag = None if replica_tag is None else str(replica_tag)
         self._snap_dir = snapshot_dir
@@ -879,9 +892,41 @@ class ServingEngine:
         self._h_occ.observe(len(occupied) / self.num_slots)
         toks = self._dispatch_chunk(occupied)
         t_chunk_done = time.monotonic()
+        # finite guard: one harvest-time check over the post-chunk
+        # logits. A numerically poisoned row (NaN/Inf) is frozen ALONE
+        # and returned partial — one bad row must never take down the
+        # whole batch or, worse, migrate its poison into a peer's carry
+        row_finite = np.isfinite(
+            np.asarray(jax.device_get(self.state.logits))).all(axis=-1)
         finished, freed = [], []
         for i, slot in occupied:
             slot.chunks += 1
+            if not row_finite[i]:
+                req = slot.request
+                # the chunk that surfaced the corruption is dropped:
+                # tokens sampled off non-finite logits are noise; the
+                # pre-chunk prefix is the honest partial
+                seq = (np.concatenate(slot.tokens) if slot.tokens
+                       else np.zeros((0,), np.int64))
+                seq = seq[:req.max_new_tokens]
+                self._c_corrupt_rows.inc()
+                obs.record_crash(
+                    "serving.corrupt_row",
+                    error=FloatingPointError(
+                        f"non-finite logits in carry row {i} "
+                        f"(request {req.id}) after chunk {slot.chunks}"),
+                    extra={"request": int(req.id), "slot": int(i),
+                           "chunks": int(slot.chunks),
+                           "tokens_kept": int(seq.shape[0])})
+                res = self._finish(slot, seq, i, corrupt_row=True)
+                self._results[req.id] = res
+                finished.append((req.id, res))
+                if slot.pinned_slab is not None:
+                    self.prefix_cache.unpin(slot.pinned_slab)
+                    slot.pinned_slab = None
+                self.scheduler.slots.release(i)
+                freed.append(i)
+                continue
             slot.tokens.append(toks[i])
             if slot.first_token_at is None:
                 # the slot's first tokens reached the host with THIS
@@ -1048,38 +1093,20 @@ class ServingEngine:
             arrays[f"leaf_{i}"] = store
             leaf_meta.append({"dtype": tag})
         now = time.monotonic()
-
-        def req_meta(req):
-            return {
-                "id": req.id, "max_new_tokens": req.max_new_tokens,
-                "eos_token_id": req.eos_token_id,
-                "temperature": req.temperature, "seed": req.seed,
-                "priority": req.priority,
-                "latency_class": req.latency_class,
-                "slo_ttft_s": req.slo_ttft_s,
-                "slo_latency_s": req.slo_latency_s,
-                # deadlines cross the snapshot as REMAINING budget: the
-                # monotonic clock does not survive a process restart
-                "deadline_remaining_s": (
-                    None if req.deadline_at is None
-                    else req.deadline_at - now),
-                "rng_request_id": req.rng_request_id,
-                "rng_tokens_emitted": req.rng_tokens_emitted,
-            }
-
         slots_meta = []
         for i, slot in self.scheduler.slots.occupied():
             arrays[f"slot{i}_prompt"] = np.asarray(slot.request.prompt)
             for j, piece in enumerate(slot.tokens):
                 arrays[f"slot{i}_piece{j}"] = np.asarray(piece)
             slots_meta.append({"slot": i,
-                               "request": req_meta(slot.request),
+                               "request": self._req_meta(slot.request,
+                                                         now),
                                "pieces": len(slot.tokens),
                                "chunks": slot.chunks})
         queue_meta = []
         for j, req in enumerate(self.scheduler.queued()):
             arrays[f"queue{j}_prompt"] = np.asarray(req.prompt)
-            queue_meta.append(req_meta(req))
+            queue_meta.append(self._req_meta(req, now))
         meta = {
             "kind": "paddle_tpu.decode_snapshot", "version": 1,
             "time_unix": time.time(),
@@ -1252,6 +1279,27 @@ class ServingEngine:
                                   if snap_slots != self.num_slots else 0)}
 
     @staticmethod
+    def _req_meta(req: Request, now: float) -> dict:
+        """The serialized-request record shared by :meth:`snapshot` and
+        :meth:`extract_rows`; :meth:`_req_from_meta` is its inverse."""
+        return {
+            "id": req.id, "max_new_tokens": req.max_new_tokens,
+            "eos_token_id": req.eos_token_id,
+            "temperature": req.temperature, "seed": req.seed,
+            "priority": req.priority,
+            "latency_class": req.latency_class,
+            "slo_ttft_s": req.slo_ttft_s,
+            "slo_latency_s": req.slo_latency_s,
+            # deadlines cross the payload as REMAINING budget: the
+            # monotonic clock does not survive a process restart
+            "deadline_remaining_s": (
+                None if req.deadline_at is None
+                else req.deadline_at - now),
+            "rng_request_id": req.rng_request_id,
+            "rng_tokens_emitted": req.rng_tokens_emitted,
+        }
+
+    @staticmethod
     def _req_from_meta(m: dict, prompt: np.ndarray, now: float) -> Request:
         rem = m.get("deadline_remaining_s")
         return Request(
@@ -1311,6 +1359,235 @@ class ServingEngine:
                 "reset_state with occupied slots would orphan in-flight "
                 "requests; export/clear them first")
         self.state = self._b.new_state()
+
+    # -- live row migration (serving/cluster fleet operations) -------------
+    def extract_rows(self, request_ids) -> Dict[str, Any]:
+        """The row-SUBSET generalization of :meth:`snapshot`: serialize
+        only the selected requests into one migration payload. An
+        in-flight request ships its carry rows — logits / KV / pos /
+        the LIVE RNG key / eos / temp, gathered on the batch axis —
+        plus the slot bookkeeping (tokens so far, chunk count); a
+        queued request ships prompt + metadata only. Ownership LEAVES
+        this engine with the payload (slots released and frozen, queue
+        entries removed), so a request can never be served by two
+        workers at once — exactly-once by construction. Must be called
+        at a chunk boundary (between steps): the carry and the host
+        token buffers agree only there. The payload travels as one npz
+        blob under a sha256 digest; :meth:`absorb_rows` verifies it
+        end-to-end (the chunked RPC channel additionally verifies per
+        part in transit). Unknown ids are refused before anything is
+        touched."""
+        import jax
+
+        from paddle_tpu.distributed.checkpoint import _np_storable
+        want = [int(i) for i in request_ids]
+        by_slot = {int(s.request.id): (i, s)
+                   for i, s in self.scheduler.slots.occupied()}
+        queued_ids = {int(r.id) for r in self.scheduler.queued()}
+        unknown = [i for i in want
+                   if i not in by_slot and i not in queued_ids]
+        if unknown:
+            raise ValueError(
+                f"extract_rows: request ids {unknown} are neither in a "
+                f"slot nor queued on this engine (already finished, or "
+                f"never submitted here)")
+        inflight = [(rid,) + by_slot[rid] for rid in want
+                    if rid in by_slot]
+        rows = [slot_idx for _, slot_idx, _ in inflight]
+        arrays: Dict[str, np.ndarray] = {}
+        leaf_meta: Dict[str, Any] = {"kc": [], "vc": []}
+        st = self.state
+        if rows:
+            idx = np.asarray(rows, np.int64)
+
+            def gather_cache(name, tree):
+                leaves, _ = jax.tree_util.tree_flatten(tree)
+                for i, leaf in enumerate(leaves):
+                    a = np.asarray(jax.device_get(leaf))
+                    # the put_cache batch-axis rule: ndim-4 for both
+                    # stacked (L, B, ...) and per-layer (B, ...) layouts
+                    store, tag = _np_storable(
+                        np.take(a, idx, axis=a.ndim - 4))
+                    arrays[f"{name}_leaf_{i}"] = store
+                    leaf_meta[name].append({"dtype": tag})
+
+            gather_cache("kc", st.kc)
+            gather_cache("vc", st.vc)
+            for nm, leaf in (("logits", st.logits), ("pos", st.pos),
+                             ("keys", st.keys), ("eos", st.eos),
+                             ("temp", st.temp)):
+                store, tag = _np_storable(
+                    np.take(np.asarray(jax.device_get(leaf)), idx,
+                            axis=0))
+                arrays[nm] = store
+                leaf_meta[nm] = {"dtype": tag}
+        now = time.monotonic()
+        slots_meta = []
+        for j, (rid, slot_idx, slot) in enumerate(inflight):
+            arrays[f"row{j}_prompt"] = np.asarray(slot.request.prompt)
+            for p, piece in enumerate(slot.tokens):
+                arrays[f"row{j}_piece{p}"] = np.asarray(piece)
+            slots_meta.append({"row": j,
+                               "request": self._req_meta(slot.request,
+                                                         now),
+                               "pieces": len(slot.tokens),
+                               "chunks": slot.chunks})
+        queue_meta = []
+        for j, req in enumerate(self.scheduler.remove(
+                [rid for rid in want if rid not in by_slot])):
+            arrays[f"queue{j}_prompt"] = np.asarray(req.prompt)
+            queue_meta.append(self._req_meta(req, now))
+        # ownership leaves with the payload: release + freeze the
+        # donated rows so the next step neither serves nor re-emits them
+        for rid, slot_idx, slot in inflight:
+            if slot.pinned_slab is not None:
+                self.prefix_cache.unpin(slot.pinned_slab)
+                slot.pinned_slab = None
+            self.scheduler.slots.release(slot_idx)
+        if rows:
+            self._freeze_rows(rows)
+        self._g_qdepth.set(len(self.scheduler))
+        meta = {
+            "kind": "paddle_tpu.row_migration", "version": 1,
+            "rows": len(inflight), "quant": self._b.quant,
+            "mesh_axes": (dict(self._b.sharding.axes)
+                          if self._b.sharding is not None else None),
+            "leaves": leaf_meta, "slots": slots_meta,
+            "queue": queue_meta,
+        }
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        self._c_migrated_out.inc(len(want))
+        obs.tracer.event("serving.migrate.extract",
+                         in_flight=len(inflight),
+                         queued=len(queue_meta))
+        return {"kind": meta["kind"], "meta": meta, "data": payload,
+                "sha256": hashlib.sha256(payload).hexdigest()}
+
+    def absorb_rows(self, payload: Dict[str, Any]) -> Dict[int, int]:
+        """The destination side of a live migration: verify the payload
+        digest (typed ``SlabTransferError`` on a flipped bit),
+        cross-check quant recipe (``QuantMismatchError``) and mesh
+        topology (``MeshMismatchError``), then scatter each shipped
+        carry row into a free slot through the SAME fused admission
+        scatter a prefill uses — a row-remapped restore, one row at a
+        time, into a LIVE engine. The shipped row keeps its in-flight
+        RNG key, so a sampled stream CONTINUES exactly where the source
+        left it (no re-derivation); greedy continuation is bit-exact by
+        the same argument as restore. Shipped queued requests re-enter
+        this engine's queue. Every absorbed request gets a fresh engine
+        id; returns ``{source engine id: new engine id}`` — the cluster
+        frontend rewires its assignment table through it."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.checkpoint import _np_restore
+        from paddle_tpu.inference.sharding import MeshMismatchError
+        from paddle_tpu.runtime.resilience import SlabTransferError
+        if payload.get("kind") != "paddle_tpu.row_migration":
+            raise ValueError(
+                f"absorb_rows: payload kind {payload.get('kind')!r} is "
+                f"not a row-migration payload")
+        raw = payload["data"]
+        got = hashlib.sha256(raw).hexdigest()
+        want = payload.get("sha256", "")
+        if got != want:
+            raise SlabTransferError(
+                f"migration payload is corrupt: sha256 {got[:16]}… != "
+                f"{want[:16]}… — refusing to scatter corrupt rows into "
+                f"a live carry", key="row_migration")
+        meta = payload["meta"]
+        if meta.get("quant") != self._b.quant:
+            from paddle_tpu.quantization.kv_cache import \
+                QuantMismatchError
+            raise QuantMismatchError(
+                f"migration payload carries quant recipe "
+                f"{meta.get('quant') or 'none'!r} but this engine's "
+                f"backend serves {self._b.quant or 'none'!r}")
+        have_axes = (dict(self._b.sharding.axes)
+                     if self._b.sharding is not None else None)
+        if meta.get("mesh_axes") != have_axes:
+            raise MeshMismatchError(
+                f"migration payload recorded mesh "
+                f"{meta.get('mesh_axes')} but this engine serves "
+                f"{have_axes}")
+        n = int(meta["rows"])
+        free = self.scheduler.slots.free_slots()
+        if len(free) < n:
+            raise RuntimeError(
+                f"absorb_rows needs {n} free slots, this engine has "
+                f"{len(free)} — migrate to a less-loaded worker")
+        npz = np.load(io.BytesIO(raw), allow_pickle=False)
+        now = time.monotonic()
+        mapping: Dict[int, int] = {}
+        if n:
+            lm = meta["leaves"]
+
+            def cache_tree(name, template):
+                tl, treedef = jax.tree_util.tree_flatten(template)
+                recorded = lm[name]
+                if len(recorded) != len(tl):
+                    raise SlabTransferError(
+                        f"migration payload cache layout mismatch: "
+                        f"{len(recorded)} {name} leaves recorded, "
+                        f"backend expects {len(tl)}", key=name)
+                return jax.tree_util.tree_unflatten(
+                    treedef,
+                    [jnp.asarray(_np_restore(npz[f"{name}_leaf_{i}"],
+                                             m["dtype"]))
+                     for i, m in enumerate(recorded)])
+
+            kc1 = cache_tree("kc", self.state.kc)
+            vc1 = cache_tree("vc", self.state.vc)
+            logits1 = jnp.asarray(
+                _np_restore(npz["logits"], lm["logits"]["dtype"]))
+            pos1 = _np_restore(npz["pos"], lm["pos"]["dtype"])
+            keys1 = _np_restore(npz["keys"], lm["keys"]["dtype"])
+            eos1 = _np_restore(npz["eos"], lm["eos"]["dtype"])
+            temp1 = _np_restore(npz["temp"], lm["temp"]["dtype"])
+            for sm in meta["slots"]:
+                j = int(sm["row"])
+                req = self._req_from_meta(sm["request"],
+                                          npz[f"row{j}_prompt"], now)
+                old_id = int(req.id)
+                req.id = self._next_id
+                self._next_id += 1
+                slot_idx = self.scheduler.slots.occupy(req)
+                # raw-key scatter: bypass _scatter's key derivation —
+                # the shipped key IS the row's live stream state
+                st = self.state
+                (logits, kc, vc, pos, keys, done, eos, temp) = \
+                    self._admit_fn(
+                        st.logits, st.kc, st.vc, st.pos, st.keys,
+                        st.done, st.eos, st.temp, logits1, kc1, vc1,
+                        jnp.asarray(slot_idx, jnp.int32),
+                        jnp.asarray(j, jnp.int32),
+                        jnp.asarray(pos1[j], jnp.int32),
+                        jnp.asarray(keys1[j], jnp.uint32),
+                        jnp.asarray(eos1[j], jnp.int32),
+                        jnp.asarray(temp1[j], jnp.float32))
+                self.state = dataclasses.replace(
+                    st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
+                    done=done, eos=eos, temp=temp)
+                slot = self.scheduler.slots.entries[slot_idx]
+                slot.admitted_at = now
+                slot.chunks = int(sm["chunks"])
+                slot.tokens = [np.asarray(npz[f"row{j}_piece{p}"])
+                               for p in range(int(sm["pieces"]))]
+                mapping[old_id] = req.id
+        for j, qm in enumerate(meta["queue"]):
+            req = self._req_from_meta(qm, npz[f"queue{j}_prompt"], now)
+            old_id = int(req.id)
+            req.id = self._next_id
+            self._next_id += 1
+            self.scheduler.push(req)
+            mapping[old_id] = req.id
+        self._g_qdepth.set(len(self.scheduler))
+        self._c_migrated_in.inc(len(mapping))
+        obs.tracer.event("serving.migrate.absorb", in_flight=n,
+                         queued=len(meta["queue"]))
+        return mapping
 
     # -- disaggregated prefill/decode (serving/cluster) --------------------
     def prefill_extract(self, prompt) -> Dict[str, Any]:
@@ -1694,7 +1971,8 @@ class ServingEngine:
             slot.events.extend(new)
 
     def _finish(self, slot, seq: np.ndarray, slot_idx: int,
-                deadline_expired: bool = False):
+                deadline_expired: bool = False,
+                corrupt_row: bool = False):
         from paddle_tpu.runtime.resilience import GenerateResult
         req = slot.request
         fin = time.monotonic()       # same clock as submit/admit stamps
@@ -1740,6 +2018,10 @@ class ServingEngine:
                 # the full budget) — the caller must be able to tell a
                 # deadline cut from a genuine EOS/budget finish
                 "deadline_expired": bool(deadline_expired),
+                # True when the finite guard cut this row: its logits
+                # went NaN/Inf and the engine froze it alone, returning
+                # the pre-corruption prefix
+                "corrupt_row": bool(corrupt_row),
             },
         }
         # the request's lifetime span (submit -> finished) on the same
@@ -1962,6 +2244,9 @@ class ServingEngine:
             "shed_backpressure": int(self._c_shed_backpressure.value),
             "shed_queue_deadline": int(self._c_shed_queue.value),
             "deadline_expired_rows": int(self._c_deadline_rows.value),
+            "corrupt_rows": int(self._c_corrupt_rows.value),
+            "rows_migrated_out": int(self._c_migrated_out.value),
+            "rows_migrated_in": int(self._c_migrated_in.value),
             "snapshots": int(self._c_snapshots.value),
             "snapshot_age_s": (
                 None if self._last_snapshot is None

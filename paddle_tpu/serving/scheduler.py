@@ -246,6 +246,20 @@ class Scheduler:
             out.append(heapq.heappop(self._heap)[2])
         return out
 
+    def remove(self, ids) -> List[Request]:
+        """Pop the queued requests whose ``id`` is in ``ids`` (admission
+        order), leaving every other entry in place with its original
+        sequence number — the migration export of a SUBSET of a live
+        worker's queue (``take_all`` is the everything-must-go case)."""
+        want = {int(i) for i in ids}
+        keep, out = [], []
+        for e in self._heap:
+            (out if e[2].id in want else keep).append(e)
+        if out:
+            self._heap = keep
+            heapq.heapify(self._heap)
+        return [e[2] for e in sorted(out, key=lambda e: (e[0], e[1]))]
+
     def admissions(self) -> List[Tuple[int, Request]]:
         """Fill every free slot from the queue; returns the
         ``(slot_index, request)`` pairs admitted this round. With

@@ -1,0 +1,500 @@
+"""Zero-downtime fleet operations (ISSUE 15).
+
+The load-bearing properties:
+- live row migration: ``extract_rows`` ships an in-flight request's
+  carry rows (logits / KV / pos / the LIVE RNG key) plus bookkeeping;
+  ``absorb_rows`` scatters them into a peer engine row-remapped — the
+  continuation is bit-exact for greedy AND for request-keyed sampling
+  (the raw key rides along, no re-derivation);
+- ownership leaves with the payload (exactly-once): the source
+  releases the slots / removes the queue entries before the payload is
+  returned, so a request can never be served by two engines at once;
+- every refusal is typed and happens BEFORE anything is scattered:
+  flipped payload bytes (``SlabTransferError``), quant-recipe mismatch
+  (``QuantMismatchError``), capacity overflow, unknown request ids;
+- the finite guard freezes ONLY a numerically poisoned row (partial,
+  flagged ``corrupt_row``) — peers in the same batch are untouched;
+- the chunked RPC channel verifies per-part sha256 with one typed
+  retry (``transfer_retries``) before refusing;
+- fleet-level (slow): live migration between worker PROCESSES,
+  rolling restart under load with zero lost requests, hot weight
+  reload with typed mixed-version refusal, and prefill-pool death
+  degrading to decode-side prefills — never a lost request.
+"""
+
+import io
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.elastic import ElasticManager
+from paddle_tpu.distributed.rpc import RpcAgent, _CHUNK_BYTES
+from paddle_tpu.inference.generate import LlamaDecoder
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.obs.exporter import ObsExporter
+from paddle_tpu.runtime.resilience import (SlabTransferError,
+                                           WeightVersionError)
+from paddle_tpu.serving import launch_cluster
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.scheduler import Request, Scheduler
+
+pytestmark = pytest.mark.serving
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=4, max_position_embeddings=64)
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+def _prompts(n=3, seed=5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, (int(rng.integers(3, 7)),)) for _ in
+            range(n)]
+
+
+def _run(eng, out=None):
+    """Step an engine until its queue and slots are empty."""
+    out = {} if out is None else out
+    while len(eng.scheduler) or list(eng.scheduler.slots.occupied()):
+        for rid, res in eng.step():
+            out[rid] = res
+    return out
+
+
+# -- fast: in-process extract/absorb ----------------------------------------
+
+def test_extract_absorb_roundtrip_greedy_bit_exact():
+    """A request migrated mid-flight between two live engines decodes
+    the SAME tokens as an undisturbed run: carry rows + host buffers
+    move as one payload, and ownership leaves the source with it."""
+    model = _model()
+    prompts = _prompts(3)
+    dec = LlamaDecoder(model, max_len=64)
+    solo = [np.asarray(dec.generate(p[None], 10)) for p in prompts]
+
+    src = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=4, chunk_size=3)
+    dst = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=4, chunk_size=3)
+    rids = [src.submit(p, max_new_tokens=10) for p in prompts]
+    done = {rid: res for rid, res in src.step()}   # rows mid-flight
+    victim = rids[1]
+    assert victim not in done
+    payload = src.extract_rows([victim])
+    assert payload["kind"] == "paddle_tpu.row_migration"
+    # exactly-once: the source no longer knows the request
+    with pytest.raises(ValueError, match="neither in a slot nor"):
+        src.extract_rows([victim])
+    mapping = dst.absorb_rows(payload)
+    assert set(mapping) == {victim}
+    _run(src, done)
+    done2 = _run(dst)
+    for i, rid in enumerate(rids):
+        got = done2[mapping[rid]] if rid == victim else done[rid]
+        np.testing.assert_array_equal(np.asarray(got), solo[i])
+
+
+def test_extract_absorb_sampled_stream_continues_bit_exact():
+    """The shipped row keeps its LIVE request-keyed RNG key: a sampled
+    stream migrated mid-flight continues exactly where the source left
+    it — same tokens as the undisturbed sampled run."""
+    model = _model()
+    prompts = _prompts(3, seed=9)
+    ref = ServingEngine(LlamaDecoder(model, max_len=64), num_slots=4,
+                        chunk_size=3, do_sample=True,
+                        request_keyed_rng=True)
+    ref_ids = [ref.submit(p, max_new_tokens=10, temperature=0.8,
+                          seed=7, rng_request_id=i)
+               for i, p in enumerate(prompts)]
+    ref_out = _run(ref)
+    want = [np.asarray(ref_out[r]) for r in ref_ids]
+
+    src = ServingEngine(LlamaDecoder(model, max_len=64), num_slots=4,
+                        chunk_size=3, do_sample=True,
+                        request_keyed_rng=True)
+    dst = ServingEngine(LlamaDecoder(model, max_len=64), num_slots=4,
+                        chunk_size=3, do_sample=True,
+                        request_keyed_rng=True)
+    rids = [src.submit(p, max_new_tokens=10, temperature=0.8, seed=7,
+                       rng_request_id=i)
+            for i, p in enumerate(prompts)]
+    done = {rid: res for rid, res in src.step()}
+    victim = rids[2]
+    assert victim not in done
+    mapping = dst.absorb_rows(src.extract_rows([victim]))
+    _run(src, done)
+    done2 = _run(dst)
+    for i, rid in enumerate(rids):
+        got = done2[mapping[rid]] if rid == victim else done[rid]
+        np.testing.assert_array_equal(np.asarray(got), want[i])
+
+
+def test_extract_moves_queued_request():
+    """A still-QUEUED request ships as prompt + metadata (no carry
+    rows) and re-enters the destination's queue."""
+    model = _model()
+    p0, p1 = _prompts(2, seed=3)
+    dec = LlamaDecoder(model, max_len=64)
+    want = np.asarray(dec.generate(p1[None], 8))
+    src = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=1, chunk_size=4)
+    dst = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=1, chunk_size=4)
+    src.submit(p0, max_new_tokens=8)
+    queued = src.submit(p1, max_new_tokens=8)
+    src.step()                       # slot 0 busy; ``queued`` waits
+    payload = src.extract_rows([queued])
+    assert payload["meta"]["rows"] == 0
+    assert len(payload["meta"]["queue"]) == 1
+    mapping = dst.absorb_rows(payload)
+    out = _run(dst)
+    np.testing.assert_array_equal(np.asarray(out[mapping[queued]]),
+                                  want)
+    assert len(src.scheduler) == 0   # the source queue entry is gone
+
+
+def test_extract_unknown_id_refused_untouched():
+    model = _model()
+    eng = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=2, chunk_size=4)
+    rid = eng.submit(_prompts(1)[0], max_new_tokens=6)
+    with pytest.raises(ValueError, match="neither in a slot nor"):
+        eng.extract_rows([rid, 999])
+    # the known id was NOT released by the refused call
+    assert eng.extract_rows([rid])["meta"]["queue"]
+
+
+def test_absorb_refuses_corrupt_payload_typed():
+    """A flipped bit in the shipped npz fails the end-to-end sha256
+    and is refused BEFORE anything scatters into the live carry."""
+    model = _model()
+    src = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=2, chunk_size=3)
+    dst = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=2, chunk_size=3)
+    rid = src.submit(_prompts(1)[0], max_new_tokens=8)
+    src.step()
+    payload = src.extract_rows([rid])
+    data = bytearray(payload["data"])
+    data[len(data) // 2] ^= 0xFF
+    payload["data"] = bytes(data)
+    with pytest.raises(SlabTransferError) as ei:
+        dst.absorb_rows(payload)
+    assert ei.value.key == "row_migration"
+    assert not list(dst.scheduler.slots.occupied())
+
+
+def test_absorb_refuses_quant_mismatch_typed():
+    from paddle_tpu.quantization.kv_cache import QuantMismatchError
+    model = _model()
+    src = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=2, chunk_size=3)
+    dst = ServingEngine(LlamaDecoder(model, max_len=32, quant="int8wk"),
+                        num_slots=2, chunk_size=3, quant="int8wk")
+    rid = src.submit(_prompts(1)[0], max_new_tokens=8)
+    src.step()
+    with pytest.raises(QuantMismatchError, match="int8wk"):
+        dst.absorb_rows(src.extract_rows([rid]))
+
+
+def test_absorb_refuses_capacity_overflow():
+    model = _model()
+    prompts = _prompts(2, seed=4)
+    src = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=2, chunk_size=3)
+    dst = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=1, chunk_size=3)
+    rids = [src.submit(p, max_new_tokens=8) for p in prompts]
+    src.step()
+    dst.submit(prompts[0], max_new_tokens=8)
+    dst.step()                       # the only destination slot is busy
+    with pytest.raises(RuntimeError, match="free slots"):
+        dst.absorb_rows(src.extract_rows(rids))
+
+
+def test_finite_guard_freezes_only_the_corrupt_row():
+    """A NaN-poisoned KV row is frozen ALONE: its request returns the
+    pre-corruption prefix flagged ``corrupt_row``; the batch peer
+    finishes bit-exact."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    model = _model()
+    p0, p1 = _prompts(2, seed=6)
+    dec = LlamaDecoder(model, max_len=64)
+    solo0 = np.asarray(dec.generate(p0[None], 10))
+    solo1 = np.asarray(dec.generate(p1[None], 10))
+    eng = ServingEngine(LlamaDecoder(model, max_len=64),
+                        num_slots=2, chunk_size=3)
+    r0 = eng.submit(p0, max_new_tokens=10)
+    r1 = eng.submit(p1, max_new_tokens=10)
+    done = {rid: res for rid, res in eng.step()}
+    assert not done
+
+    def poison_row0(leaf):
+        idx = [slice(None)] * leaf.ndim
+        idx[leaf.ndim - 4] = 0       # the put_cache batch-axis rule
+        return leaf.at[tuple(idx)].set(jnp.nan)
+
+    st = eng.state
+    eng.state = dataclasses.replace(
+        st, kc=jax.tree_util.tree_map(poison_row0, st.kc))
+    _run(eng, done)
+    bad = done[r0].resilience["serving"]
+    assert bad["corrupt_row"] is True
+    got0 = np.asarray(done[r0])
+    assert got0.shape[1] < solo0.shape[1]          # honest partial
+    np.testing.assert_array_equal(got0, solo0[:, :got0.shape[1]])
+    ok = done[r1].resilience["serving"]
+    assert ok["corrupt_row"] is False
+    np.testing.assert_array_equal(np.asarray(done[r1]), solo1)
+
+
+def test_scheduler_remove_pops_subset_in_order():
+    s = Scheduler(num_slots=2)
+    for i in range(4):
+        s.push(Request(id=i, prompt=np.arange(4), max_new_tokens=4))
+    out = s.remove([2, 0])
+    assert [r.id for r in out] == [0, 2]
+    assert [r.id for r in s.queued()] == [1, 3]
+    assert s.remove([99]) == []
+
+
+# -- fast: chunked RPC per-part integrity -----------------------------------
+
+def test_rpc_chunked_part_sha_one_retry_then_typed_failure():
+    """A persistently corrupt ``{key}/part{i}`` store value mismatches
+    its header sha twice: one counted retry, then ``SlabTransferError``
+    naming the key and part. A torn read that heals on the retry is
+    fetched clean with ``transfer_retries == 1``."""
+    a0 = RpcAgent("sha0", 0, 2)
+    a1 = RpcAgent("sha1", 1, 2, host=a0.store.host, port=a0.store.port,
+                  is_master=False)
+    try:
+        payload = os.urandom(2 * _CHUNK_BYTES + 1024)   # 3 parts
+        a0._put("blob/heal", payload)
+        # torn read: part1 is corrupt ONCE, the retry reads it clean
+        clean_get = a0.store.get
+        state = {"fired": False}
+
+        def flaky_get(key):
+            v = clean_get(key)
+            if key == "blob/heal/part1" and not state["fired"]:
+                state["fired"] = True
+                return b"\x00" * len(v)
+            return v
+
+        a0.store.get = flaky_get
+        try:
+            before = a0.transfer_retries
+            assert a0._fetch("blob/heal", 10) == payload
+            assert a0.transfer_retries == before + 1
+        finally:
+            a0.store.get = clean_get
+        # real corruption: the stored bytes themselves are wrong
+        a0._put("blob/bad", payload)
+        part = payload[_CHUNK_BYTES:2 * _CHUNK_BYTES]
+        a0.store.set("blob/bad/part1", b"\xff" + part[1:])
+        with pytest.raises(SlabTransferError) as ei:
+            a0._fetch("blob/bad", 10)
+        assert ei.value.key == "blob/bad"
+        assert ei.value.part == 1
+    finally:
+        a0.shutdown()
+        a1.shutdown()
+
+
+# -- fast: health surfaces --------------------------------------------------
+
+def test_exporter_healthz_verdict():
+    ex = ObsExporter()
+    ok, payload = ex.healthz()
+    assert ok and payload == {"ok": True}   # no provider = serving
+    verdict = {"ok": True, "engine": "ready"}
+    ex.set_health_provider(lambda: verdict)
+    ok, payload = ex.healthz()
+    assert ok and payload["engine"] == "ready"
+    verdict["ok"] = False
+    ok, _ = ex.healthz()
+    assert not ok
+
+    def broken():
+        raise RuntimeError("probe exploded")
+
+    ex.set_health_provider(broken)
+    ok, payload = ex.healthz()
+    assert not ok and "probe exploded" in payload["error"]
+
+
+class _DictStore:
+    def __init__(self):
+        self.d = {}
+
+    def get(self, k):
+        return self.d.get(k)
+
+    def set(self, k, v):
+        # the real TCPStore encodes str values to bytes on the wire
+        self.d[k] = v.encode() if isinstance(v, str) else v
+
+
+def test_elastic_beat_age_tracks_staleness():
+    """``beat_age`` is the early-warning signal between "beating" and
+    "TTL-dead": seconds since the node's heartbeat value last changed
+    on THIS observer's monotonic clock."""
+    em = ElasticManager(_DictStore(), node_id="n0", heartbeat_s=30.0,
+                        ttl_s=60.0)
+    assert em.beat_age("ghost") is None
+    em._beat()
+    assert em.beat_age("n0") < 0.5
+    time.sleep(0.2)
+    assert em.beat_age("n0") >= 0.2
+    em._beat()                       # a fresh beat resets the age
+    assert em.beat_age("n0") < 0.2
+
+
+def test_fleet_error_types_carry_context():
+    e = WeightVersionError("mixed", src_version="sha256:aaa",
+                           dst_version="sha256:bbb")
+    assert isinstance(e, RuntimeError)
+    assert (e.src_version, e.dst_version) == ("sha256:aaa",
+                                              "sha256:bbb")
+    t = SlabTransferError("corrupt", key="k", part=3)
+    assert isinstance(t, RuntimeError)
+    assert (t.key, t.part) == ("k", 3)
+
+
+# -- slow: real worker processes --------------------------------------------
+
+def _cluster_reqs(model, n=4, seed=12, budget=(6, 12)):
+    rng = np.random.default_rng(seed)
+    dec = LlamaDecoder(model, max_len=48)
+    reqs = [(rng.integers(0, 64, (6,)), int(rng.integers(*budget)))
+            for _ in range(n)]
+    solo = [np.asarray(dec.generate(p[None], b)) for p, b in reqs]
+    return reqs, solo
+
+
+@pytest.mark.slow
+def test_cluster_live_migration_between_processes(tmp_path):
+    """Rows migrate between REAL worker processes mid-flight: bit-exact
+    continuation, the resilience record names the hop as a migration
+    (not a requeue), and the source keeps serving what stayed."""
+    model = _model()
+    reqs, solo = _cluster_reqs(model, n=4, seed=12)
+    with launch_cluster(model, str(tmp_path / "mig"), prefill=0,
+                        decode=2, max_len=48,
+                        engine_kw={"num_slots": 8, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=6.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, b) for p, b in reqs]
+        for _ in range(2):
+            router.step()
+        d0 = cl.handle("decode0")
+        on_d0 = [rid for rid in rids
+                 if router.outcome(rid) is None
+                 and router._tracked[rid].worker == d0.rank]
+        assert on_d0, "no in-flight rows on the migration source"
+        moved = router.migrate(on_d0, "decode0", "decode1")
+        assert moved == on_d0
+        router.drain(max_steps=500)
+        m = router.metrics()
+        for i, rid in enumerate(rids):
+            out = router.outcome(rid)
+            np.testing.assert_array_equal(np.asarray(out), solo[i])
+            if rid in moved:
+                rec = out.resilience["cluster"]
+                assert rec["migrations"] == ["decode1"]
+                assert rec["requeues"] == 0
+        assert m["migrations"] == len(moved)
+        assert m["worker_deaths"] == 0
+
+
+@pytest.mark.slow
+def test_cluster_rolling_restart_and_hot_reload(tmp_path):
+    """Every worker restarts while the fleet serves — zero lost
+    requests, bit-exact — then a staged weights file hot-reloads
+    through a second rolling restart: the fleet decodes the NEW
+    parameters afterwards."""
+    model = _model()
+    reqs, solo = _cluster_reqs(model, n=4, seed=13)
+    with launch_cluster(model, str(tmp_path / "roll"), prefill=0,
+                        decode=2, max_len=48,
+                        engine_kw={"num_slots": 8, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=6.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, b) for p, b in reqs]
+        for _ in range(2):
+            router.step()
+        assert router.in_flight() >= 1
+        report = router.rolling_restart()
+        assert sorted(r["name"] for r in report["restarted"]) == \
+            ["decode0", "decode1"]
+        router.drain(max_steps=500)
+        for i, rid in enumerate(rids):
+            np.testing.assert_array_equal(
+                np.asarray(router.outcome(rid)), solo[i])
+        m = router.metrics()
+        assert m["rolling_restarts"] == 2
+        assert m["worker_deaths"] == 0
+
+        # hot reload: stage new weights -> rolling restart IS the swap
+        model2 = _model(seed=1)
+        cl.stage_weights(model2)
+        versions_v1 = [h.weights_version for h in router.workers]
+        report2 = router.rolling_restart()
+        assert len(report2["restarted"]) == 2
+        versions_v2 = [h.weights_version for h in router.workers]
+        assert all(v2 and v2 not in versions_v1 for v2 in versions_v2)
+        assert len(set(versions_v2)) == 1      # whole fleet on v2
+        p, b = reqs[0]
+        want2 = np.asarray(
+            LlamaDecoder(model2, max_len=48).generate(p[None], b))
+        rid2 = router.submit(p, b)
+        router.drain(max_steps=500)
+        np.testing.assert_array_equal(
+            np.asarray(router.outcome(rid2)), want2)
+
+
+@pytest.mark.slow
+def test_cluster_prefill_pool_death_degrades_to_decode_prefill(
+        tmp_path):
+    """SIGKILL the ONLY prefill worker mid-run: later admissions fall
+    back to decode-side prefills (counted), and every request —
+    admitted before and after the death — finishes bit-exact."""
+    model = _model()
+    reqs, solo = _cluster_reqs(model, n=4, seed=14)
+    with launch_cluster(model, str(tmp_path / "pfdeath"), prefill=1,
+                        decode=1, max_len=48,
+                        engine_kw={"num_slots": 8, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=2.0,
+                        heartbeat_miss_threshold=1,
+                        rpc_timeout_s=5.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, b) for p, b in reqs[:2]]
+        assert router.metrics()["disaggregated_admissions"] >= 1
+        router.step()
+        cl.kill("prefill0")
+        # submit BEFORE the router notices the death: the prefill RPC
+        # to the corpse fails, strikes it, and the admission degrades
+        # to a decode-side prefill — the counted fallback path
+        rids += [router.submit(p, b) for p, b in reqs[2:]]
+        router.drain(max_steps=500)
+        m = router.metrics()
+        for i, rid in enumerate(rids):
+            out = router.outcome(rid)
+            assert out is not None and not isinstance(out,
+                                                      BaseException), \
+                f"request {i} lost to the prefill-pool death: {out!r}"
+            np.testing.assert_array_equal(np.asarray(out), solo[i])
+        assert m["disaggregation_fallbacks"] >= 1
+        assert m["states"]["decode0"] == "healthy"

@@ -41,6 +41,17 @@ Classes swept (decode + checkpoint + bundle + elastic + serving paths):
                         flowing) -> the frontend's step future times
                         out, the breaker opens as a dead socket, the
                         hung worker's work requeues bit-exactly
+  migrate_mid_handoff_kill  the migration SOURCE is SIGKILLed between
+                        extraction and absorb (REAL OS kill via the
+                        _on_extracted drill hook) -> the destination
+                        wins: ownership left the source with the
+                        payload, so the later death requeues NOTHING
+                        (exactly-once) and every request completes
+                        bit-exact with zero replays
+  rolling_restart_under_load  rolling_restart() cycles every worker of
+                        a serving cluster mid-run -> in-flight rows
+                        live-migrate to the peer and back, zero worker
+                        deaths, zero lost requests, all bit-exact
 
 Prints one human line per class to stderr and ONE parseable JSON line
 to stdout (the bench.py last-line contract); exit code 0 iff all pass.
@@ -410,6 +421,89 @@ def drill_frontend_rpc_timeout(tmp):
             f"requeued, all bit-exact")
 
 
+def drill_migrate_mid_handoff_kill(tmp):
+    import numpy as np
+    from paddle_tpu.serving import launch_cluster
+    model, reqs, solo = _cluster_workload(n=4, seed=10)
+    with launch_cluster(model, os.path.join(tmp, "handoff_cluster"),
+                        prefill=0, decode=2, max_len=48,
+                        engine_kw={"num_slots": 4, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=2.0,
+                        heartbeat_miss_threshold=1,
+                        rpc_timeout_s=60.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, n) for p, n in reqs]
+        for _ in range(2):                   # rows genuinely mid-flight
+            router.step()
+        d0 = cl.handle("decode0")
+        on_d0 = [rid for rid in rids
+                 if router.outcome(rid) is None
+                 and router._tracked[rid].worker == d0.rank]
+        assert on_d0, "no in-flight rows on the migration source"
+        # SIGKILL the source the instant the payload has left it — the
+        # race the exactly-once ledger discipline exists for
+        moved = router.migrate(on_d0, "decode0", "decode1",
+                               _on_extracted=lambda: cl.kill("decode0"))
+        assert moved == on_d0, (moved, on_d0)
+        # wait for the FRONTEND OBSERVER's TTL to expire the corpse (a
+        # fixed sleep races the observer clock: the elastic sweep may
+        # first notice the final beat well after the kill)
+        deadline = time.monotonic() + 30.0
+        while "decode0" in set(router.elastic.members):
+            assert time.monotonic() < deadline, \
+                "TTL never expired the SIGKILLed source"
+            time.sleep(0.1)
+        router.step()                        # the sweep declares it dead
+        router.drain()
+        m = router.metrics()
+    for i, rid in enumerate(rids):
+        out = router.outcome(rid)
+        assert out is not None and not isinstance(out, BaseException), \
+            f"request {i} lost in the migration handoff: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged after the mid-handoff kill"
+    assert m["states"]["decode0"] == "dead", m
+    assert m["migrations"] == len(on_d0), m
+    # the destination won: the source's death found NOTHING to requeue
+    assert m["requeued"] == 0, \
+        f"migrated rows were double-requeued off the corpse: {m}"
+    return (f"source SIGKILLed mid-handoff, destination won "
+            f"({len(on_d0)} rows), 0 requeues, all bit-exact")
+
+
+def drill_rolling_restart_under_load(tmp):
+    import numpy as np
+    from paddle_tpu.serving import launch_cluster
+    model, reqs, solo = _cluster_workload(n=4, seed=11)
+    with launch_cluster(model, os.path.join(tmp, "rolling_cluster"),
+                        prefill=0, decode=2, max_len=48,
+                        engine_kw={"num_slots": 4, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=6.0,
+                        rpc_timeout_s=60.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, n) for p, n in reqs]
+        for _ in range(2):                   # rows genuinely mid-flight
+            router.step()
+        assert router.in_flight() >= 1, "workload drained too early"
+        report = router.rolling_restart()
+        router.drain()
+        m = router.metrics()
+    assert len(report["restarted"]) == 2, report
+    for i, rid in enumerate(rids):
+        out = router.outcome(rid)
+        assert out is not None and not isinstance(out, BaseException), \
+            f"request {i} lost across the rolling restart: {out!r}"
+        assert np.array_equal(np.asarray(out), solo[i]), \
+            f"request {i} diverged across the rolling restart"
+    assert m["rolling_restarts"] == 2, m
+    assert m["worker_deaths"] == 0, \
+        f"a rolling restart leg was counted as a death: {m}"
+    assert m["migrations"] >= 1, \
+        f"the restart never live-migrated a row: {m}"
+    return (f"both workers restarted under load ({m['migrations']} "
+            f"rows migrated, 0 deaths), all bit-exact")
+
+
 def main():
     import tempfile
 
@@ -427,6 +521,10 @@ def main():
         ("snapshot_torn_write", drill_snapshot_torn_write, True),
         ("worker_process_kill", drill_worker_process_kill, True),
         ("frontend_rpc_timeout", drill_frontend_rpc_timeout, True),
+        ("migrate_mid_handoff_kill", drill_migrate_mid_handoff_kill,
+         True),
+        ("rolling_restart_under_load", drill_rolling_restart_under_load,
+         True),
     ]
     results = {}
     ok = True

@@ -70,6 +70,15 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      a typed error), the per-worker dispatch split and the per-worker-
      labelled fleet /metrics are hard-asserted inside the bench
 
+ 15. serve_rolling (`bench.py --serve --cluster prefill:1,decode:2
+     --rolling-restart`): the zero-downtime fleet-operations gate —
+     live DecodeState migration between worker processes, a proactive
+     SUSPECT evacuation off a stale heartbeat, a rolling restart of
+     every worker under load, and a hot weight reload with the typed
+     mixed-version migration refusal; greedy AND request-keyed sampled
+     bit-exactness, zero lost requests and zero worker deaths are
+     hard-asserted inside the bench
+
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
 """
@@ -144,6 +153,17 @@ STEPS = [
     ("serve_cluster", [sys.executable, "bench.py", "--serve",
                        "--cluster", "prefill:1,decode:2", "--faults"],
      None),
+    # zero-downtime fleet-operations gate: live DecodeState migration
+    # between worker processes, a proactive SUSPECT evacuation off a
+    # stale (not dead) heartbeat, a rolling restart of EVERY worker
+    # while the fleet keeps serving, and a hot weight reload with the
+    # typed mixed-version migration refusal — greedy AND request-keyed
+    # sampled streams must stay bit-exact vs undisturbed runs, with
+    # zero lost accepted requests and zero worker deaths (rc != 0 on
+    # any violation, all hard-asserted inside the bench)
+    ("serve_rolling", [sys.executable, "bench.py", "--serve",
+                       "--cluster", "prefill:1,decode:2",
+                       "--rolling-restart"], None),
 ]
 
 
