@@ -2164,6 +2164,109 @@ def bench_serve_rolling(spec="prefill:1,decode:2", n_requests=None,
     return line
 
 
+def bench_serve_frontend_failover(spec="prefill:1,decode:2",
+                                  n_requests=None, slots=None,
+                                  chunk=None):
+    """``--serve --cluster prefill:1,decode:2 --kill-frontend``: the
+    control-plane-SPOF gate — REAL OS processes end to end. The store
+    daemon hosts the rendezvous, the frontend runs as its own process
+    with a durable WAL, and mid-run — with at least 2 requests in
+    flight AND 2 queued — it is SIGKILLed. A respawned frontend
+    (``resume_wal=...``) must recover EVERY accepted request (resumed
+    in place or ledger-replayed, counted separately) bit-exact vs an
+    undisturbed run, and a zombie op stamped with the dead
+    incarnation's epoch must be refused typed (``StaleEpochError``).
+    Two passes: greedy, and request-keyed sampled (the RNG resume
+    point rides the WAL)."""
+    import os
+    import tempfile
+
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import parse_cluster_spec
+    from paddle_tpu.serving.cluster.frontend_proc import \
+        run_frontend_failover_drill
+
+    roles = parse_cluster_spec(spec)
+    prefill = roles["prefill"]
+    decode = roles["decode"] + roles["unified"]
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    n_req = n_requests or 8
+    slots = slots or 2
+    chunk = chunk or 4
+    workdir = tempfile.mkdtemp(prefix="bench_ffo_")
+    passes = {}
+    for label, sampled in (("greedy", False), ("sampled", True)):
+        t0 = time.perf_counter()
+        base = run_frontend_failover_drill(
+            model, os.path.join(workdir, f"{label}_base"),
+            prefill=prefill, decode=decode, n_requests=n_req,
+            kill=False, sampled=sampled, num_slots=slots,
+            chunk_size=chunk)
+        killed = run_frontend_failover_drill(
+            model, os.path.join(workdir, f"{label}_kill"),
+            prefill=prefill, decode=decode, n_requests=n_req,
+            kill=True, sampled=sampled, num_slots=slots,
+            chunk_size=chunk)
+        wall = time.perf_counter() - t0
+        ready = killed["ready"]
+        assert ready["occupied"] >= 2 and ready["queued"] >= 2, \
+            f"{label}: the SIGKILL window had too little live work " \
+            f"(occupied={ready['occupied']}, queued={ready['queued']})"
+        assert killed["zombie_error"] == "StaleEpochError", \
+            f"{label}: zombie frontend not fenced typed " \
+            f"({killed['zombie_error']!r})"
+        rep = killed["recovery"]
+        accounted = (rep["finished_in_wal"] + rep["finished_in_gap"]
+                     + rep["resumed"] + rep["replayed"])
+        assert accounted == len(base["outcomes"]), \
+            f"{label}: recovery lost requests: {rep} vs " \
+            f"{len(base['outcomes'])} accepted"
+        lost = sum(1 for o in killed["outcomes"].values()
+                   if "unresolved" in o or "error" in o)
+        assert lost == 0, \
+            f"{label}: {lost} accepted requests lost to the frontend " \
+            f"kill: {killed['outcomes']}"
+        mismatched = [tag for tag, out in base["outcomes"].items()
+                      if killed["outcomes"].get(tag) != out]
+        assert not mismatched, \
+            f"{label}: {len(mismatched)} requests diverged across the " \
+            f"frontend failover: {mismatched}"
+        passes[label] = {
+            "requests": len(base["outcomes"]),
+            "bit_exact": len(base["outcomes"]), "lost": 0,
+            "killed_with_inflight": ready["occupied"],
+            "killed_with_queued": ready["queued"],
+            "epoch_before": ready["epoch"],
+            "epoch_after": killed["epoch"],
+            "resumed_in_place": rep["resumed"],
+            "replayed": rep["replayed"],
+            "finished_in_wal": rep["finished_in_wal"],
+            "finished_in_gap": rep["finished_in_gap"],
+            "wal_records": rep["wal_records"],
+            "zombie_fenced": killed["zombie_error"],
+            "wall_s": round(wall, 3),
+        }
+        print(f"serve-frontend-failover[{label}]: SIGKILL at "
+              f"occupied={ready['occupied']}/queued={ready['queued']}, "
+              f"epoch {ready['epoch']} -> {killed['epoch']}, "
+              f"{rep['resumed']} resumed + {rep['replayed']} replayed "
+              f"+ {rep['finished_in_gap']} finished-in-gap, "
+              f"{len(base['outcomes'])} bit-exact, zombie fenced "
+              f"typed ({wall:.1f}s)", file=sys.stderr)
+    line = _emit("serving_frontend_failover_recovered",
+                 float(passes["greedy"]["resumed_in_place"]
+                       + passes["greedy"]["replayed"]
+                       + passes["greedy"]["finished_in_gap"]),
+                 "requests")
+    line["serve_frontend_failover"] = {"spec": spec, **passes}
+    print(json.dumps(line))
+    return line
+
+
 def bench_serve_prefix(n_groups=None, slots=None, chunk=None, mesh=None):
     """``--serve --prefix-mix``: the prefix-cache serving benchmark.
 
@@ -2544,6 +2647,17 @@ def main():
                          "migration refusal; greedy AND request-keyed "
                          "sampled bit-exactness vs undisturbed runs "
                          "are hard-asserted in-bench")
+    ap.add_argument("--kill-frontend", action="store_true",
+                    help="with --serve --cluster: the control-plane-"
+                         "SPOF gate — SIGKILL the FRONTEND process "
+                         "mid-run with work in flight AND queued; a "
+                         "respawned frontend replays the durable WAL, "
+                         "re-adopts the workers and must recover every "
+                         "accepted request bit-exact (greedy AND "
+                         "request-keyed sampled), with the dead "
+                         "incarnation's epoch fenced typed "
+                         "(StaleEpochError) — all hard-asserted "
+                         "in-bench")
     ap.add_argument("--faults", action="store_true",
                     help="with --serve --replicas: inject the replica-"
                          "kill + delayed-heartbeat fault plan; with "
@@ -2595,6 +2709,14 @@ def main():
     except Exception as e:
         _emit_failure("backend_init", e)
         sys.exit(1)
+    if args.serve and args.cluster and args.kill_frontend:
+        _run_guarded("serve_frontend_failover",
+                     lambda: bench_serve_frontend_failover(
+                         spec=args.cluster,
+                         n_requests=args.serve_requests,
+                         slots=args.serve_slots,
+                         chunk=args.serve_chunk))
+        return
     if args.serve and args.cluster and args.rolling_restart:
         _run_guarded("serve_rolling", lambda: bench_serve_rolling(
             spec=args.cluster, n_requests=args.serve_requests,

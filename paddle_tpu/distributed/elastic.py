@@ -121,6 +121,24 @@ class ElasticManager:
         prev = self._seen.get(node_id)
         return None if prev is None else time.monotonic() - prev[1]
 
+    def wait_for(self, node_ids, timeout_s: float = 10.0) -> List[str]:
+        """Block until every node in ``node_ids`` is alive on THIS
+        observer's clock (a fresh observer starts with an empty
+        ``_seen`` table — a respawned frontend must wait one beat per
+        worker before judging liveness). Returns the alive set; raises
+        ``TimeoutError`` naming the stragglers."""
+        want = {str(n) for n in node_ids}
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            alive = set(self._alive_nodes())
+            if want <= alive:
+                return sorted(alive)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"nodes {sorted(want - alive)} not alive within "
+                    f"{timeout_s:.1f}s (alive: {sorted(alive)})")
+            time.sleep(min(0.05, self.heartbeat_s / 4))
+
     def status(self) -> str:
         n = len(self._members)
         if n < self.np_min:

@@ -147,7 +147,9 @@ class RpcAgent:
                        + json.dumps({"n": n, "sha": sha}).encode())
 
     def _fetch(self, key: str, timeout: float) -> bytes:
-        from paddle_tpu.runtime.resilience import SlabTransferError
+        from paddle_tpu.runtime.resilience import (SlabTransferError,
+                                                   classify_error,
+                                                   resilient_call)
         raw = self.store.wait(key, timeout=timeout)
         if not raw.startswith(_CHUNK_MAGIC):
             return raw
@@ -160,25 +162,71 @@ class RpcAgent:
         except ValueError:
             meta = json.loads(hdr)
             n, sha = int(meta["n"]), meta["sha"]
+
+        def _get_verified(i: int) -> bytes:
+            part = self.store.get(f"{key}/part{i}")
+            got = hashlib.sha256(part).hexdigest()
+            if got != sha[i]:
+                raise SlabTransferError(
+                    f"chunked transfer {key}/part{i} failed sha256 "
+                    f"verification ({got[:16]}… != {sha[i][:16]}…) — "
+                    f"refusing the corrupt payload", key=key, part=i)
+            return part
+
         parts = []
         for i in range(n):
-            part = self.store.get(f"{key}/part{i}")
-            if sha is not None \
-                    and hashlib.sha256(part).hexdigest() != sha[i]:
-                # one typed retry: a torn read re-fetches clean; real
-                # corruption (the stored bytes themselves are wrong)
-                # mismatches again and is refused typed
-                self.transfer_retries += 1
-                part = self.store.get(f"{key}/part{i}")
-                got = hashlib.sha256(part).hexdigest()
-                if got != sha[i]:
-                    raise SlabTransferError(
-                        f"chunked transfer {key}/part{i} failed sha256 "
-                        f"verification after retry ({got[:16]}… != "
-                        f"{sha[i][:16]}…) — refusing the corrupt "
-                        f"payload", key=key, part=i)
-            parts.append(part)
+            if sha is None:
+                parts.append(self.store.get(f"{key}/part{i}"))
+                continue
+            # one retry through the shared retry loop: a torn read
+            # re-fetches clean (counted here AND as a RetryEvent, so
+            # serving.cluster.slab_retries and resilience.retries
+            # agree); real corruption — the stored bytes themselves
+            # are wrong — mismatches again and the typed
+            # SlabTransferError propagates
+            parts.append(resilient_call(
+                _get_verified, i, retries=1, backoff=0.05, jitter=0.5,
+                site="distributed.rpc.chunk_fetch",
+                classify=lambda e, phase: (
+                    "transient" if isinstance(e, SlabTransferError)
+                    else classify_error(e, phase)),
+                on_event=self._count_transfer_retry))
         return b"".join(parts)
+
+    def _count_transfer_retry(self, _ev) -> None:
+        self.transfer_retries += 1
+
+    # -- partitionable sends ------------------------------------------------
+    def _send(self, peer: int, cnt_key: str, key_prefix: str, idx: int,
+              payload: bytes) -> None:
+        """One request/reply write, routed through the network-partition
+        fault sites: a ``rpc_partition`` plan DROPS the message (the
+        store never sees it — on this retransmit-free transport the
+        peer's serial stream stalls at the missing index, exactly a
+        partitioned link), ``rpc_delay`` delivers it from a background
+        timer, and ``rpc_duplicate`` delivers it twice under a FRESH
+        index so the receiver genuinely processes it again (duplicate
+        replies resolve no future; duplicate requests are executed —
+        worker-side submission dedupe is what keeps the fleet
+        exactly-once). Rules match directionally on (this rank, peer
+        rank), so asymmetric partitions are one-sided plans."""
+        from paddle_tpu.runtime.resilience import fault_injector
+        action, delay = ("ok", 0.0)
+        if fault_injector.active():
+            action, delay = fault_injector.rpc_action(str(self.rank),
+                                                      str(peer))
+        if action == "drop":
+            return
+        if action == "delay":
+            t = threading.Timer(delay, self._put,
+                                args=(f"{key_prefix}/{idx}", payload))
+            t.daemon = True
+            t.start()
+            return
+        self._put(f"{key_prefix}/{idx}", payload)
+        if action == "dup":
+            idx2 = self.store.add(cnt_key, 1)
+            self._put(f"{key_prefix}/{idx2}", payload)
 
     # -- client ------------------------------------------------------------
     def call(self, to, fn: Callable, args=(), kwargs=None,
@@ -189,7 +237,7 @@ class RpcAgent:
             seq = self.store.add(f"rpc/cnt/{dst}", 1)
             self._next_reply[(dst, seq)] = fut  # noqa: consumed by _collect
         payload = pickle.dumps((self.rank, seq, fn, args, kwargs or {}))
-        self._put(f"rpc/req/{dst}/{seq}", payload)
+        self._send(dst, f"rpc/cnt/{dst}", f"rpc/req/{dst}", seq, payload)
         return fut
 
     def _collect(self):
@@ -237,7 +285,8 @@ class RpcAgent:
                      RuntimeError(f"rpc result not picklable: {e}")))
             # reply stream is indexed by the CALLER's arrival order
             ridx = self.store.add(f"rpc/rescnt/{src}", 1)
-            self._put(f"rpc/res/{src}/{ridx}", payload)
+            self._send(src, f"rpc/rescnt/{src}", f"rpc/res/{src}",
+                       ridx, payload)
 
     def shutdown(self):
         self._stop.set()

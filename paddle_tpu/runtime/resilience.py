@@ -53,7 +53,7 @@ __all__ = [
     "RetryEvent", "DegradationEvent", "FaultEvent", "ReplicaEvent",
     "InjectedFault", "CorruptCheckpointError", "CorruptBundleError",
     "DecodeFailedError", "DeadlineExceededError", "ReplicaDeadError",
-    "SlabTransferError", "WeightVersionError",
+    "SlabTransferError", "WeightVersionError", "StaleEpochError",
     "classify_error", "resilient_call",
     "FaultInjector", "fault_injector", "atomic_write_bytes",
     "record_event", "drain_events", "recent_events",
@@ -271,6 +271,24 @@ class WeightVersionError(RuntimeError):
         self.dst_version = dst_version
 
 
+class StaleEpochError(RuntimeError):
+    """An RPC op carried a frontend epoch OLDER than the one this worker
+    has already stamped: the caller is a zombie incarnation of the
+    control plane — a frontend that was declared dead (and replaced)
+    but whose process is still issuing ops. The op is refused so a
+    zombie can never double-serve a request the new incarnation already
+    owns. Carries the op name and both epochs (note: only the message
+    survives an RPC pickle round-trip; the TYPE is the contract)."""
+
+    def __init__(self, message: str, op: Optional[str] = None,
+                 stale_epoch: Optional[int] = None,
+                 current_epoch: Optional[int] = None):
+        super().__init__(message)
+        self.op = op
+        self.stale_epoch = stale_epoch
+        self.current_epoch = current_epoch
+
+
 # ---------------------------------------------------------------------------
 # Transient / fatal classification
 # ---------------------------------------------------------------------------
@@ -327,6 +345,7 @@ def resilient_call(fn: Callable, *args,
                    retries: Optional[int] = None,
                    backoff: Optional[float] = None,
                    deadline_s: Optional[float] = None,
+                   jitter: float = 0.0,
                    phase: str = "steady",
                    site: str = "call",
                    classify: Optional[Callable] = None,
@@ -338,13 +357,16 @@ def resilient_call(fn: Callable, *args,
     Transient exceptions (see :func:`classify_error`; ``phase`` tunes the
     RESOURCE_EXHAUSTED rule) are retried up to ``retries`` times with
     exponential backoff ``backoff * 2**(i-1)`` seconds, bounded by
-    ``deadline_s`` of total elapsed time when given. Fatal exceptions —
-    and the last transient one once the budget is spent — propagate
-    unchanged, so callers keep the real error class. Each absorbed
-    failure emits a :class:`RetryEvent` to the process event log and to
-    ``on_event``. Defaults come from ``FLAGS_resilience_retries`` /
-    ``FLAGS_resilience_backoff_s`` / ``FLAGS_resilience_deadline_s``
-    (0 = no deadline)."""
+    ``deadline_s`` of total elapsed time when given. ``jitter > 0``
+    stretches each delay by a uniform factor in ``[1, 1+jitter)`` —
+    decorrelating the retry storms of many callers hitting the same
+    contended resource; the default 0 keeps schedules deterministic.
+    Fatal exceptions — and the last transient one once the budget is
+    spent — propagate unchanged, so callers keep the real error class.
+    Each absorbed failure emits a :class:`RetryEvent` to the process
+    event log and to ``on_event``. Defaults come from
+    ``FLAGS_resilience_retries`` / ``FLAGS_resilience_backoff_s`` /
+    ``FLAGS_resilience_deadline_s`` (0 = no deadline)."""
     if retries is None:
         retries = int(_flag("resilience_retries", 3))
     if backoff is None:
@@ -362,6 +384,9 @@ def resilient_call(fn: Callable, *args,
             if i >= attempts or classify(e, phase) != "transient":
                 raise
             delay = backoff * (2 ** (i - 1))
+            if jitter > 0:
+                import random
+                delay *= 1.0 + random.random() * float(jitter)
             if deadline_s is not None and \
                     (time.monotonic() - t0) + delay > deadline_s:
                 raise
@@ -405,6 +430,20 @@ class FaultInjector:
     - ``{"kind": "delay_heartbeat", "node": "*", "after_beats": 2,
        "skip_beats": 4}`` — suppress a window of beats, then resume
       (the stalled-but-alive member).
+    - ``{"kind": "rpc_partition", "src": "0", "dst": "1"}`` — DROP every
+      RPC message sent from rank ``src`` to rank ``dst`` (fnmatch
+      patterns on the rank strings). Directional: partitioning
+      ``0 -> 1`` says nothing about ``1 -> 0`` — give both rules for a
+      symmetric cut, one for the asymmetric half-partition. Default
+      unbounded (a SUSTAINED partition); bound with ``times``.
+    - ``{"kind": "rpc_delay", "src": "*", "dst": "2", "delay_s": 0.5}``
+      — deliver matching messages late (background timer) instead of
+      dropping them: the slow-link half of the partition taxonomy.
+    - ``{"kind": "rpc_duplicate", "src": "0", "dst": "*"}`` — deliver
+      matching messages TWICE (the duplicate rides a fresh sequence
+      number, so the receiver genuinely executes it again): the
+      at-least-once-transport drill that exactly-once submission
+      dedupe must absorb.
 
     Configure programmatically (``configure(plan)`` / ``clear()``) or
     via the ``PADDLE_TPU_FAULT_PLAN`` env var (a JSON list, read once at
@@ -542,6 +581,48 @@ class FaultInjector:
                 self._fire(path, rule, f"bit flipped at byte {at}")
                 return bytes(corrupted), False
         return data, False
+
+    def rpc_action(self, src: str, dst: str) -> Tuple[str, float]:
+        """Message-send-shaped injection point (``distributed/rpc.py``
+        routes every request/reply write through it). Returns
+        ``(action, delay_s)`` where action is ``"ok"`` (deliver),
+        ``"drop"`` (the partition eats the message), ``"delay"``
+        (deliver after ``delay_s``) or ``"dup"`` (deliver twice). The
+        first matching rule wins; rules match DIRECTIONALLY on the
+        (src, dst) rank strings, so asymmetric partitions are just
+        one-sided plans."""
+        self._maybe_load_env()
+        if not self._rules:
+            return "ok", 0.0
+        with self._lock:
+            for idx, rule in enumerate(self._rules):
+                kind = rule.get("kind")
+                if kind not in ("rpc_partition", "rpc_delay",
+                                "rpc_duplicate"):
+                    continue
+                if not fnmatch.fnmatchcase(str(src),
+                                           str(rule.get("src", "*"))):
+                    continue
+                if not fnmatch.fnmatchcase(str(dst),
+                                           str(rule.get("dst", "*"))):
+                    continue
+                times = rule.get("times")   # default: sustained
+                n = self._counts.get(idx, 0)
+                if times is not None and n >= int(times):
+                    continue
+                self._counts[idx] = n + 1
+                site = f"rpc:{src}->{dst}"
+                if kind == "rpc_partition":
+                    self._fire(site, rule, f"message {n + 1} dropped")
+                    return "drop", 0.0
+                if kind == "rpc_delay":
+                    d = float(rule.get("delay_s", 0.2))
+                    self._fire(site, rule,
+                               f"message {n + 1} delayed {d:.3f}s")
+                    return "delay", d
+                self._fire(site, rule, f"message {n + 1} duplicated")
+                return "dup", 0.0
+        return "ok", 0.0
 
     def heartbeat_action(self, node: str) -> str:
         """Heartbeat-shaped injection point: ``"ok"`` (beat normally),

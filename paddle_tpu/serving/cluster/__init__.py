@@ -23,6 +23,19 @@ over the repo's own control plane:
 - :mod:`launch` — spawns the worker pool (stdlib subprocess), ships the
   model weights once as an npz, waits for registration, returns a
   :class:`Cluster` handle with kill/respawn hooks for fault drills.
+  The TCPStore rendezvous lives in its own store-daemon process
+  (:mod:`store_daemon`), so the control plane's death no longer takes
+  the fleet's nervous system with it.
+- :mod:`wal` — the frontend's durable :class:`WriteAheadLog`: an
+  append-only, per-record-checksummed, segment-rotated log of every
+  request lifecycle transition. A respawned
+  ``ClusterRouter(resume_wal=...)`` replays it, re-adopts the live
+  workers under a fresh fencing epoch (stale incarnations are refused
+  typed ``StaleEpochError``), resumes rows the fleet still holds and
+  ledger-replays the rest — bit-exact, exactly-once.
+- :mod:`frontend_proc` — the frontend AS a process: the drill harness
+  that spawns store daemon + workers + a killable frontend child and
+  asserts zero-loss recovery across a frontend SIGKILL.
 
 Disaggregation: prefill workers run the admission prefill and EXTRACT
 the KV rows through the prefix-slab path (``engine.prefill_extract``);
@@ -40,6 +53,9 @@ from paddle_tpu.serving.cluster.launch import (  # noqa: F401
     launch_cluster,
     parse_cluster_spec,
 )
+from paddle_tpu.serving.cluster.wal import (  # noqa: F401
+    WriteAheadLog,
+)
 
 __all__ = ["ClusterRouter", "WorkerHandle", "Cluster", "launch_cluster",
-           "parse_cluster_spec"]
+           "parse_cluster_spec", "WriteAheadLog"]

@@ -61,6 +61,19 @@ Fleet operations (planned churn, not just crash recovery):
   marked suspect, stops taking submits, and its in-flight work is
   evacuated to peers BEFORE the TTL declares it dead.
 
+Control-plane resilience (the frontend's OWN death, PR 18): every
+request lifecycle transition lands in a durable WAL
+(``serving/cluster/wal.py``), the TCPStore rendezvous lives in its own
+store-daemon process, and each router incarnation claims a
+monotonically-increasing **frontend epoch** stamped on every RPC op —
+workers refuse older epochs typed (``StaleEpochError``), so a zombie
+incarnation can never double-serve. A respawned
+``ClusterRouter(resume_wal=...)`` replays the WAL, re-adopts the live
+workers (``adopt`` handshake), resumes rows the fleet still holds in
+place and ledger-replays the rest — bit-exact, exactly-once. Deadlines
+persist as REMAINING budget and rebase onto the new incarnation's
+monotonic clock.
+
 Fleet observability: ``start_exporter`` serves ONE /metrics that
 scrapes every live worker's own exporter at request time and
 concatenates the (per-worker-labelled) expositions after the
@@ -86,6 +99,7 @@ from paddle_tpu.runtime.resilience import (DeadlineExceededError,
                                            ReplicaDeadError, ReplicaEvent,
                                            WeightVersionError,
                                            record_event)
+from paddle_tpu.serving.cluster.wal import WriteAheadLog
 from paddle_tpu.serving.cluster.worker import worker_op
 
 __all__ = ["ClusterRouter", "WorkerHandle"]
@@ -142,6 +156,7 @@ class _Tracked:
     attempts: List[str] = dataclasses.field(default_factory=list)
     migrations: List[str] = dataclasses.field(default_factory=list)
     replayed_tokens: int = 0
+    tag: Optional[str] = None        # caller's correlation id (WAL'd)
 
 
 class ClusterRouter:
@@ -160,7 +175,9 @@ class ClusterRouter:
                  heartbeat_miss_threshold: int = 3,
                  recover: str = "replay",
                  respawn: Optional[Callable[[WorkerHandle], dict]] = None,
-                 suspect_after_s: Optional[float] = None):
+                 suspect_after_s: Optional[float] = None,
+                 wal_dir: Optional[str] = None,
+                 resume_wal: Optional[str] = None):
         if recover not in ("replay", "restart"):
             raise ValueError(
                 f"recover must be 'replay' or 'restart', got {recover!r}")
@@ -187,6 +204,16 @@ class ClusterRouter:
         self._errors: Dict[int, BaseException] = {}
         self._next_id = 0
         self._exporter = None
+        # frontend epoch: claim the next incarnation number on the
+        # shared store. Workers track the highest epoch stamped on any
+        # op and refuse older ones typed (StaleEpochError) — the fence
+        # that stops a zombie incarnation from double-serving. Routers
+        # over store-less (in-process fake) agents run unfenced at 0.
+        try:
+            self.epoch = int(self.agent.store.add(
+                "cluster/frontend/epoch", 1))
+        except Exception:
+            self.epoch = 0
         self.registry = MetricsRegistry()
         r = self.registry
         self._c_submitted = r.counter(
@@ -245,7 +272,38 @@ class ClusterRouter:
         self._g_healthy = r.gauge(
             "serving.cluster.healthy_workers", "workers taking traffic")
         self._g_healthy.set(len(self.workers))
+        self._g_epoch = r.gauge(
+            "serving.cluster.frontend_epoch",
+            "this router incarnation's fencing epoch (workers refuse "
+            "ops stamped with an older one)")
+        self._g_epoch.set(self.epoch)
+        self._g_wal_fsync = r.gauge(
+            "serving.cluster.wal_fsync_latency_s",
+            "duration of the WAL's most recent fsync")
+        self._c_wal_bytes = r.counter(
+            "serving.cluster.wal_bytes_written",
+            "bytes appended to the frontend write-ahead log")
         obs.flight_recorder.add_state("serving.cluster", self)
+
+        # durable request ledger: every lifecycle transition lands in
+        # the WAL (submits/finishes/requeues/migrations fsynced; the
+        # per-step token harvest group-commits one fsync per step), so
+        # a respawned incarnation rebuilds exact tracking state
+        self._wal: Optional[WriteAheadLog] = None
+        self._wal_tokens: Dict[int, int] = {}   # rid -> persisted count
+        self.recovery_report: Optional[Dict[str, Any]] = None
+        path = resume_wal or wal_dir
+        if path is not None:
+            self._wal = WriteAheadLog(path)
+            if self._wal.recovered and resume_wal is None:
+                raise ValueError(
+                    f"wal_dir {path!r} holds "
+                    f"{len(self._wal.recovered)} records from a "
+                    f"previous incarnation — pass resume_wal= to "
+                    f"recover them (or point wal_dir at a fresh "
+                    f"directory)")
+        if resume_wal is not None:
+            self._recover(self._wal.recovered)
 
     # -- pools -------------------------------------------------------------
     def _decode_pool(self, excluded: Set[int]) -> List[WorkerHandle]:
@@ -273,6 +331,10 @@ class ClusterRouter:
     # -- RPC ---------------------------------------------------------------
     def _call(self, h: WorkerHandle, op: str, *args,
               timeout: Optional[float] = None, **kwargs):
+        if self.epoch:
+            # stamp the fencing epoch on every op (a worker that has
+            # seen a newer incarnation refuses this one typed)
+            kwargs.setdefault("_epoch", self.epoch)
         fut = self.agent.call(h.rank, worker_op, (op,) + args, kwargs)
         return fut.wait(self.rpc_timeout_s if timeout is None
                         else timeout)
@@ -282,7 +344,8 @@ class ClusterRouter:
                eos_token_id: Optional[int] = None,
                temperature: float = 1.0, seed: int = 0,
                priority: int = 0, latency_class: str = "default",
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               tag: Optional[str] = None) -> int:
         """Route one request; returns the cluster request id. When a
         prefill pool exists the admission prefill runs THERE and ships
         to the decode worker as a slab (full prefix hit: zero decode
@@ -327,9 +390,11 @@ class ClusterRouter:
                 latency_class=str(latency_class),
                 deadline_at=(None if deadline_s is None
                              else now + float(deadline_s)),
-                worker=h.rank, engine_rid=erid, attempts=[h.name])
+                worker=h.rank, engine_rid=erid, attempts=[h.name],
+                tag=tag)
             self._by_engine[h.rank][erid] = rid
             self._c_submitted.inc()
+            self._wal_submit(self._tracked[rid])
             return rid
         raise last_shed
 
@@ -453,10 +518,15 @@ class ClusterRouter:
                 rid = self._by_engine[h.rank].get(int(erid))
                 if rid is not None:
                     self._tracked[rid].ledger = np.asarray(toks)
+                    self._wal_tokens_append(rid)
             for erid, kind, payload, resil in r.get("finished", []):
                 out = self._deliver(h, int(erid), kind, payload, resil)
                 if out is not None:
                     finished.append(out)
+        if self._wal is not None:
+            # group commit: the whole step's token harvest in one fsync
+            self._wal.sync()
+            self._sync_wal_stats()
         return finished
 
     def drain(self, max_steps: Optional[int] = None) -> Dict[int, Any]:
@@ -499,6 +569,7 @@ class ClusterRouter:
         t = self._tracked[rid]
         if kind == "error":
             self._errors[rid] = payload
+            self._wal_finish(rid, error=payload)
             return rid, payload
         if resil is not None:
             # attempts counts every worker that held the request;
@@ -512,6 +583,7 @@ class ClusterRouter:
         res = GenerateResult.wrap(np.asarray(payload), resil)
         self._results[rid] = res
         self._c_completed.inc()
+        self._wal_finish(rid, tokens=np.asarray(payload), resil=resil)
         return rid, res
 
     # -- health / recovery -------------------------------------------------
@@ -632,6 +704,7 @@ class ClusterRouter:
                 f"request {rid} deadline expired before requeue off "
                 f"dead worker {dead.name}", request_id=rid)
             self._errors[rid] = err
+            self._wal_finish(rid, error=err)
             finished.append((rid, err))
             return
         # fold the ledger into the prompt: the survivor teacher-forces
@@ -653,6 +726,7 @@ class ClusterRouter:
                 f"(excluded ranks {sorted(t.excluded)})",
                 replica=dead.name)
             self._errors[rid] = err
+            self._wal_finish(rid, error=err)
             finished.append((rid, err))
             return
         rem_deadline = (None if t.deadline_at is None
@@ -675,6 +749,7 @@ class ClusterRouter:
             except DeadlineExceededError as e:
                 self._c_shed_requeue.inc()
                 self._errors[rid] = e
+                self._wal_finish(rid, error=e)
                 finished.append((rid, e))
                 return
             except Exception as e:
@@ -685,6 +760,7 @@ class ClusterRouter:
             t.attempts.append(h.name)
             self._by_engine[h.rank][erid] = rid
             self._c_requeued.inc()
+            self._wal_requeue(t)
             record_event(ReplicaEvent(
                 site="serving.cluster", replica=h.name,
                 action="requeue",
@@ -696,6 +772,7 @@ class ClusterRouter:
             f"request {rid}: every requeue candidate failed",
             replica=dead.name)
         self._errors[rid] = err
+        self._wal_finish(rid, error=err)
         finished.append((rid, err))
 
     # -- fleet operations: migrate / evacuate / rolling restart ------------
@@ -809,6 +886,10 @@ class ClusterRouter:
             t.attempts.append(dst_h.name)
             t.migrations.append(dst_h.name)
             self._by_engine[dst_h.rank][mapping[erid]] = rid
+            self._wal_migrate(t, dst_h.name)
+        if self._wal is not None:
+            self._wal.sync()
+            self._sync_wal_stats()
         self._c_migrations.inc(len(rids))
         record_event(ReplicaEvent(
             site="serving.cluster", replica=src_h.name,
@@ -942,6 +1023,302 @@ class ClusterRouter:
         if delta > 0:
             self._c_slab_retries.inc(delta)
 
+    # -- durable WAL: lifecycle records + failover recovery ----------------
+    def _deadline_rem(self, t: _Tracked) -> Optional[float]:
+        """The deadline as REMAINING budget — the only form that
+        survives a frontend restart (``deadline_at`` is this process's
+        monotonic clock, meaningless in the next incarnation)."""
+        if t.deadline_at is None:
+            return None
+        return max(0.0, t.deadline_at - time.monotonic())
+
+    def _sync_wal_stats(self) -> None:
+        st = self._wal.stats()
+        self._g_wal_fsync.set(float(st["last_fsync_s"]))
+        delta = int(st["bytes_written"]) - int(self._c_wal_bytes.value)
+        if delta > 0:
+            self._c_wal_bytes.inc(delta)
+
+    def _wal_submit(self, t: _Tracked) -> None:
+        if self._wal is None:
+            return
+        self._wal.append({
+            "t": "submit", "rid": t.rid, "tag": t.tag,
+            "prompt": np.asarray(t.prompt).tolist(),
+            "max_new_tokens": int(t.max_new_tokens),
+            "eos_token_id": t.eos_token_id,
+            "temperature": float(t.temperature), "seed": int(t.seed),
+            "priority": int(t.priority),
+            "latency_class": t.latency_class,
+            "deadline_rem": self._deadline_rem(t),
+            "worker": int(t.worker), "engine_rid": int(t.engine_rid),
+        }, sync=True)
+        self._wal_tokens[t.rid] = 0
+        self._sync_wal_stats()
+
+    def _wal_tokens_append(self, rid: int) -> None:
+        """Persist the ledger tokens harvested since the last append
+        (UNSYNCED — ``step`` group-commits one fsync per iteration)."""
+        if self._wal is None:
+            return
+        t = self._tracked[rid]
+        done = self._wal_tokens.get(rid, 0)
+        if t.ledger.size <= done:
+            return
+        self._wal.append({
+            "t": "tokens", "rid": rid, "off": done,
+            "toks": t.ledger[done:].tolist(),
+            "deadline_rem": self._deadline_rem(t),
+        }, sync=False)
+        self._wal_tokens[rid] = int(t.ledger.size)
+
+    def _wal_requeue(self, t: _Tracked) -> None:
+        if self._wal is None:
+            return
+        self._wal.append({
+            "t": "requeue", "rid": t.rid, "worker": int(t.worker),
+            "engine_rid": int(t.engine_rid),
+            "prompt": np.asarray(t.prompt).tolist(),
+            "max_new_tokens": int(t.max_new_tokens),
+            "replayed_tokens": int(t.replayed_tokens),
+            "excluded": sorted(t.excluded),
+            "attempts": list(t.attempts),
+            "deadline_rem": self._deadline_rem(t),
+        }, sync=True)
+        self._wal_tokens[t.rid] = 0
+        self._sync_wal_stats()
+
+    def _wal_migrate(self, t: _Tracked, dst_name: str) -> None:
+        if self._wal is None:
+            return
+        self._wal.append({
+            "t": "migrate", "rid": t.rid, "worker": int(t.worker),
+            "engine_rid": int(t.engine_rid), "to": dst_name,
+        }, sync=False)
+
+    def _wal_finish(self, rid: int, tokens=None, resil=None,
+                    error: Optional[BaseException] = None) -> None:
+        if self._wal is None:
+            return
+        rec: Dict[str, Any] = {"t": "finish", "rid": rid}
+        if error is not None:
+            rec["etype"] = type(error).__name__
+            rec["error"] = str(error)[:500]
+        else:
+            rec["tokens"] = np.asarray(tokens).tolist()
+            try:
+                rec["resil"] = (None if resil is None else json.loads(
+                    json.dumps(resil, default=str)))
+            except Exception:
+                rec["resil"] = None
+        self._wal.append(rec, sync=True)
+        self._wal_tokens.pop(rid, None)
+        self._sync_wal_stats()
+
+    def close_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+
+    @staticmethod
+    def _rebuild_error(etype: str, msg: str,
+                       rid: int) -> BaseException:
+        """Re-materialize a WAL'd error outcome as its TYPED class (the
+        type is the contract clients dispatch on)."""
+        from paddle_tpu.runtime import resilience as _res
+        cls = getattr(_res, etype, None)
+        if cls is DeadlineExceededError:
+            return DeadlineExceededError(msg, request_id=rid)
+        if isinstance(cls, type) and issubclass(cls, BaseException):
+            try:
+                return cls(msg)
+            except Exception:
+                pass
+        return RuntimeError(f"{etype}: {msg}")
+
+    def _recover(self, records: List[Dict[str, Any]]) -> None:
+        """Rebuild the dead incarnation's tracking state from its WAL,
+        then reconcile it against the LIVE fleet: a request whose
+        worker survived the outage and still accounts for its engine
+        row RESUMES in place (delivery stays per-rid-once); one whose
+        worker died — or released the row — ledger-replays onto a
+        survivor, bit-exact, exactly-once. Deadlines rebase from the
+        persisted remaining budget onto THIS process's monotonic clock
+        (neither early-expired nor immortal). Finish records re-deliver
+        directly — the outcome already happened."""
+        now = time.monotonic()
+        rem_by_rid: Dict[int, Optional[float]] = {}
+        finished_in_wal = 0
+        for rec in records:
+            kind = rec["t"]
+            rid = int(rec["rid"])
+            if kind == "submit":
+                self._tracked[rid] = _Tracked(
+                    rid=rid,
+                    prompt=np.asarray(rec["prompt"], np.int64),
+                    max_new_tokens=int(rec["max_new_tokens"]),
+                    eos_token_id=rec.get("eos_token_id"),
+                    temperature=float(rec.get("temperature", 1.0)),
+                    seed=int(rec.get("seed", 0)),
+                    priority=int(rec.get("priority", 0)),
+                    latency_class=str(rec.get("latency_class",
+                                              "default")),
+                    deadline_at=None,
+                    worker=int(rec["worker"]),
+                    engine_rid=int(rec["engine_rid"]),
+                    tag=rec.get("tag"))
+                rem_by_rid[rid] = rec.get("deadline_rem")
+                continue
+            t = self._tracked.get(rid)
+            if kind == "tokens":
+                if t is None:
+                    continue
+                off = int(rec.get("off", 0))
+                toks = np.asarray(rec.get("toks", []), np.int64)
+                t.ledger = np.concatenate([t.ledger[:off], toks])
+                rem_by_rid[rid] = rec.get("deadline_rem")
+            elif kind == "requeue":
+                if t is None:
+                    continue
+                t.prompt = np.asarray(rec["prompt"], np.int64)
+                t.max_new_tokens = int(rec["max_new_tokens"])
+                t.replayed_tokens = int(rec.get("replayed_tokens", 0))
+                t.excluded = {int(x) for x in rec.get("excluded", [])}
+                t.attempts = list(rec.get("attempts", []))
+                t.worker = int(rec["worker"])
+                t.engine_rid = int(rec["engine_rid"])
+                t.ledger = np.zeros((0,), np.int64)
+                rem_by_rid[rid] = rec.get("deadline_rem")
+            elif kind == "migrate":
+                if t is None:
+                    continue
+                t.worker = int(rec["worker"])
+                t.engine_rid = int(rec["engine_rid"])
+                t.attempts.append(str(rec.get("to", "")))
+                t.migrations.append(str(rec.get("to", "")))
+            elif kind == "finish":
+                finished_in_wal += 1
+                if "etype" in rec:
+                    self._errors[rid] = self._rebuild_error(
+                        rec["etype"], rec.get("error", ""), rid)
+                else:
+                    self._results[rid] = GenerateResult.wrap(
+                        np.asarray(rec.get("tokens", []), np.int64),
+                        rec.get("resil"))
+        if self._tracked:
+            self._next_id = max(self._tracked) + 1
+            self._c_submitted.inc(len(self._tracked))
+        if self._results:
+            self._c_completed.inc(len(self._results))
+        for rid, rem in rem_by_rid.items():
+            t = self._tracked.get(rid)
+            if t is not None:
+                t.deadline_at = (None if rem is None
+                                 else now + max(0.0, float(rem)))
+        unresolved = [rid for rid in self._tracked
+                      if rid not in self._results
+                      and rid not in self._errors]
+        for rid in unresolved:
+            self._wal_tokens[rid] = int(self._tracked[rid].ledger.size)
+
+        # adopt the live fleet: wait for worker heartbeats to land on
+        # THIS observer's clock, then handshake each worker for the
+        # engine ids it still accounts for
+        try:
+            self.elastic.wait_for([h.name for h in self.workers],
+                                  timeout_s=10.0)
+        except Exception:
+            pass    # stragglers strike below and their work replays
+        sink: List[Tuple[int, Any]] = []
+        known_by_rank: Dict[int, Set[int]] = {}
+        for h in self.workers:
+            try:
+                info = self._call(h, "adopt")
+            except Exception as e:
+                self._strike(h, e, sink)
+                continue
+            h.queued = int(info.get("queued", 0))
+            h.occupied = int(info.get("occupied", 0))
+            known_by_rank[h.rank] = {int(x)
+                                     for x in info.get("known", [])}
+        resumed = replayed = finished_in_gap = 0
+        for rid in sorted(unresolved):
+            t = self._tracked[rid]
+            h = next((w for w in self.workers
+                      if w.rank == t.worker), None)
+            if (h is not None and h.state == "healthy"
+                    and t.engine_rid in known_by_rank.get(h.rank,
+                                                          set())):
+                self._by_engine[h.rank][t.engine_rid] = rid
+                try:
+                    res = self._call(h, "result", t.engine_rid)
+                except Exception as e:
+                    self._strike(h, e, sink)
+                    if self._by_engine[h.rank].get(
+                            t.engine_rid) == rid:
+                        # transient op failure on a live worker: stay
+                        # assigned, the serving loop resolves it
+                        self._c_resumed.inc()
+                        resumed += 1
+                    else:
+                        # the strike tripped the breaker and
+                        # _declare_dead already replayed every rid it
+                        # held, this one included
+                        replayed += 1
+                    continue
+                if res is not None:
+                    # finished during the control-plane outage: the
+                    # worker's results table already holds the outcome
+                    finished_in_gap += 1
+                    if isinstance(res, BaseException):
+                        self._deliver(h, t.engine_rid, "error", res,
+                                      None)
+                    else:
+                        self._deliver(h, t.engine_rid, "tokens",
+                                      res[0], res[1])
+                else:
+                    self._c_resumed.inc()
+                    resumed += 1
+                continue
+            # the worker is gone, or released the row (migration in
+            # flight when the frontend died): ledger-replay
+            dead = h if h is not None else WorkerHandle(
+                name=f"rank{t.worker}", rank=t.worker, role="decode",
+                pid=0, state="dead")
+            replayed += 1
+            self._requeue(rid, dead, ReplicaDeadError(
+                f"request {rid}: its worker (rank {t.worker}) did not "
+                f"survive the frontend failover",
+                replica=dead.name), sink, exclude=False)
+        self.recovery_report = {
+            "epoch": self.epoch,
+            "wal_records": len(records),
+            "finished_in_wal": finished_in_wal,
+            "finished_in_gap": finished_in_gap,
+            "resumed": resumed,
+            "replayed": replayed,
+            "unresolved": self.in_flight(),
+        }
+        record_event(ReplicaEvent(
+            site="serving.cluster", replica="frontend",
+            action="failover_recovered",
+            detail=f"epoch {self.epoch}: {len(records)} WAL records, "
+                   f"{resumed} resumed in place, {replayed} replayed, "
+                   f"{finished_in_gap} finished during the outage"))
+
+    def _health(self) -> Dict[str, Any]:
+        """Frontend /healthz verdict: 200 while a QUORUM of workers is
+        reachable and the WAL is writable, 503 otherwise."""
+        healthy = sum(1 for h in self.workers if h.state == "healthy")
+        quorum = len(self.workers) // 2 + 1
+        wal_ok = self._wal is None or self._wal.healthy()
+        return {"ok": healthy >= quorum and wal_ok,
+                "epoch": self.epoch,
+                "healthy_workers": healthy,
+                "workers": len(self.workers), "quorum": quorum,
+                "wal_ok": wal_ok,
+                "wal": (None if self._wal is None
+                        else self._wal.stats())}
+
     # -- fleet observability -----------------------------------------------
     def worker_metrics(self) -> Dict[str, dict]:
         """RPC metrics snapshot per live worker — the bench's
@@ -992,6 +1369,9 @@ class ClusterRouter:
         request accounting."""
         return {
             "recover": self.recover,
+            "epoch": self.epoch,
+            "wal": None if self._wal is None else self._wal.stats(),
+            "recovery": self.recovery_report,
             "workers": [{
                 "name": h.name, "rank": h.rank, "role": h.role,
                 "pid": h.pid, "state": h.state,
@@ -1042,6 +1422,9 @@ class ClusterRouter:
             "proactive_evacuations": int(self._c_proactive.value),
             "rolling_restarts": int(self._c_rolling.value),
             "slab_retries": int(self._c_slab_retries.value),
+            "frontend_epoch": self.epoch,
+            "wal_bytes_written": int(self._c_wal_bytes.value),
+            "wal": None if self._wal is None else self._wal.stats(),
         }
 
     def start_exporter(self, port: Optional[int] = None) -> int:
@@ -1060,6 +1443,7 @@ class ClusterRouter:
         exp.add_registry("cluster", self.registry)
         exp.add_status_provider("cluster", self.status)
         exp.add_text_provider("workers", self._scrape_worker_metrics)
+        exp.set_health_provider(self._health)
         for h in self.workers:
             exp.add_status_provider(
                 f"worker:{h.name}",

@@ -7,9 +7,13 @@ zero-to-cluster path:
    every worker rebuilds the identical parameters from it (and the
    caller's in-process reference decodes the same ones: the greedy-
    parity precondition);
-2. the frontend's master ``RpcAgent`` (rank 0) starts the TCPStore the
-   whole cluster shares — RPC streams, elastic heartbeats and
-   registration all ride it, no second control plane;
+2. a tiny store DAEMON process (``store_daemon.py``) hosts the
+   TCPStore the whole cluster shares — RPC streams, elastic
+   heartbeats and registration all ride it, no second control plane.
+   The frontend's rank-0 ``RpcAgent`` connects as a plain client, so
+   frontend SIGKILL no longer kills the rendezvous: workers keep
+   heartbeating and a respawned ``ClusterRouter(resume_wal=...)``
+   re-adopts them (see ``frontend_proc.py``);
 3. one OS process per worker (stdlib ``subprocess.Popen`` of
    ``python -m paddle_tpu.serving.cluster.worker``) with its whole
    config in the ``PADDLE_TPU_CLUSTER_CFG`` env JSON; the launcher
@@ -47,7 +51,8 @@ import numpy as np
 
 from paddle_tpu.serving.cluster.frontend import ClusterRouter, WorkerHandle
 
-__all__ = ["Cluster", "launch_cluster", "parse_cluster_spec"]
+__all__ = ["Cluster", "launch_cluster", "parse_cluster_spec",
+           "adopt_worker_handles"]
 
 
 def parse_cluster_spec(spec: str) -> Dict[str, int]:
@@ -77,7 +82,8 @@ class Cluster:
     def __init__(self, router: ClusterRouter, agent, elastic,
                  procs: Dict[int, subprocess.Popen],
                  configs: Dict[int, dict], spawn_timeout_s: float,
-                 workdir: Optional[str] = None, weights_seq: int = 1):
+                 workdir: Optional[str] = None, weights_seq: int = 1,
+                 store_proc: Optional[subprocess.Popen] = None):
         self.router = router
         self.agent = agent
         self.elastic = elastic
@@ -86,6 +92,7 @@ class Cluster:
         self._spawn_timeout_s = float(spawn_timeout_s)
         self.workdir = workdir
         self._weights_seq = int(weights_seq)
+        self.store_proc = store_proc
 
     # -- fault drills ------------------------------------------------------
     def handle(self, name: str) -> WorkerHandle:
@@ -163,8 +170,16 @@ class Cluster:
             if p.poll() is None:
                 p.kill()
         self.router.stop_exporter()
+        self.router.close_wal()
         self.elastic.stop()
         self.agent.shutdown()
+        # the rendezvous dies LAST: everything above still rides it
+        if self.store_proc is not None and self.store_proc.poll() is None:
+            self.store_proc.terminate()
+            try:
+                self.store_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.store_proc.kill()
 
     def __enter__(self) -> "Cluster":
         return self
@@ -203,6 +218,56 @@ def _wait_registered(store, rank: int, timeout_s: float,
     raise TimeoutError(
         f"cluster worker rank {rank} did not register within "
         f"{timeout_s:.0f}s")
+
+
+def _spawn_store_daemon(workdir: str, timeout_s: float = 30.0):
+    """Start the standalone TCPStore rendezvous process and block until
+    it publishes its port file. Returns ``(proc, host, port)``."""
+    from paddle_tpu.serving.cluster import store_daemon
+
+    port_file = os.path.join(workdir, "store_daemon.json")
+    try:
+        os.remove(port_file)
+    except FileNotFoundError:
+        pass
+    env = dict(os.environ)
+    env[store_daemon.ENV_CFG] = json.dumps(
+        {"port_file": port_file, "host": "127.0.0.1"})
+    proc = subprocess.Popen([sys.executable, store_daemon.__file__],
+                            env=env, cwd=os.getcwd())
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"store daemon exited with code {proc.returncode} "
+                f"before publishing its port")
+        if os.path.exists(port_file):
+            info = json.load(open(port_file))
+            return proc, info["host"], int(info["port"])
+        time.sleep(0.02)
+    proc.kill()
+    raise TimeoutError(
+        f"store daemon did not publish {port_file} within "
+        f"{timeout_s:.0f}s")
+
+
+def adopt_worker_handles(store, ranks) -> List[WorkerHandle]:
+    """Rebuild :class:`WorkerHandle`\\ s from the live registration keys
+    — the respawned frontend's view of the fleet it did not spawn.
+    Ranks whose registration is missing/blank are skipped (the caller
+    reconciles against the WAL's worker set)."""
+    handles: List[WorkerHandle] = []
+    for rank in sorted(int(r) for r in ranks):
+        raw = store.get(f"cluster/worker/{rank}")
+        if not raw:
+            continue
+        info = json.loads(raw.decode())
+        handles.append(WorkerHandle(
+            name=info["name"], rank=rank, role=info["role"],
+            pid=int(info["pid"]),
+            obs_port=int(info.get("obs_port", 0)),
+            weights_version=info.get("weights_version")))
+    return handles
 
 
 def launch_cluster(model, workdir: str, prefill: int = 1,
@@ -247,7 +312,9 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
     if not roles:
         raise ValueError("launch_cluster needs at least one worker")
     world = 1 + len(roles)
-    agent = RpcAgent("frontend", 0, world, port=0)
+    store_proc, store_host, store_port = _spawn_store_daemon(workdir)
+    agent = RpcAgent("frontend", 0, world, host=store_host,
+                     port=store_port, is_master=False)
     elastic = ElasticManager(agent.store, node_id="frontend",
                              np_range=f"1:{world}",
                              heartbeat_s=heartbeat_s,
@@ -272,8 +339,8 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
                 ekw["snapshot_dir"] = os.path.join(workdir,
                                                    f"snap_{name}")
         cfg = {"name": name, "rank": rank, "world_size": world,
-               "master_host": agent.store.host,
-               "master_port": agent.store.port,
+               "master_host": store_host,
+               "master_port": store_port,
                "role": role, "model": model_cfg, "weights": weights,
                "max_len": int(max_len), "quant": quant, "engine": ekw,
                "heartbeat_s": heartbeat_s, "ttl_s": ttl_s,
@@ -298,14 +365,18 @@ def launch_cluster(model, workdir: str, prefill: int = 1,
                 p.kill()
         elastic.stop()
         agent.shutdown()
+        if store_proc.poll() is None:
+            store_proc.kill()
         raise
 
     router = ClusterRouter(
         agent, handles, elastic, rpc_timeout_s=rpc_timeout_s,
         breaker_threshold=breaker_threshold,
         heartbeat_miss_threshold=heartbeat_miss_threshold,
-        recover=recover, suspect_after_s=suspect_after_s)
+        recover=recover, suspect_after_s=suspect_after_s,
+        wal_dir=os.path.join(workdir, "frontend_wal"))
     cluster = Cluster(router, agent, elastic, procs, configs,
-                      spawn_timeout_s, workdir=workdir, weights_seq=1)
+                      spawn_timeout_s, workdir=workdir, weights_seq=1,
+                      store_proc=store_proc)
     router._respawn = cluster.respawn
     return cluster

@@ -45,6 +45,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from paddle_tpu.runtime.resilience import StaleEpochError
+
 __all__ = ["WorkerHost", "worker_op", "main"]
 
 _HOST: Optional["WorkerHost"] = None
@@ -123,6 +125,19 @@ class WorkerHost:
             heartbeat_s=float(cfg.get("heartbeat_s", 0.5)),
             ttl_s=float(cfg.get("ttl_s", 3.0))).start()
 
+        # frontend-epoch fence: read (add 0) the shared monotonic epoch
+        # counter — each ClusterRouter incarnation claims the next value
+        # and stamps it on every op; this worker tracks the HIGHEST
+        # epoch it has seen and refuses anything older (a zombie
+        # frontend that was declared dead but keeps issuing ops).
+        self.frontend_epoch = int(
+            self.agent.store.add("cluster/frontend/epoch", 0))
+        # submit dedupe: (frontend rid, tokens already emitted) → engine
+        # rid, so a duplicated/ghost submit (rpc_duplicate drill, or a
+        # requeue whose original submit actually landed) never occupies
+        # a second slot with the same request
+        self._submit_seen: Dict[tuple, int] = {}
+
         # the worker's own pull telemetry: /metrics + /statusz, every
         # sample line labelled with the worker's name so the frontend
         # can concatenate N workers into one fleet exposition verbatim
@@ -143,6 +158,7 @@ class WorkerHost:
                         "rank": self.rank, "pid": os.getpid(),
                         "obs_port": self.obs_port,
                         "weights_version": self.weights_version,
+                        "epoch": self.frontend_epoch,
                         "resumed": bool(resume)}).encode())
 
     def _health(self) -> Dict[str, Any]:
@@ -156,6 +172,22 @@ class WorkerHost:
 
     # -- op dispatch -------------------------------------------------------
     def handle(self, name: str, *args, **kwargs):
+        epoch = kwargs.pop("_epoch", None)
+        if epoch is not None:
+            epoch = int(epoch)
+            if epoch < self.frontend_epoch:
+                # a zombie incarnation of the control plane: it was
+                # declared dead and replaced (a newer epoch already
+                # stamped an op here), but its process is still issuing
+                # ops — refuse typed so it can never double-serve
+                raise StaleEpochError(
+                    f"worker {self.name}: op {name!r} from stale "
+                    f"frontend epoch {epoch} refused (current epoch "
+                    f"{self.frontend_epoch}) — zombie frontend fenced",
+                    op=name, stale_epoch=epoch,
+                    current_epoch=self.frontend_epoch)
+            if epoch > self.frontend_epoch:
+                self.frontend_epoch = epoch
         fn = getattr(self, f"op_{name}", None)
         if fn is None:
             raise ValueError(f"worker {self.name}: unknown op {name!r}")
@@ -166,7 +198,21 @@ class WorkerHost:
                 "weights_version": self.weights_version}
 
     def op_submit(self, prompt, **kwargs) -> int:
-        return self.engine.submit(np.asarray(prompt), **kwargs)
+        key = None
+        rid = kwargs.get("rng_request_id")
+        if rid is not None:
+            key = (int(rid), int(kwargs.get("rng_tokens_emitted") or 0))
+            erid = self._submit_seen.get(key)
+            # the cached engine rid answers the duplicate ONLY while
+            # this engine still accounts for it — a row released by
+            # extract_rows (migrated away, then legitimately requeued
+            # back here) must fall through to a fresh submit
+            if erid is not None and erid in self.op_known():
+                return erid
+        erid = self.engine.submit(np.asarray(prompt), **kwargs)
+        if key is not None:
+            self._submit_seen[key] = erid
+        return erid
 
     def op_step(self) -> Dict[str, Any]:
         """One engine iteration. Ships (a) the finished outcomes —
@@ -207,6 +253,21 @@ class WorkerHost:
             ids.add(int(req.id))
         return ids
 
+    def op_adopt(self) -> Dict[str, Any]:
+        """The respawned frontend's reconciliation handshake: everything
+        it needs to fold this live worker back under management —
+        identity, the engine ids this incarnation can still account for
+        (WAL rows matching one RESUME in place; the rest ledger-replay),
+        and current load."""
+        sch = self.engine.scheduler
+        return {"name": self.name, "role": self.role,
+                "rank": self.rank, "pid": os.getpid(),
+                "epoch": self.frontend_epoch,
+                "weights_version": self.weights_version,
+                "known": sorted(self.op_known()),
+                "queued": len(sch),
+                "occupied": len(sch.slots.occupied())}
+
     def op_prefill(self, prompt) -> Dict[str, Any]:
         return self.engine.prefill_extract(np.asarray(prompt))
 
@@ -245,6 +306,7 @@ class WorkerHost:
         return {"name": self.name, "role": self.role, "rank": self.rank,
                 "pid": os.getpid(), "obs_port": self.obs_port,
                 "weights_version": self.weights_version,
+                "frontend_epoch": self.frontend_epoch,
                 "engine": self.engine.status()}
 
     def op_stall(self, seconds: float) -> bool:

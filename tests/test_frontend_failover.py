@@ -1,0 +1,483 @@
+"""Control-plane failover: WAL durability, recovery semantics, epoch
+fencing, and network partitions.
+
+Fast tests cover the WriteAheadLog recovery discipline (round-trip,
+segment rotation, torn-tail truncate-and-recover, mid-file typed
+refusal), the ``rpc_partition``/``rpc_delay``/``rpc_duplicate`` fault
+rules in isolation, ``StaleEpochError``'s contract, and — through an
+in-process fake fleet — ``ClusterRouter(resume_wal=...)``'s replay of
+a dead incarnation's WAL: resume-in-place vs ledger-replay, the
+deadline REBASE regression (a persisted remaining budget neither
+expires early nor becomes immortal on the new incarnation's clock),
+and finished-outcome restoration.
+
+Slow tests run the real thing: a frontend OS process SIGKILLed
+mid-serve with work in flight AND queued, its successor recovering
+every accepted request bit-exactly, a zombie op fenced typed, and an
+asymmetric network partition drill over a live cluster.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.runtime import resilience as res
+from paddle_tpu.runtime.resilience import (CorruptCheckpointError,
+                                           DeadlineExceededError,
+                                           ReplicaDeadError,
+                                           StaleEpochError,
+                                           fault_injector)
+from paddle_tpu.serving.cluster.frontend import ClusterRouter, WorkerHandle
+from paddle_tpu.serving.cluster.wal import WriteAheadLog
+
+pytestmark = pytest.mark.serving
+
+CFG = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=4, max_position_embeddings=64)
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+# -- fast: WAL recovery discipline ------------------------------------------
+
+def test_wal_round_trip_and_rotation(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, segment_bytes=200)
+    for i in range(10):
+        w.append({"t": "submit", "rid": i, "prompt": np.arange(3)},
+                 sync=(i % 2 == 0))
+    st = w.stats()
+    assert st["segments"] > 1           # rotation actually happened
+    assert st["fsyncs"] >= 5
+    w.close()
+    w2 = WriteAheadLog(d)
+    assert [r["rid"] for r in w2.recovered] == list(range(10))
+    assert w2.recovered[3]["prompt"] == [0, 1, 2]   # numpy-safe JSON
+    # the reopened log keeps appending where the old one stopped
+    w2.append({"t": "finish", "rid": 10})
+    w2.close()
+    assert len(WriteAheadLog(d).recovered) == 11
+
+
+def test_wal_torn_tail_truncates_and_recovers(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d)
+    for i in range(5):
+        w.append({"t": "submit", "rid": i})
+    w.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[-1])
+    # tear the tail mid-record: the append died before completing
+    os.truncate(seg, os.path.getsize(seg) - 7)
+    w2 = WriteAheadLog(d)
+    assert [r["rid"] for r in w2.recovered] == [0, 1, 2, 3]
+    # ...and the truncated log is APPENDABLE (recovery, not read-only)
+    w2.append({"t": "submit", "rid": 99})
+    w2.close()
+    assert [r["rid"] for r in WriteAheadLog(d).recovered] \
+        == [0, 1, 2, 3, 99]
+
+
+def test_wal_mid_file_corruption_refused_typed(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, segment_bytes=200)
+    for i in range(10):
+        w.append({"t": "submit", "rid": i, "prompt": np.arange(3)})
+    w.close()
+    first = os.path.join(d, sorted(os.listdir(d))[0])
+    with open(first, "rb+") as f:
+        f.seek(44)              # inside the first record's JSON body
+        b = f.read(1)
+        f.seek(44)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CorruptCheckpointError):
+        WriteAheadLog(d)
+
+
+def test_wal_bad_magic_refused_typed(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d)
+    w.append({"t": "submit", "rid": 0})
+    w.append({"t": "submit", "rid": 1})
+    w.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[0])
+    with open(seg, "rb+") as f:
+        f.write(b"XXXX")        # clobber the first record's magic
+    with pytest.raises(CorruptCheckpointError):
+        WriteAheadLog(d)
+
+
+# -- fast: partition fault rules --------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fault_injector.clear()
+    yield
+    fault_injector.clear()
+
+
+def test_rpc_partition_rule_is_directional():
+    fault_injector.configure([
+        {"kind": "rpc_partition", "src": "0", "dst": "2"}])
+    assert fault_injector.rpc_action("0", "2") == ("drop", 0.0)
+    # asymmetric: the reverse direction still delivers
+    assert fault_injector.rpc_action("2", "0") == ("ok", 0.0)
+    assert fault_injector.rpc_action("0", "1") == ("ok", 0.0)
+    assert any(e.fault == "rpc_partition" for e in fault_injector.fired)
+
+
+def test_rpc_rules_times_bound_delay_and_dup():
+    fault_injector.configure([
+        {"kind": "rpc_duplicate", "src": "0", "dst": "1", "times": 1},
+        {"kind": "rpc_delay", "src": "0", "dst": "2",
+         "delay_s": 0.05}])
+    assert fault_injector.rpc_action("0", "1") == ("dup", 0.0)
+    # the times=1 budget is spent: delivery returns to normal
+    assert fault_injector.rpc_action("0", "1") == ("ok", 0.0)
+    act, delay = fault_injector.rpc_action("0", "2")
+    assert act == "delay" and delay == pytest.approx(0.05)
+
+
+def test_stale_epoch_error_contract():
+    assert "StaleEpochError" in res.__all__
+    e = StaleEpochError("zombie", op="step", stale_epoch=1,
+                        current_epoch=2)
+    assert isinstance(e, RuntimeError)
+    assert (e.op, e.stale_epoch, e.current_epoch) == ("step", 1, 2)
+
+
+# -- fast: in-process WAL recovery over a fake fleet ------------------------
+
+class _FakeStore:
+    def __init__(self):
+        self.kv = {}
+        self.counters = {}
+
+    def add(self, key, delta):
+        self.counters[key] = self.counters.get(key, 0) + int(delta)
+        return self.counters[key]
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def set(self, key, value):
+        self.kv[key] = value
+
+
+class _FakeFuture:
+    def __init__(self, value=None, error=None):
+        self._value, self._error = value, error
+
+    def wait(self, timeout=None):
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _FakeWorker:
+    """One fake worker's op surface: a ``known`` set it still accounts
+    for, canned ``result`` outcomes, and a recorder for submits (the
+    replay path's assertion target)."""
+
+    def __init__(self, known=(), results=None):
+        self.known = set(known)
+        self.results = dict(results or {})
+        self.submits = []
+        self._next_erid = 1000
+
+    def handle(self, op, *args, **kwargs):
+        kwargs.pop("_epoch", None)
+        if op == "adopt":
+            return {"known": sorted(self.known), "queued": 0,
+                    "occupied": len(self.known)}
+        if op == "result":
+            return self.results.get(int(args[0]))
+        if op == "submit":
+            self.submits.append((args[0], kwargs))
+            erid = self._next_erid
+            self._next_erid += 1
+            self.known.add(erid)
+            return erid
+        if op == "step":
+            return {"finished": [], "inflight": {}, "queued": 0,
+                    "occupied": len(self.known)}
+        raise ValueError(f"fake worker: unexpected op {op!r}")
+
+
+class _FakeAgent:
+    def __init__(self, workers):
+        self.store = _FakeStore()
+        self.workers = workers           # rank -> _FakeWorker
+        self.transfer_retries = 0
+
+    def call(self, rank, fn, args, kwargs):
+        try:
+            return _FakeFuture(
+                value=self.workers[rank].handle(*args, **kwargs))
+        except BaseException as e:
+            return _FakeFuture(error=e)
+
+
+class _FakeElastic:
+    def __init__(self, names):
+        self._names = list(names)
+
+    @property
+    def members(self):
+        return list(self._names)
+
+    def beat_age(self, node_id):
+        return 0.0
+
+    def wait_for(self, node_ids, timeout_s=10.0):
+        return sorted(self._names)
+
+
+def _write_failover_wal(path, records):
+    w = WriteAheadLog(path)
+    for rec in records:
+        w.append(rec)
+    w.close()
+
+
+def test_recovery_resumes_known_rows_and_rebases_deadline(tmp_path):
+    """A row the surviving worker still accounts for RESUMES in place,
+    and its deadline rebases from the persisted REMAINING budget onto
+    the new incarnation's monotonic clock — not the dead one's."""
+    wal = str(tmp_path / "wal")
+    _write_failover_wal(wal, [
+        {"t": "submit", "rid": 0, "tag": "a", "prompt": [1, 2, 3],
+         "max_new_tokens": 8, "eos_token_id": None, "temperature": 1.0,
+         "seed": 0, "priority": 0, "latency_class": "default",
+         "deadline_rem": 5.0, "worker": 1, "engine_rid": 100},
+        {"t": "tokens", "rid": 0, "off": 0, "toks": [7, 8],
+         "deadline_rem": 4.5},
+    ])
+    worker = _FakeWorker(known={100})
+    agent = _FakeAgent({1: worker})
+    h = WorkerHandle(name="decode0", rank=1, role="decode", pid=1)
+    router = ClusterRouter(agent, [h], _FakeElastic(["decode0"]),
+                           resume_wal=wal)
+    rep = router.recovery_report
+    assert rep["resumed"] == 1 and rep["replayed"] == 0
+    assert router._by_engine[1][100] == 0
+    assert router._tracked[0].ledger.tolist() == [7, 8]
+    assert worker.submits == []          # resumed, NOT resubmitted
+    # the rebase: ~4.5s of budget remain on THIS process's clock
+    rem = router._tracked[0].deadline_at - time.monotonic()
+    assert 3.5 < rem <= 4.5
+    router.close_wal()
+
+
+def test_recovery_replays_lost_rows_with_folded_ledger(tmp_path):
+    """A row the fleet no longer accounts for ledger-replays: the
+    harvested tokens fold into the prompt, the budget shrinks, and the
+    request-keyed RNG resume point rides along — bit-exact replay."""
+    wal = str(tmp_path / "wal")
+    _write_failover_wal(wal, [
+        {"t": "submit", "rid": 0, "tag": "a", "prompt": [1, 2, 3],
+         "max_new_tokens": 8, "eos_token_id": None, "temperature": 1.0,
+         "seed": 3, "priority": 0, "latency_class": "default",
+         "deadline_rem": None, "worker": 1, "engine_rid": 100},
+        {"t": "tokens", "rid": 0, "off": 0, "toks": [7, 8, 9],
+         "deadline_rem": None},
+    ])
+    worker = _FakeWorker(known=set())    # the row died with the worker
+    agent = _FakeAgent({1: worker})
+    h = WorkerHandle(name="decode0", rank=1, role="decode", pid=1)
+    router = ClusterRouter(agent, [h], _FakeElastic(["decode0"]),
+                           resume_wal=wal)
+    rep = router.recovery_report
+    assert rep["resumed"] == 0 and rep["replayed"] == 1
+    (prompt, kwargs), = worker.submits
+    assert np.asarray(prompt).tolist() == [1, 2, 3, 7, 8, 9]
+    assert kwargs["max_new_tokens"] == 5
+    assert kwargs["rng_request_id"] == 0
+    assert kwargs["rng_tokens_emitted"] == 3
+    assert kwargs["deadline_s"] is None      # no deadline stays none —
+    assert router.in_flight() == 1           # NOT immortal-by-accident
+    router.close_wal()
+
+
+def test_recovery_sheds_exhausted_deadline_typed(tmp_path):
+    """Zero remaining budget at the last append + a dead worker ⇒ the
+    replay sheds typed, it does not resurrect an expired request."""
+    wal = str(tmp_path / "wal")
+    _write_failover_wal(wal, [
+        {"t": "submit", "rid": 0, "tag": "a", "prompt": [1, 2],
+         "max_new_tokens": 4, "eos_token_id": None, "temperature": 1.0,
+         "seed": 0, "priority": 0, "latency_class": "default",
+         "deadline_rem": 0.0, "worker": 1, "engine_rid": 100},
+    ])
+    worker = _FakeWorker(known=set())
+    agent = _FakeAgent({1: worker})
+    h = WorkerHandle(name="decode0", rank=1, role="decode", pid=1)
+    router = ClusterRouter(agent, [h], _FakeElastic(["decode0"]),
+                           resume_wal=wal)
+    assert worker.submits == []
+    with pytest.raises(DeadlineExceededError):
+        router.result(0)
+    assert router.metrics()["shed_requeue_deadline"] == 1
+    router.close_wal()
+
+
+def test_recovery_restores_finished_outcomes(tmp_path):
+    """Finish records re-deliver directly — tokens as a wrapped result,
+    errors re-materialized as their TYPED class."""
+    wal = str(tmp_path / "wal")
+    _write_failover_wal(wal, [
+        {"t": "submit", "rid": 0, "tag": "a", "prompt": [1],
+         "max_new_tokens": 2, "eos_token_id": None, "temperature": 1.0,
+         "seed": 0, "priority": 0, "latency_class": "default",
+         "deadline_rem": None, "worker": 1, "engine_rid": 100},
+        {"t": "finish", "rid": 0, "tokens": [1, 5, 6], "resil": None},
+        {"t": "submit", "rid": 1, "tag": "b", "prompt": [2],
+         "max_new_tokens": 2, "eos_token_id": None, "temperature": 1.0,
+         "seed": 0, "priority": 0, "latency_class": "default",
+         "deadline_rem": None, "worker": 1, "engine_rid": 101},
+        {"t": "finish", "rid": 1, "etype": "ReplicaDeadError",
+         "error": "no surviving decode worker"},
+    ])
+    agent = _FakeAgent({1: _FakeWorker()})
+    h = WorkerHandle(name="decode0", rank=1, role="decode", pid=1)
+    router = ClusterRouter(agent, [h], _FakeElastic(["decode0"]),
+                           resume_wal=wal)
+    assert router.recovery_report["finished_in_wal"] == 2
+    assert np.asarray(router.result(0)).tolist() == [1, 5, 6]
+    with pytest.raises(ReplicaDeadError):
+        router.result(1)
+    assert router.in_flight() == 0
+    assert router._next_id == 2          # fresh rids continue after WAL
+    router.close_wal()
+
+
+def test_wal_dir_with_history_requires_resume(tmp_path):
+    wal = str(tmp_path / "wal")
+    _write_failover_wal(wal, [
+        {"t": "submit", "rid": 0, "tag": None, "prompt": [1],
+         "max_new_tokens": 2, "eos_token_id": None, "temperature": 1.0,
+         "seed": 0, "priority": 0, "latency_class": "default",
+         "deadline_rem": None, "worker": 1, "engine_rid": 100}])
+    agent = _FakeAgent({1: _FakeWorker()})
+    h = WorkerHandle(name="decode0", rank=1, role="decode", pid=1)
+    with pytest.raises(ValueError, match="resume_wal"):
+        ClusterRouter(agent, [h], _FakeElastic(["decode0"]),
+                      wal_dir=wal)
+
+
+def test_frontend_health_quorum_and_wal(tmp_path):
+    agent = _FakeAgent({1: _FakeWorker(), 2: _FakeWorker()})
+    hs = [WorkerHandle(name="decode0", rank=1, role="decode", pid=1),
+          WorkerHandle(name="decode1", rank=2, role="decode", pid=2)]
+    router = ClusterRouter(agent, hs, _FakeElastic(["decode0",
+                                                    "decode1"]),
+                           wal_dir=str(tmp_path / "wal"))
+    assert router._health()["ok"]
+    hs[0].state = "dead"
+    hs[1].state = "dead"
+    assert not router._health()["ok"]    # quorum lost
+    hs[0].state = "healthy"
+    hs[1].state = "healthy"
+    router.close_wal()
+    assert not router._health()["ok"]    # WAL no longer writable
+
+
+# -- slow: real OS processes ------------------------------------------------
+
+@pytest.mark.slow
+def test_frontend_sigkill_failover_parity(tmp_path):
+    """SIGKILL the frontend process mid-serve (≥2 in flight, ≥2
+    queued); the respawned incarnation recovers every accepted request
+    bit-exact vs an undisturbed run and the zombie epoch is fenced."""
+    from paddle_tpu.serving.cluster.frontend_proc import \
+        run_frontend_failover_drill
+    model = _model()
+    base = run_frontend_failover_drill(
+        model, str(tmp_path / "base"), kill=False)
+    killed = run_frontend_failover_drill(
+        model, str(tmp_path / "kill"), kill=True)
+    assert killed["ready"]["occupied"] >= 2
+    assert killed["ready"]["queued"] >= 2
+    assert killed["zombie_error"] == "StaleEpochError"
+    rep = killed["recovery"]
+    # zero-loss accounting: every accepted request is either already
+    # finished in the WAL, finished on a worker during the outage,
+    # resumed in place, or ledger-replayed — counted separately
+    assert rep["finished_in_wal"] + rep["finished_in_gap"] \
+        + rep["resumed"] + rep["replayed"] == len(base["outcomes"])
+    assert rep["resumed"] >= 1      # workers survive a frontend kill
+    assert killed["epoch"] > killed["ready"]["epoch"]
+    for tag, out in base["outcomes"].items():
+        assert killed["outcomes"][tag] == out, tag
+    assert not any("unresolved" in o
+                   for o in killed["outcomes"].values())
+
+
+@pytest.mark.slow
+def test_rpc_partition_drill(tmp_path):
+    """Asymmetric partition (frontend->victim drops, reverse intact):
+    the victim's work requeues onto the survivor bit-exact with no
+    double-serve; partitioning the WHOLE decode pool sheds typed."""
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.serving import launch_cluster
+    model = _model()
+    dec = LlamaDecoder(model, max_len=128)
+    rng = np.random.default_rng(5)
+    reqs = [(rng.integers(0, 64, (6,)), 8) for _ in range(4)]
+    solo = [np.asarray(dec.generate(p[None], b)) for p, b in reqs]
+    with launch_cluster(model, str(tmp_path / "cl"), prefill=0,
+                        decode=2, max_len=128,
+                        engine_kw={"num_slots": 2, "chunk_size": 4},
+                        rpc_timeout_s=60.0, heartbeat_s=0.3,
+                        ttl_s=30.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, b) for p, b in reqs]
+        router.step()          # warmup: worker compiles land here
+        # tighten only once warm, so a dropped message reads as a dead
+        # socket in seconds (the first step would otherwise race it)
+        router.rpc_timeout_s = 3.0
+        victim = next(h for h in router.workers
+                      if len(router._by_engine[h.rank]) >= 1)
+        fault_injector.configure([
+            {"kind": "rpc_partition", "src": "0",
+             "dst": str(victim.rank)}])
+        try:
+            router.drain(max_steps=300)
+            fired = [e.fault for e in fault_injector.fired]
+        finally:
+            fault_injector.clear()      # clear() resets .fired too
+        m = router.metrics()
+        assert m["worker_deaths"] == 1
+        assert m["requeued"] >= 1
+        assert "rpc_partition" in fired
+        # no split-brain, no double-serve: every request resolves with
+        # tokens exactly once, bit-equal to the solo reference
+        for rid, want in zip(rids, solo):
+            got = router.result(rid)
+            assert np.array_equal(np.asarray(got), want)
+        assert m["completed"] == len(reqs)
+        # phase 2: sustained partition of the WHOLE decode pool — the
+        # in-flight request sheds typed (dead-letter), no hang
+        survivor = next(h for h in router.workers
+                        if h.state == "healthy")
+        rid2 = router.submit(reqs[0][0], 8)
+        fault_injector.configure([
+            {"kind": "rpc_partition", "src": "0",
+             "dst": str(survivor.rank)}])
+        try:
+            router.drain(max_steps=300)
+        finally:
+            fault_injector.clear()
+        with pytest.raises(ReplicaDeadError):
+            router.result(rid2)
+        assert router.metrics()["dead_letter"] >= 1
+        # ...and a fresh submit with no routable pool refuses typed too
+        with pytest.raises(ReplicaDeadError):
+            router.submit(reqs[1][0], 8)

@@ -52,6 +52,19 @@ Classes swept (decode + checkpoint + bundle + elastic + serving paths):
                         a serving cluster mid-run -> in-flight rows
                         live-migrate to the peer and back, zero worker
                         deaths, zero lost requests, all bit-exact
+  frontend_kill_mid_serve  the FRONTEND process is SIGKILLed mid-serve
+                        (REAL OS kill, work in flight AND queued) ->
+                        a respawned ClusterRouter(resume_wal=...)
+                        replays the durable WAL, re-adopts the live
+                        workers, recovers every accepted request
+                        bit-exact vs the undisturbed run, and the dead
+                        incarnation's epoch is fenced typed
+                        (StaleEpochError) when it tries to operate
+  rpc_partition         an asymmetric network partition drops every
+                        frontend->victim RPC message -> the victim's
+                        work requeues onto the survivor bit-exact with
+                        no double-serve; partitioning the WHOLE decode
+                        pool sheds typed (ReplicaDeadError), no hang
 
 Prints one human line per class to stderr and ONE parseable JSON line
 to stdout (the bench.py last-line contract); exit code 0 iff all pass.
@@ -504,6 +517,98 @@ def drill_rolling_restart_under_load(tmp):
             f"rows migrated, 0 deaths), all bit-exact")
 
 
+def drill_frontend_kill_mid_serve(tmp):
+    from paddle_tpu.serving.cluster.frontend_proc import \
+        run_frontend_failover_drill
+    model, _, _ = _cluster_workload(n=1)
+    base = run_frontend_failover_drill(
+        model, os.path.join(tmp, "ffo_base"), kill=False)
+    killed = run_frontend_failover_drill(
+        model, os.path.join(tmp, "ffo_kill"), kill=True)
+    ready = killed["ready"]
+    assert ready["occupied"] >= 2 and ready["queued"] >= 2, \
+        f"the kill window had too little in flight: {ready}"
+    assert killed["zombie_error"] == "StaleEpochError", \
+        f"zombie frontend not fenced typed: {killed['zombie_error']}"
+    rep = killed["recovery"]
+    total = (rep["finished_in_wal"] + rep["finished_in_gap"]
+             + rep["resumed"] + rep["replayed"])
+    assert total == len(base["outcomes"]), \
+        f"recovery accounting lost requests: {rep}"
+    for tag, out in base["outcomes"].items():
+        assert killed["outcomes"][tag] == out, \
+            f"{tag} diverged across the frontend failover"
+    assert not any("unresolved" in o
+                   for o in killed["outcomes"].values())
+    return (f"frontend SIGKILLed (epoch {ready['epoch']} -> "
+            f"{killed['epoch']}): {rep['resumed']} resumed in place, "
+            f"{rep['replayed']} replayed, zombie fenced typed, all "
+            f"{len(base['outcomes'])} bit-exact")
+
+
+def drill_rpc_partition(tmp):
+    import numpy as np
+    from paddle_tpu.runtime.resilience import (ReplicaDeadError,
+                                               fault_injector)
+    from paddle_tpu.serving import launch_cluster
+    model, reqs, solo = _cluster_workload(n=4, seed=12)
+    # rpc_timeout_s starts LONG (the first step compiles the worker's
+    # decode programs) and tightens only once the fleet is warm — a
+    # dropped message then reads as a dead socket in ~3s, not 60
+    with launch_cluster(model, os.path.join(tmp, "partition_cluster"),
+                        prefill=0, decode=2, max_len=48,
+                        engine_kw={"num_slots": 2, "chunk_size": 4},
+                        heartbeat_s=0.3, ttl_s=30.0,
+                        rpc_timeout_s=60.0) as cl:
+        router = cl.router
+        rids = [router.submit(p, n) for p, n in reqs]
+        router.step()                        # warmup: compiles land
+        router.rpc_timeout_s = 3.0
+        victim = next(h for h in router.workers
+                      if len(router._by_engine[h.rank]) >= 1)
+        fault_injector.configure([
+            {"kind": "rpc_partition", "src": "0",
+             "dst": str(victim.rank)}])
+        try:
+            router.drain(max_steps=300)
+            dropped = sum(1 for e in fault_injector.fired
+                          if e.fault == "rpc_partition")
+        finally:
+            fault_injector.clear()
+        m = router.metrics()
+        assert m["worker_deaths"] == 1 and m["requeued"] >= 1, m
+        for rid, want in zip(rids, solo):
+            got = router.result(rid)       # raises on a lost request
+            assert np.array_equal(np.asarray(got), want), \
+                f"request {rid} diverged after the partition requeue"
+        # sustained partition of the WHOLE pool: typed shed, no hang
+        survivor = next(h for h in router.workers
+                        if h.state == "healthy")
+        rid2 = router.submit(reqs[0][0], 6)
+        fault_injector.configure([
+            {"kind": "rpc_partition", "src": "0",
+             "dst": str(survivor.rank)}])
+        try:
+            router.drain(max_steps=300)
+        finally:
+            fault_injector.clear()
+        try:
+            router.result(rid2)
+            raise AssertionError(
+                "request under a total partition resolved silently")
+        except ReplicaDeadError:
+            pass
+        try:
+            router.submit(reqs[1][0], 6)
+            raise AssertionError(
+                "submit with no routable pool did not refuse typed")
+        except ReplicaDeadError:
+            pass
+    return (f"asymmetric partition dropped {dropped} messages, victim "
+            f"dead, {m['requeued']} requeued bit-exact; total "
+            f"partition shed typed")
+
+
 def main():
     import tempfile
 
@@ -525,6 +630,9 @@ def main():
          True),
         ("rolling_restart_under_load", drill_rolling_restart_under_load,
          True),
+        ("frontend_kill_mid_serve", drill_frontend_kill_mid_serve,
+         True),
+        ("rpc_partition", drill_rpc_partition, True),
     ]
     results = {}
     ok = True

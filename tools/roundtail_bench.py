@@ -79,6 +79,14 @@ Runs, in order, with per-step logs under /tmp/roundtail/:
      bit-exactness, zero lost requests and zero worker deaths are
      hard-asserted inside the bench
 
+ 16. serve_frontend_failover (`bench.py --serve --cluster
+     prefill:1,decode:2 --kill-frontend`): the control-plane-SPOF
+     gate — the frontend process is SIGKILLed mid-run with work in
+     flight AND queued; its successor replays the durable WAL,
+     re-adopts the live workers (epoch-fenced: the dead incarnation's
+     ops are refused typed StaleEpochError) and recovers every
+     accepted request bit-exact, greedy AND request-keyed sampled
+
 Each step is a subprocess so one failure doesn't kill the rest; the
 summary prints at the end. Usage: python tools/roundtail_bench.py
 """
@@ -164,6 +172,18 @@ STEPS = [
     ("serve_rolling", [sys.executable, "bench.py", "--serve",
                        "--cluster", "prefill:1,decode:2",
                        "--rolling-restart"], None),
+    # control-plane-SPOF gate: the store daemon hosts the rendezvous,
+    # the frontend runs as its own OS process with a durable WAL, and
+    # it is SIGKILLed mid-run with >=2 requests in flight AND >=2
+    # queued — the respawned frontend must recover EVERY accepted
+    # request (resumed in place or WAL-ledger-replayed, counted
+    # separately) bit-exact vs an undisturbed run, greedy AND
+    # request-keyed sampled, and a zombie op from the dead
+    # incarnation's epoch must be refused typed (StaleEpochError) —
+    # rc != 0 on any violation, all hard-asserted inside the bench
+    ("serve_frontend_failover", [sys.executable, "bench.py", "--serve",
+                                 "--cluster", "prefill:1,decode:2",
+                                 "--kill-frontend"], None),
 ]
 
 
