@@ -934,10 +934,8 @@ def bench_decode_modes(steps=None, mesh=None):
              ("spec_greedy", dict(spec_kw)),
              ("spec_sampled", {"do_sample": True, "temperature": 0.8,
                                "top_k": 40, "seed": 0, **spec_kw})]
-    if mesh_obj is not None:
-        # speculative decode is refused on a mesh (typed, at generate
-        # time) — the sweep drops those rows rather than crash the run
-        modes = [m for m in modes if not m[0].startswith("spec_")]
+    # speculative modes run on a mesh too: the shard_map'd per-row
+    # uneven cache advance made SpeculativeMeshError a working path
     run_mark = _obs_mark()        # the whole-run trace export window
     dev_sess = _obs_device_session()   # PADDLE_TPU_OBS_DEVICE=1 evidence
     rows = {}
@@ -1358,6 +1356,19 @@ def bench_serve(n_requests=None, slots=None, chunk=None, mesh=None,
     assert disp_cont == (m["prefill_dispatches"] + m["chunk_dispatches"]
                          + m["step_dispatches"]), \
         f"hidden dispatches: {disp_cont} vs {m}"
+    # steady-state dispatches-per-chunk == 1: the device admission ring
+    # splices every admitted row inside the NEXT chunk program — zero
+    # host-side scatters, zero extra dispatch boundaries, no per-token
+    # rung exercised. Every request stages exactly one ring row.
+    ring = m["admission_ring"]
+    assert ring is not None, "decoder serving should run the ring"
+    assert ring["host_scattered"] == 0, \
+        f"ring admission must not host-scatter: {ring}"
+    assert ring["staged"] == n_req and ring["scattered"] == n_req, \
+        f"one ring splice per admitted request: {ring} vs {n_req}"
+    assert m["step_dispatches"] == 0, \
+        f"clean serve must stay on the chunk rung: {m}"
+    cont["admission_ring"] = ring
     # obs evidence (PADDLE_TPU_OBS=1): the exported trace's dispatch-span
     # counts must equal the engine's asserted accounting — one prefill
     # span per admitted request, one chunk span per chunk dispatch.
@@ -1473,6 +1484,150 @@ def bench_serve(n_requests=None, slots=None, chunk=None, mesh=None,
     print(json.dumps(line))
     if exporter is not None:
         exporter.stop()          # release the port before returning
+    return line
+
+
+def bench_serve_spec(n_requests=None, slots=None, chunk=None, mesh=None):
+    """``--serve --speculative [--mesh dp:D,tp:T]``: speculative
+    continuous batching vs the plain engine, SAME workload.
+
+    Two engines over one decoder: (a) the plain ring engine, (b) the
+    speculative engine (``draft_model='skip:1'``, greedy). Hard asserts:
+
+    - dispatch accounting is exact on BOTH engines — prefills + chunks
+      (+ draft prefills for b), admission adds zero host round-trips
+      (``admission.host_scattered == 0``, one ring splice per request);
+    - greedy tokens are BIT-EXACT between the two engines (speculative
+      verify-accept is teacher-forced-equivalent by construction);
+    - the dispatch win is real: speculative ``tokens_per_dispatch``
+      (useful tokens over ALL dispatches) > 1.8 and its chunk-dispatch
+      count is strictly below the plain engine's.
+
+    Under ``--mesh`` the same contract runs sharded (the shard_map'd
+    speculative path) — bit-exact on the virtual CPU mesh."""
+    import numpy as np
+
+    import jax
+    from paddle_tpu.inference.generate import LlamaDecoder
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        import jax.numpy as jnp
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        n_req = n_requests or 16
+        slots = slots or 8
+        chunk = chunk or 16
+        prompt_len, len_pool = 32, (8, 16, 32, 96)
+        draft, K = "skip:3", 4
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256)
+        n_req = n_requests or 16
+        slots = slots or 4
+        chunk = chunk or 8
+        prompt_len, len_pool = 8, (4, 8, 16, 96)
+        draft, K = "skip:1", 2
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        for p in model.parameters():
+            p._set_value(p.value.astype(jnp.bfloat16))
+    mesh_obj = _bench_mesh(mesh)
+    max_len = prompt_len + max(len_pool) + K
+    dec = LlamaDecoder(model, max_len=max_len, mesh=mesh_obj)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_req)]
+    lens = rng.choice(len_pool, n_req)
+    useful = int(lens.sum())
+
+    def run(label, **kw):
+        eng = ServingEngine(dec, num_slots=slots, chunk_size=chunk, **kw)
+        for i in range(n_req):        # queue everything; drain steadily
+            eng.submit(prompts[i], int(lens[i]), seed=i)
+        d0 = dec.dispatch_count
+        t0 = time.perf_counter()
+        res = eng.drain()
+        wall = time.perf_counter() - t0
+        m = eng.metrics()
+        disp = dec.dispatch_count - d0
+        draft_pf = m["draft_prefill_dispatches"]
+        assert disp == (m["prefill_dispatches"] + draft_pf
+                        + m["chunk_dispatches"]
+                        + m["step_dispatches"]), \
+            f"{label}: hidden dispatches: {disp} vs {m}"
+        assert m["step_dispatches"] == 0, \
+            f"{label}: clean serve must stay on the chunk rung: {m}"
+        ring = m["admission_ring"]
+        assert ring["host_scattered"] == 0, \
+            f"{label}: ring admission must not host-scatter: {ring}"
+        assert ring["staged"] == n_req and ring["scattered"] == n_req, \
+            f"{label}: one ring splice per request: {ring} vs {n_req}"
+        rec = {"wall_s": round(wall, 3),
+               "tokens_per_sec": round(useful / wall, 1),
+               "dispatches": disp,
+               "prefill_dispatches": m["prefill_dispatches"],
+               "draft_prefill_dispatches": draft_pf,
+               "chunk_dispatches": m["chunk_dispatches"],
+               "tokens_per_dispatch": round(useful / disp, 3),
+               "admission_ring": ring,
+               "speculative": m["speculative"]}
+        return rec, res
+
+    # warm both compiled paths outside the timed windows
+    for kw in ({}, {"draft_model": draft, "num_speculative_tokens": K}):
+        w = ServingEngine(dec, num_slots=slots, chunk_size=chunk, **kw)
+        for k in range(slots + 1):
+            w.submit(prompts[k % n_req], int(len_pool[k % len(len_pool)]))
+        w.drain()
+
+    plain, res_p = run("plain")
+    spec, res_s = run("spec", draft_model=draft,
+                      num_speculative_tokens=K)
+    # greedy parity: request id i is i-th submitted on both engines
+    for i in range(n_req):
+        a, b = np.asarray(res_p[i]), np.asarray(res_s[i])
+        assert np.array_equal(a, b), \
+            f"request {i}: speculative tokens diverged from plain engine"
+    # the K-fold lever, measured: fewer chunk dispatches for the same
+    # tokens, and ~2 tokens per dispatch overall
+    assert spec["chunk_dispatches"] < plain["chunk_dispatches"], \
+        f"speculation must cut chunk dispatches: {spec} vs {plain}"
+    assert spec["tokens_per_dispatch"] > 1.8, \
+        f"speculative tokens_per_dispatch too low: {spec}"
+    reduction = plain["dispatches"] / spec["dispatches"]
+    acc = spec["speculative"]["acceptance_len_mean"]
+    print(f"serve-spec: plain {plain['dispatches']} dispatches "
+          f"({plain['tokens_per_dispatch']:.2f} tok/dispatch) vs "
+          f"speculative {spec['dispatches']} "
+          f"({spec['tokens_per_dispatch']:.2f} tok/dispatch, "
+          f"acceptance_len_mean {acc:.2f}): {reduction:.2f}x dispatch "
+          f"reduction, bit-exact on {n_req} requests"
+          + (f" on mesh {_parse_mesh(mesh)}" if mesh else ""),
+          file=sys.stderr)
+    line = _emit("serving_speculative_tokens_per_dispatch",
+                 spec["tokens_per_dispatch"], "tokens/dispatch")
+    mesh_rec = None
+    if dec.sharding is not None:
+        mesh_rec = dec.sharding.describe()
+        mesh_rec.pop("partition_rules", None)
+    line["serve_spec"] = {
+        "config": "134M" if on_tpu else "tiny-cpu",
+        "requests": n_req, "slots": slots, "chunk_size": chunk,
+        "prompt_len": prompt_len, "output_len_pool": list(len_pool),
+        "draft": draft, "num_speculative_tokens": K,
+        "mesh": mesh_rec,
+        "plain": plain, "speculative": spec,
+        "dispatch_reduction": round(reduction, 3),
+        "parity_bit_exact": True,
+    }
+    print(json.dumps(line))
     return line
 
 
@@ -2663,6 +2818,15 @@ def main():
                          "kill + delayed-heartbeat fault plan; with "
                          "--serve --cluster: SIGKILL a decode worker "
                          "process mid-run; report p99 under failure")
+    ap.add_argument("--speculative", action="store_true",
+                    help="with --serve: speculative continuous batching "
+                         "vs the plain engine on the same workload — "
+                         "hard-asserts bit-exact greedy parity, exact "
+                         "dispatch accounting (prefills + draft "
+                         "prefills + chunks, admission adds zero), "
+                         "tokens_per_dispatch > 1.8 and a strict "
+                         "chunk-dispatch reduction; composes with "
+                         "--mesh (sharded speculative decode)")
     ap.add_argument("--prefix-mix", action="store_true",
                     help="with --serve: the prefix-cache benchmark — a "
                          "shared-prompt arrival mix served cold vs "
@@ -2733,6 +2897,11 @@ def main():
             n_requests=args.serve_requests, replicas=args.replicas,
             slots=args.serve_slots, chunk=args.serve_chunk,
             faults=args.faults))
+        return
+    if args.serve and args.speculative:
+        _run_guarded("serve_spec", lambda: bench_serve_spec(
+            n_requests=args.serve_requests, slots=args.serve_slots,
+            chunk=args.serve_chunk, mesh=args.mesh))
         return
     if args.serve and args.prefix_mix:
         _run_guarded("serve_prefix", lambda: bench_serve_prefix(

@@ -214,7 +214,9 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-from paddle_tpu.inference.aot import load_compiled, save_compiled  # noqa: E402,F401
+from paddle_tpu.inference.aot import (  # noqa: E402,F401
+    load_compiled, read_meta, save_compiled,
+)
 from paddle_tpu.inference.bundle import (  # noqa: E402,F401
     AotPredictor, export_decoder_bundle, export_predict_bundle,
 )
@@ -222,6 +224,7 @@ from paddle_tpu.inference.sharding import (  # noqa: E402,F401
     DecodeSharding, MeshMismatchError, SpeculativeMeshError,
 )
 
-__all__ += ["save_compiled", "load_compiled", "AotPredictor",
+__all__ += ["save_compiled", "load_compiled", "read_meta",
+            "AotPredictor",
             "export_predict_bundle", "export_decoder_bundle",
             "DecodeSharding", "MeshMismatchError", "SpeculativeMeshError"]

@@ -5,41 +5,101 @@ Analog of the reference's save_inference_model → AnalysisPredictor flow
 jax.export's serialized StableHLO module. ``load_compiled`` rebuilds a
 callable WITHOUT re-tracing any Python — a fresh process never imports the
 model code, it just feeds the deserialized executable.
+
+Two on-disk formats, distinguished by magic:
+
+- ``PTPU-AOT1``: magic + raw StableHLO bytes (the original format);
+- ``PTPU-AOT2``: magic + 4-byte big-endian length + that many bytes of
+  JSON entry metadata + raw StableHLO bytes. The embedded dict is the
+  entry's SELF-DESCRIPTION (what program this is, its statics — e.g. a
+  chunk entry's ``chunk``/``admit_ring``/``spec_chunk``), readable via
+  :func:`read_meta` without touching bundle.json and without
+  deserializing the module — a stray ``.aot`` file stays identifiable
+  even separated from its bundle.
+
+Both loaders accept both formats; format 1 simply has no metadata.
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Callable, Optional, Sequence
+import json
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 from jax import export as _jexport
 
-__all__ = ["save_compiled", "load_compiled"]
+__all__ = ["save_compiled", "load_compiled", "read_meta"]
 
 _MAGIC = b"PTPU-AOT1\n"
+_MAGIC2 = b"PTPU-AOT2\n"
 
 
 def save_compiled(fn: Callable, example_args: Sequence, path: str,
-                  donate_argnums=()) -> str:
+                  donate_argnums=(),
+                  meta: Optional[Dict[str, Any]] = None) -> str:
     """Trace+lower ``fn`` at the example args' shapes/dtypes and write the
     serialized StableHLO executable to ``path`` (save_inference_model
     analog). The export is shape-polymorphism-free: static shapes are the
-    TPU deployment contract. The write is crash-safe (temp + atomic
-    rename — a killed exporter never leaves a half-written module under
-    the final name). Returns the sha256 hexdigest of the INTENDED file
-    bytes, computed before the write hits disk, so bundle manifests can
-    refuse any later on-disk corruption (inference/bundle.py)."""
+    TPU deployment contract. ``meta`` (JSON-serializable dict) embeds an
+    entry self-description readable back via :func:`read_meta`. The write
+    is crash-safe (temp + atomic rename — a killed exporter never leaves
+    a half-written module under the final name). Returns the sha256
+    hexdigest of the INTENDED file bytes, computed before the write hits
+    disk, so bundle manifests can refuse any later on-disk corruption
+    (inference/bundle.py)."""
     exp = _jexport.export(jax.jit(fn, donate_argnums=donate_argnums))(
         *example_args)
     blob = exp.serialize()
-    # raw StableHLO bytes after the magic — NOT pickle: loading a model
-    # artifact must never execute arbitrary code from the file
+    # raw StableHLO bytes after the magic (+ length-prefixed JSON meta in
+    # format 2) — NOT pickle: loading a model artifact must never execute
+    # arbitrary code from the file
     from paddle_tpu.runtime.resilience import atomic_write_bytes
-    payload = _MAGIC + bytes(blob)
+    if meta is None:
+        payload = _MAGIC + bytes(blob)
+    else:
+        mj = json.dumps(meta, sort_keys=True).encode()
+        payload = _MAGIC2 + len(mj).to_bytes(4, "big") + mj + bytes(blob)
     digest = hashlib.sha256(payload).hexdigest()
     atomic_write_bytes(path, payload)
     return digest
+
+
+def _split(raw: bytes, path: str
+           ) -> Tuple[Optional[Dict[str, Any]], bytes]:
+    """(embedded meta or None, StableHLO bytes) for either format."""
+    if raw[:len(_MAGIC2)] == _MAGIC2:
+        off = len(_MAGIC2)
+        n = int.from_bytes(raw[off:off + 4], "big")
+        head, blob = raw[off + 4:off + 4 + n], raw[off + 4 + n:]
+        if len(head) != n:
+            raise ValueError(
+                f"{path}: truncated AOT entry metadata ({len(head)} of "
+                f"{n} declared bytes)")
+        return json.loads(head.decode()), blob
+    if raw[:len(_MAGIC)] == _MAGIC:
+        return None, raw[len(_MAGIC):]
+    raise ValueError(f"{path}: not a paddle_tpu AOT export")
+
+
+def read_meta(path: str) -> Optional[Dict[str, Any]]:
+    """The embedded entry metadata of an AOT export, WITHOUT reading or
+    deserializing the module bytes (the metadata block leads the file).
+    ``None`` for a format-1 file (no embedded meta); ``ValueError`` for a
+    file that is not an AOT export at all."""
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC2) + 4)
+        if head[:len(_MAGIC2)] == _MAGIC2:
+            n = int.from_bytes(head[len(_MAGIC2):], "big")
+            raw = f.read(n)
+            if len(raw) != n:
+                raise ValueError(
+                    f"{path}: truncated AOT entry metadata ({len(raw)} "
+                    f"of {n} declared bytes)")
+            return json.loads(raw.decode())
+    if head[:len(_MAGIC)] == _MAGIC:
+        return None
+    raise ValueError(f"{path}: not a paddle_tpu AOT export")
 
 
 def load_compiled(path: str, expected_sha256: Optional[str] = None
@@ -60,8 +120,6 @@ def load_compiled(path: str, expected_sha256: Optional[str] = None
                 f"{path}: sha256 {got[:16]}… does not match the bundle "
                 f"manifest's {expected_sha256[:16]}… — refusing to serve "
                 f"a corrupt module ({len(raw)} bytes on disk)")
-    magic, blob = raw[:len(_MAGIC)], raw[len(_MAGIC):]
-    if magic != _MAGIC:
-        raise ValueError(f"{path}: not a paddle_tpu AOT export")
+    _, blob = _split(raw, path)
     exp = _jexport.deserialize(bytearray(blob))
     return lambda *args: exp.call(*args)

@@ -37,11 +37,14 @@ __all__ = ["export_predict_bundle", "export_decoder_bundle", "AotPredictor"]
 _META = "bundle.json"
 
 
-def _save_exp(fn, args, path, donate_argnums=()):
+def _save_exp(fn, args, path, donate_argnums=(), meta=None):
     """Export one entry module (crash-safe write) and return its sha256
-    for the bundle manifest."""
+    for the bundle manifest. ``meta`` embeds an entry self-description
+    in the .aot file itself (``aot.read_meta``) so a stray entry stays
+    identifiable away from bundle.json."""
     from paddle_tpu.inference.aot import save_compiled
-    return save_compiled(fn, args, path, donate_argnums=donate_argnums)
+    return save_compiled(fn, args, path, donate_argnums=donate_argnums,
+                         meta=meta)
 
 
 def _load_exp(path, expected_sha256=None):
@@ -379,7 +382,14 @@ def export_decoder_bundle(decoder, out_dir: str,
                  sput(jnp.full((int(B),), -1, jnp.int32), "eos"),
                  sput(jnp.ones((int(B),), jnp.float32), "temp")),
                 os.path.join(out_dir, ctag + ".aot"),
-                donate_argnums=(1, 2))
+                donate_argnums=(1, 2),
+                # the entry self-describes its statics: this chunk
+                # program has NO ring-admission prologue and NO
+                # speculative verify loop — what the serving engine's
+                # typed demotions point at
+                meta={"entry": "decode_chunk", "batch": int(B),
+                      "chunk": int(T), "admit_ring": False,
+                      "spec_chunk": False})
             chunks.append({"file": ctag + ".aot", "batch": int(B),
                            "chunk": int(T)})
     if csizes:
@@ -403,7 +413,9 @@ def export_decoder_bundle(decoder, out_dir: str,
                 (sput(jnp.zeros((1, int(S)), jnp.int32)), kc1, vc1,
                  sput(jnp.ones((1,), jnp.int32)),
                  sput(jnp.zeros((1,), jnp.int32))),
-                os.path.join(out_dir, atag + ".aot"))
+                os.path.join(out_dir, atag + ".aot"),
+                meta={"entry": "admit_prefill", "batch": 1,
+                      "seq": int(S), "admit_pos0": True})
             admits.append({"file": atag + ".aot", "batch": 1,
                            "seq": int(S)})
     # the fused-decode serving contract: key/done/eos/temperature are
@@ -446,7 +458,16 @@ def export_decoder_bundle(decoder, out_dir: str,
                            # contract; absent on pre-prefix bundles,
                            # whose partial hits the engine demotes to
                            # misses
-                           "admit_pos0": True}
+                           "admit_pos0": True,
+                           # bundle entries carry neither the device
+                           # admission-ring prologue nor a speculative
+                           # chunk program: ServingEngine demotes bundle
+                           # serving to host-scatter admission, and
+                           # refuses draft_model= over a bundle typed
+                           # (pointing at these statics) instead of
+                           # crashing on a missing entry mid-serve
+                           "admit_ring": False,
+                           "spec_chunk": False}
     if srd is not None:
         # the mesh contract: entries are partitioned programs for THIS
         # topology (jax.export refuses other device counts outright);

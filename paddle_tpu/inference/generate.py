@@ -55,9 +55,18 @@ class DecodeState:
     ``decode_chunk`` advances the state by T tokens in ONE dispatch;
     chaining chunks is bit-exact with run-to-completion for greedy.
 
-    The draft-cache / speculative-stats fields are reserved for chunked
-    speculative decode (ROADMAP): today a state never carries them and
-    ``decode_chunk`` serves the plain fused loop only.
+    A SPECULATIVE carry (``init_decode_state(draft_model=...)``)
+    additionally holds the draft model's KV caches (``dkc``/``dvc``), a
+    per-row pending token ``tok`` (the last emitted-but-not-yet-cached
+    token; ``-1`` = "no pending token, pick from ``logits``" — the state
+    of a freshly admitted row) and per-row CUMULATIVE acceptance stats
+    (``spec_rounds``/``spec_accepted``, reset at admission). In that mode
+    ``logits`` are the verify logits of the pending token's position —
+    finite (the serving engine's corruption guard still works) but NOT
+    pick-ready; the ``tok`` sentinel governs the next pick. ``nv`` is an
+    OUTPUT of a speculative chunk: the per-row count of valid tokens in
+    the returned ``(B, T+K)`` buffer — ``T..T+K`` of them, the per-row
+    overflow being the accepted draft tail of the chunk's last round.
     """
 
     logits: Any           # (B, V) f32 — logits the next pick samples from
@@ -68,8 +77,13 @@ class DecodeState:
     done: Any             # (B,) bool — frozen rows (eos hit / slot free)
     eos: Any              # (B,) i32 — per-row eos id, -1 = none
     temp: Any             # (B,) f32 — per-row sampling temperature
-    dkc: Any = None       # reserved: draft caches (speculative chunks)
+    dkc: Any = None       # draft caches (speculative chunks)
     dvc: Any = None
+    tok: Any = None       # (B,) i32 — pending token, -1 = pick from logits
+    spec_rounds: Any = None    # (B,) i32 — cumulative verify rounds
+    spec_accepted: Any = None  # (B,) i32 — cumulative accepted drafts
+    nv: Any = None        # (B,) i32 — valid tokens in the last chunk's buf
+    spec: Any = None      # host-side: {"ekey", "K"} engine routing meta
     steps_done: int = 0   # host-side: loop steps executed so far
 
 
@@ -142,20 +156,31 @@ def _cache_layer_set(kc, kc_l, li):
     return jax.lax.dynamic_update_slice(kc, kc_l[None], (li, 0, 0, 0, 0))
 
 
-def _cache_update(buf, t, pos, head_major):
+def _cache_update(buf, t, pos, head_major, sharded=False):
     """Write t into ONE layer's cache buffer at [pos, pos+S). Scalar pos:
     a single dynamic-update-slice. Per-row (B,) pos: the same DUS vmapped
     over the batch (lowers to scatter — each row lands at its own
     offset, the speculative-decode requirement). A quantized buffer
     (``int8wk``) quantizes the incoming rows by per-row absmax and
     updates the int8 and scale leaves with the SAME index math (the
-    scale keeps a last dim of 1, so ranks line up)."""
+    scale keeps a last dim of 1, so ranks line up).
+
+    ``sharded`` may be the live ``DecodeSharding`` (not just a bool): the
+    per-row branch then lowers through ``shard_map`` — dp splits the
+    batch, tp splits the head axis, and the per-row DUS touches only its
+    own row's shard, so the LOCAL body is exactly the single-device body
+    and no collective is ever needed. That is the trusted sharded
+    lowering of the speculative uneven cache advance (the former
+    ``SpeculativeMeshError``); axes the guard drops (non-dividing dims)
+    replicate, and the body still computes identical values per replica."""
     from paddle_tpu.quantization.kv_cache import (is_quantized_kv,
                                                   quantize_kv_rows)
     if is_quantized_kv(buf):
         qt = quantize_kv_rows(t)
-        return {"q": _cache_update(buf["q"], qt["q"], pos, head_major),
-                "s": _cache_update(buf["s"], qt["s"], pos, head_major)}
+        return {"q": _cache_update(buf["q"], qt["q"], pos, head_major,
+                                   sharded),
+                "s": _cache_update(buf["s"], qt["s"], pos, head_major,
+                                   sharded)}
     if jnp.ndim(pos) == 1:
         if head_major:     # buf (B, KV, L, D), t (B, KV, S, D)
             f = lambda c, u, p0: jax.lax.dynamic_update_slice(  # noqa: E731
@@ -163,9 +188,47 @@ def _cache_update(buf, t, pos, head_major):
         else:              # buf (B, L, KV, D), t (B, S, KV, D)
             f = lambda c, u, p0: jax.lax.dynamic_update_slice(  # noqa: E731
                 c, u, (p0, 0, 0))
-        return jax.vmap(f)(buf, t, pos)
+        upd = jax.vmap(f)
+        srd = sharded if (sharded and not isinstance(sharded, bool)) \
+            else None
+        if srd is not None:
+            try:
+                from jax.experimental.shard_map import shard_map
+                ent = srd.state_entries("kc", buf.ndim, head_major)
+                bspec = srd.guarded(buf.shape, ent)
+                tspec = srd.guarded(t.shape, ent)
+                pspec = srd.guarded(pos.shape,
+                                    srd.state_entries("pos", 1))
+                return shard_map(
+                    upd, mesh=srd.jax_mesh,
+                    in_specs=(bspec, tspec, pspec), out_specs=bspec,
+                    check_rep=False)(buf, t, pos)
+            except Exception:
+                pass       # plain vmap below: GSPMD scatters it instead
+        return upd(buf, t, pos)
     at = (0, 0, pos, 0) if head_major else (0, pos, 0, 0)
     return jax.lax.dynamic_update_slice(buf, t, at)
+
+
+def _row_scatter(dst, src, idx):
+    """Scatter whole batch rows ``src[j] -> dst[idx[j]]`` on the cache
+    batch axis (``ndim - 4``: 0 for a per-layer 4-D buffer, 1 for a
+    stacked 5-D one), recursing over per-layer tuples and quantized
+    ``{"q", "s"}`` leaves. ``idx`` entries >= dst's batch size DROP
+    (``mode="drop"``) — the admission-ring convention maps empty ring
+    rows to that sentinel (NEVER pass raw -1: negative scatter indices
+    wrap). Used both to stage admission-prefill rows into the ring and
+    to splice ring rows into the live carry inside the chunk program."""
+    from paddle_tpu.quantization.kv_cache import is_quantized_kv
+    if is_quantized_kv(dst):
+        return {"q": _row_scatter(dst["q"], src["q"], idx),
+                "s": _row_scatter(dst["s"], src["s"], idx)}
+    if isinstance(dst, tuple):
+        return tuple(_row_scatter(d, s, idx) for d, s in zip(dst, src))
+    ax = dst.ndim - 4
+    if ax <= 0:
+        return dst.at[idx].set(src, mode="drop")
+    return dst.at[:, idx].set(src, mode="drop")
 
 
 def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
@@ -202,13 +265,15 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
     vt = jnp.swapaxes(v, 1, 2) if head_major else v
     if isinstance(kc, tuple):
         # per-layer cache buffers: an update on THIS layer's array only
-        kc_l = _cache_update(kc[li], kt, pos, head_major)
-        vc_l = _cache_update(vc[li], vt, pos, head_major)
+        kc_l = _cache_update(kc[li], kt, pos, head_major, sharded)
+        vc_l = _cache_update(vc[li], vt, pos, head_major, sharded)
         kc = tuple(kc_l if i == li else c for i, c in enumerate(kc))
         vc = tuple(vc_l if i == li else c for i, c in enumerate(vc))
     else:
-        kc_l = _cache_update(_cache_layer(kc, li), kt, pos, head_major)
-        vc_l = _cache_update(_cache_layer(vc, li), vt, pos, head_major)
+        kc_l = _cache_update(_cache_layer(kc, li), kt, pos, head_major,
+                             sharded)
+        vc_l = _cache_update(_cache_layer(vc, li), vt, pos, head_major,
+                             sharded)
         kc = _cache_layer_set(kc, kc_l, li)
         vc = _cache_layer_set(vc, vc_l, li)
 
@@ -344,7 +409,7 @@ def _build_params(model: LlamaForCausalLM, max_len: int,
 
 def _spec_round(p, dp, cfg, dcfg, tok, pos, key, done, kc, vc, dkc, dvc,
                 eos_id, temperature, max_len, *, K: int, do_sample: bool,
-                use_eos: bool, top_k, top_p):
+                use_eos: bool, top_k, top_p, sharded=False):
     """One draft-propose / target-verify / accept round (Leviathan et
     al., arXiv:2211.17192) as a pure trace-level function, so the SAME
     code runs inside the fused while-loop program AND as the per-round
@@ -382,7 +447,7 @@ def _spec_round(p, dp, cfg, dcfg, tok, pos, key, done, kc, vc, dkc, dvc,
     def dbody(carry, j):
         cur, dkc, dvc = carry
         lg, dkc, dvc = _forward_cached(dp, dcfg, cur[:, None], dkc, dvc,
-                                       pos + j, max_len)
+                                       pos + j, max_len, sharded=sharded)
         if do_sample:
             kj = jax.lax.dynamic_index_in_dim(
                 dkeys, jnp.minimum(j, K - 1), keepdims=False)
@@ -398,7 +463,8 @@ def _spec_round(p, dp, cfg, dcfg, tok, pos, key, done, kc, vc, dkc, dvc,
     props = jnp.moveaxis((ys[0] if do_sample else ys)[:K], 0, 1)  # (B, K)
     seq = jnp.concatenate([tok[:, None], props], axis=1)       # (B, K+1)
     all_lg, kc, vc = _forward_cached(p, cfg, seq, kc, vc, pos, max_len,
-                                     return_all=True)          # (B,K+1,V)
+                                     return_all=True,
+                                     sharded=sharded)          # (B,K+1,V)
     if do_sample:
         pprob = jax.nn.softmax(
             _filter_logits(all_lg, temperature, top_k, top_p), axis=-1)
@@ -439,6 +505,96 @@ def _spec_round(p, dp, cfg, dcfg, tok, pos, key, done, kc, vc, dkc, dvc,
         done = jnp.logical_or(done, jnp.any(hit, axis=1))
     tok_next = jnp.take_along_axis(emit, a[:, None], axis=1)[:, 0]
     return emit, a, tok_next, key, done, kc, vc, dkc, dvc
+
+
+def _spec_round_rows(p, dp, cfg, dcfg, tok, pos, keys, done, kc, vc, dkc,
+                     dvc, eos, temp, max_len, *, K: int, do_sample: bool,
+                     top_k, top_p, sharded=False):
+    """``_spec_round`` under the CHUNKED-SERVING carry contract: PER-ROW
+    RNG keys (each row splits its OWN (2,) raw uint32 key per round, so
+    its sample stream is invariant to batch neighbours — the admission
+    contract ``chunk_decode`` already honours), per-row eos ids (``-1``
+    = none; rows already done flush their eos fill at the full K+1 rate)
+    and per-row temperatures. Same Leviathan accept/reject math as
+    ``_spec_round`` — greedy rounds are bit-identical, which is what the
+    chunk-slicing-invariance tests ride on.
+
+    Returns ``(emit (B, K+1), a (B,), tok_next (B,), lg_a (B, V), keys,
+    done, kc, vc, dkc, dvc)``; ``lg_a`` is the verify logits at each
+    row's accepted position — the freshest finite logits the carry can
+    hold (NOT pick-ready: ``tok_next`` is the pending pick)."""
+    B = tok.shape[0]
+    fill = jnp.where(eos >= 0, eos, 0)
+    if do_sample:
+        kk = jax.vmap(jax.random.split)(keys)               # (B, 2, 2)
+        keys_next, sub = kk[:, 0], kk[:, 1]
+        rk = jax.vmap(lambda k: jax.random.split(k, 3))(sub)
+        dkeys = jax.vmap(lambda k: jax.random.split(k, K))(rk[:, 0])
+        u = jax.vmap(lambda k: jax.random.uniform(k, (K,)))(rk[:, 1])
+        ckey = rk[:, 2]                                     # (B, 2)
+    else:
+        keys_next = keys
+
+    def dbody(carry, j):
+        cur, dkc, dvc = carry
+        lg, dkc, dvc = _forward_cached(dp, dcfg, cur[:, None], dkc, dvc,
+                                       pos + j, max_len, sharded=sharded)
+        if do_sample:
+            kj = jax.lax.dynamic_index_in_dim(
+                dkeys, jnp.minimum(j, K - 1), axis=1, keepdims=False)
+            flt = _filter_logits(lg, temp[:, None], top_k, top_p)
+            nxt = jax.vmap(jax.random.categorical)(
+                kj, flt).astype(jnp.int32)
+            return (nxt, dkc, dvc), (nxt, flt)
+        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+        return (nxt, dkc, dvc), nxt
+
+    (_, dkc, dvc), ys = jax.lax.scan(dbody, (tok, dkc, dvc),
+                                     jnp.arange(K + 1))
+    props = jnp.moveaxis((ys[0] if do_sample else ys)[:K], 0, 1)  # (B, K)
+    seq = jnp.concatenate([tok[:, None], props], axis=1)       # (B, K+1)
+    all_lg, kc, vc = _forward_cached(p, cfg, seq, kc, vc, pos, max_len,
+                                     return_all=True,
+                                     sharded=sharded)          # (B,K+1,V)
+    if do_sample:
+        pprob = jax.nn.softmax(
+            _filter_logits(all_lg, temp[:, None, None], top_k, top_p),
+            axis=-1)
+        qprob = jax.nn.softmax(jnp.moveaxis(ys[1][:K], 0, 1), axis=-1)
+        pd = jnp.take_along_axis(pprob[:, :K], props[..., None],
+                                 axis=-1)[..., 0]
+        qd = jnp.take_along_axis(qprob, props[..., None], axis=-1)[..., 0]
+        accept = u * qd < pd
+        a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+        pa = jnp.take_along_axis(pprob, a[:, None, None], axis=1)[:, 0]
+        qa = jnp.take_along_axis(
+            qprob, jnp.minimum(a, K - 1)[:, None, None], axis=1)[:, 0]
+        resid = jnp.maximum(pa - qa, 0.0)
+        rs = jnp.sum(resid, axis=-1, keepdims=True)
+        resid = jnp.where(rs > 0, resid / jnp.where(rs > 0, rs, 1.0), pa)
+        dist = jnp.where((a == K)[:, None], pa, resid)
+        corr = jax.vmap(jax.random.categorical)(
+            ckey, jnp.log(dist)).astype(jnp.int32)
+    else:
+        tgt = jnp.argmax(all_lg, -1).astype(jnp.int32)         # (B, K+1)
+        match = props == tgt[:, :K]
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        corr = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
+    jidx = jnp.arange(K + 1)[None, :]
+    ext = jnp.concatenate([props, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    emit = jnp.where(jidx < a[:, None], ext,
+                     jnp.where(jidx == a[:, None], corr[:, None], 0))
+    a = jnp.where(done, K, a)        # finished rows flush fill full-rate
+    emit = jnp.where(done[:, None], fill[:, None], emit)
+    valid = jidx <= a[:, None]
+    hit = jnp.logical_and(emit == eos[:, None], valid)  # -1 never matches
+    after = (jnp.cumsum(hit.astype(jnp.int32), axis=1)
+             - hit.astype(jnp.int32)) > 0
+    emit = jnp.where(jnp.logical_and(after, valid), fill[:, None], emit)
+    done = jnp.logical_or(done, jnp.any(hit, axis=1))
+    tok_next = jnp.take_along_axis(emit, a[:, None], axis=1)[:, 0]
+    lg_a = jnp.take_along_axis(all_lg, a[:, None, None], axis=1)[:, 0]
+    return emit, a, tok_next, lg_a, keys_next, done, kc, vc, dkc, dvc
 
 
 class LlamaDecoder:
@@ -511,8 +667,11 @@ class LlamaDecoder:
         device across chunk re-entry, and every jitted entry pins its
         carry outputs to the same placements (sharding-preserving jit).
         Greedy and per-row-keyed sampled TOKENS are bit-exact with the
-        single-device path; speculative decode is refused with a typed
-        ``SpeculativeMeshError``."""
+        single-device path — including SPECULATIVE decode, whose per-row
+        uneven cache advance lowers through ``shard_map``
+        (``_cache_update``); only speculative BUNDLE EXPORT from a
+        mesh-built decoder still refuses typed
+        (``SpeculativeMeshError``)."""
         from paddle_tpu.quantization.kv_cache import resolve_decode_quant
         self.quant = resolve_decode_quant(quant, weight_dtype)
         # legacy surface (bundle meta, draft-param reuse): any quantized
@@ -539,9 +698,12 @@ class LlamaDecoder:
         if self.sharding is not None:
             self.params = self.sharding.shard_params(self.params)
         cfg = self.cfg
-        # trace-time statics the closures below capture: whether the
-        # programs run under GSPMD, and the cache layout's head axis
-        shd = self.sharding is not None
+        # trace-time statics the closures below capture: the LIVE
+        # DecodeSharding when the programs run under GSPMD (falsy
+        # off-mesh — every `if not sharded` check still reads naturally,
+        # and _cache_update can reach the mesh for its shard_map
+        # lowering), and the cache layout's head axis
+        shd = self.sharding if self.sharding is not None else False
         head_major = cfg.num_attention_heads != cfg.num_key_value_heads
         self._head_major = head_major
         srd = self.sharding
@@ -701,6 +863,95 @@ class LlamaDecoder:
                 logits_all, (true_len - 1)[:, None, None], axis=1)[:, 0]
             return pin_fwd(logits, kc, vc)
 
+        def ring_admit_prefill(p, ids, kc, vc, true_len, pos0,
+                               ring_logits, ring_kc, ring_vc, ring_idx):
+            """``admit_prefill`` that STAGES its results into the
+            device-resident admission ring instead of returning them to
+            host: the freshly prefilled rows scatter into ring rows
+            ``ring_idx`` (host-chosen free slots) inside the SAME
+            dispatch, and the next chunk program splices them into the
+            live carry mid-chunk. Admission thus costs exactly its one
+            counted prefill dispatch — the host-side ``_admit_row``
+            scatter round-trip is gone."""
+            self.trace_count += 1
+            logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc,
+                                                 pos0, max_len,
+                                                 return_all=True,
+                                                 sharded=shd)
+            logits = jnp.take_along_axis(
+                logits_all, (true_len - 1)[:, None, None], axis=1)[:, 0]
+            ring_logits = ring_logits.at[ring_idx].set(logits,
+                                                       mode="drop")
+            ring_kc = _row_scatter(ring_kc, kc, ring_idx)
+            ring_vc = _row_scatter(ring_vc, vc, ring_idx)
+            return pin_fwd(ring_logits, ring_kc, ring_vc)
+
+        def ring_chunk_decode(p, logits0, kc, vc, pos0, keys0, done0,
+                              eos0, temp0, ring_logits, ring_kc, ring_vc,
+                              ring_slot, ring_pos, ring_keys, ring_eos,
+                              ring_temp, steps: int, do_sample: bool,
+                              top_k, top_p):
+            """``chunk_decode`` with a DEVICE-SIDE slot-refill prologue:
+            before the T-step scan, ring rows staged by
+            ``ring_admit_prefill`` scatter into the carry at their
+            destination slots (``ring_slot``; empty ring rows carry the
+            B sentinel and drop). Admitting mid-stream therefore never
+            adds a dispatch boundary — steady state is ONE fused
+            dispatch per chunk per replica regardless of admission rate.
+            ``ring_slot=None`` (with every ring operand None) skips the
+            prologue and is trace-identical to the plain chunk. Because
+            admission can rewrite per-row eos/temp, BOTH are part of the
+            returned carry here (the plain program treats them as
+            read-only inputs)."""
+            self.trace_count += 1
+            B = logits0.shape[0]
+            logits, pos, keys, done = logits0, pos0, keys0, done0
+            eos, temp = eos0, temp0
+            if ring_slot is not None:
+                tgt = jnp.where(ring_slot >= 0, ring_slot, B)
+                logits = logits.at[tgt].set(ring_logits, mode="drop")
+                kc = _row_scatter(kc, ring_kc, tgt)
+                vc = _row_scatter(vc, ring_vc, tgt)
+                pos = pos.at[tgt].set(ring_pos, mode="drop")
+                keys = keys.at[tgt].set(ring_keys, mode="drop")
+                done = done.at[tgt].set(False, mode="drop")
+                eos = eos.at[tgt].set(ring_eos, mode="drop")
+                temp = temp.at[tgt].set(ring_temp, mode="drop")
+
+            def pick(logits, keys, done):
+                if do_sample:
+                    kk = jax.vmap(jax.random.split)(keys)       # (B,2,2)
+                    keys, subs = kk[:, 0], kk[:, 1]
+                    flt = _filter_logits(logits, temp[:, None],
+                                         top_k, top_p)
+                    tok = jax.vmap(jax.random.categorical)(
+                        subs, flt).astype(jnp.int32)
+                else:
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                tok = jnp.where(done, jnp.where(eos >= 0, eos, 0), tok)
+                done = jnp.logical_or(done, tok == eos)
+                return tok, keys, done
+
+            def body(carry, _):
+                logits, kc, vc, pos, keys, done = carry
+                tok, keys, done = pick(logits, keys, done)
+                logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
+                                                 vc, pos, max_len,
+                                                 sharded=shd)
+                pos = jnp.minimum(pos + 1, max_len - 1)
+                return (logits, kc, vc, pos, keys, done), tok
+
+            (logits, kc, vc, pos, keys, done), toks = jax.lax.scan(
+                body, (logits, kc, vc, pos, keys, done), None,
+                length=steps)
+            logits, kc, vc, pos, keys, done = pin_carry(
+                logits, kc, vc, pos, keys, done)
+            if shd:
+                eos = srd.constrain(eos, "eos", head_major)
+                temp = srd.constrain(temp, "temp", head_major)
+            return (jnp.moveaxis(toks, 0, 1), logits, kc, vc, pos, keys,
+                    done, eos, temp)
+
         self._prefill = self._counted(jax.jit(prefill), "decode.prefill")
         self._step = self._counted(jax.jit(step), "decode.step")
         self._fused_decode = self._counted(jax.jit(
@@ -720,6 +971,19 @@ class LlamaDecoder:
             "decode.chunk_step")
         self._admit_prefill = self._counted(jax.jit(admit_prefill),
                                             "decode.admit_prefill")
+        # ring-admission variants: same fault sites as their plain
+        # counterparts — the serving ladder, fault plans and the obs
+        # span-vs-dispatch accounting see ONE logical site per role
+        self._ring_chunk_decode = self._counted(jax.jit(
+            ring_chunk_decode,
+            static_argnames=("steps", "do_sample", "top_k", "top_p")),
+            "decode.chunk")
+        self._ring_chunk_step = self._counted(jax.jit(
+            ring_chunk_decode,
+            static_argnames=("steps", "do_sample", "top_k", "top_p")),
+            "decode.chunk_step")
+        self._ring_admit_prefill = self._counted(jax.jit(
+            ring_admit_prefill), "decode.admit_prefill")
 
     def _counted(self, jitted, site="decode.dispatch"):
         """Count dispatches AND guard each one: the fault-injection hook
@@ -805,14 +1069,23 @@ class LlamaDecoder:
 
     # -- chunked resumable decode -----------------------------------------
     def init_decode_state(self, input_ids, eos_token_id=None,
-                          temperature: float = 1.0, seed: int = 0
+                          temperature: float = 1.0, seed: int = 0,
+                          draft_model=None,
+                          num_speculative_tokens: Optional[int] = None,
+                          draft_quant: Optional[str] = None
                           ) -> DecodeState:
         """Prefill (one dispatch) and build the exportable loop carry for
         ``decode_chunk``. Whole-batch entry: every row starts from the
         same prompt tensor; the serving engine instead assembles mixed
         states row by row via its admission path. Per-row keys are
         ``split(PRNGKey(seed), B)`` — row i's sampled stream depends only
-        on ``keys[i]``, never on its neighbours."""
+        on ``keys[i]``, never on its neighbours.
+
+        With ``draft_model`` the carry is SPECULATIVE: it additionally
+        holds the draft's prefilled caches (one extra counted dispatch),
+        the per-row pending-token sentinel ``tok=-1`` and zeroed
+        cumulative acceptance stats — ``decode_chunk`` then advances it
+        by draft/verify/accept rounds instead of single steps."""
         import jax.random as jrandom
 
         ids = jnp.asarray(np.asarray(input_ids))
@@ -820,6 +1093,28 @@ class LlamaDecoder:
         kc, vc = self._empty_cache(B)
         logits, kc, vc = self._prefill(self.params, ids, kc, vc)
         eos_n = _normalize_eos(eos_token_id)
+        kw = {}
+        if draft_model is not None:
+            from paddle_tpu.flags import flags
+            K = int(num_speculative_tokens
+                    if num_speculative_tokens is not None
+                    else flags.decode_speculative_tokens)
+            if K < 1:
+                raise ValueError(
+                    f"num_speculative_tokens must be >= 1, got {K}")
+            eng = self._spec_engine(draft_model, draft_quant)
+            dkc, dvc = self._empty_cache(B, eng["cfg"])
+            _, dkc, dvc = eng["prefill"](eng["params"], ids, dkc, dvc)
+            kw = dict(dkc=dkc, dvc=dvc,
+                      tok=jnp.full((B,), -1, jnp.int32),
+                      spec_rounds=jnp.zeros((B,), jnp.int32),
+                      spec_accepted=jnp.zeros((B,), jnp.int32),
+                      spec={"ekey": eng["ekey"], "K": K})
+        elif num_speculative_tokens is not None:
+            raise ValueError("num_speculative_tokens requires a "
+                             "draft_model")
+        elif draft_quant is not None:
+            raise ValueError("draft_quant requires a draft_model")
         state = DecodeState(
             logits=logits, kc=kc, vc=vc,
             pos=jnp.full((B,), S, jnp.int32),
@@ -828,7 +1123,7 @@ class LlamaDecoder:
             done=jnp.zeros((B,), jnp.bool_),
             eos=jnp.full((B,), -1 if eos_n is None else int(eos_n),
                          jnp.int32),
-            temp=jnp.full((B,), float(temperature), jnp.float32))
+            temp=jnp.full((B,), float(temperature), jnp.float32), **kw)
         if self.sharding is not None:
             # per-row fields join the mesh (batch over dp); logits and
             # caches already came out of the prefill pinned
@@ -843,11 +1138,36 @@ class LlamaDecoder:
         Chaining chunks totalling N steps emits the same greedy tokens,
         bit-exactly, as one run-to-completion ``generate`` of N — the
         property continuous batching rides on (a request's output can't
-        depend on how admission sliced its decode into dispatches)."""
+        depend on how admission sliced its decode into dispatches).
+
+        A SPECULATIVE carry (``init_decode_state(draft_model=...)``)
+        routes to the chunked speculative program instead:
+        ``num_tokens`` counts verify ROUNDS (each committing 1..K+1
+        tokens), the returned token buffer is
+        ``(B, num_tokens*(K+1)+1)`` and the new state's ``nv`` holds
+        each row's valid count, at least ``num_tokens`` (slice
+        ``toks[i, :nv[i]]``; everything past ``num_tokens`` is
+        acceptance overflow — the per-dispatch token yield that IS the
+        speculative dispatch reduction)."""
         if state.dkc is not None:
-            raise NotImplementedError(
-                "chunked decode does not carry draft caches yet "
-                "(speculative continuous batching is a ROADMAP item)")
+            eng = self._spec_engines[state.spec["ekey"]]
+            K = int(state.spec["K"])
+            (toks, nv, logits, kc, vc, dkc, dvc, pos, keys, done, eos,
+             temp, tok, sr, sa) = eng["chunk"](
+                self.params, eng["params"], state.logits, state.kc,
+                state.vc, state.dkc, state.dvc, state.pos, state.keys,
+                state.done, state.eos, state.temp, state.tok,
+                state.spec_rounds, state.spec_accepted,
+                None, None, None, None, None,      # no admission ring
+                None, None, None, None, None,
+                steps=int(num_tokens), K=K, do_sample=bool(do_sample),
+                top_k=None if top_k is None else int(top_k),
+                top_p=None if top_p is None else float(top_p))
+            return toks, dataclasses.replace(
+                state, logits=logits, kc=kc, vc=vc, dkc=dkc, dvc=dvc,
+                pos=pos, keys=keys, done=done, eos=eos, temp=temp,
+                tok=tok, spec_rounds=sr, spec_accepted=sa, nv=nv,
+                steps_done=state.steps_done + int(num_tokens))
         toks, logits, kc, vc, pos, keys, done = self._chunk_decode(
             self.params, state.logits, state.kc, state.vc, state.pos,
             state.keys, state.done, state.eos, state.temp,
@@ -882,6 +1202,62 @@ class LlamaDecoder:
             if eos_norm is not None and bool(np.asarray(state.done).all()):
                 break
         return np.concatenate(out, axis=1)
+
+    def _generate_chunked_spec(self, ids, max_new, eos_norm, do_sample,
+                               temperature, top_k, top_p, seed,
+                               draft_model, draft_quant, K, chunk_size):
+        """Chunked SPECULATIVE decode: prefill(target) + prefill(draft)
+        + roughly ``ceil(max_new/(T*(1+a)))`` chunk dispatches at
+        acceptance ``a`` — each dispatch runs T verify rounds and
+        commits a per-row variable ``>= T`` tokens (``decode_chunk``'s
+        ``nv`` contract), so the speculative K-fold dispatch reduction
+        composes with chunk re-entry. Greedy tokens are bit-exact with
+        the one-dispatch fused speculative path for every ``chunk_size``
+        slicing (the chunk-slicing-invariance contract); sampling draws
+        from PER-ROW key streams like the plain chunked path.
+        Acceptance stats accumulate per row in the CARRY across chunk
+        re-entries, so ``last_spec_stats`` reports the CUMULATIVE
+        request totals — never stale, never last-chunk-only."""
+        T = int(chunk_size)
+        if T < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {T}")
+        state = self.init_decode_state(
+            ids, eos_token_id=eos_norm, temperature=temperature,
+            seed=seed, draft_model=draft_model, num_speculative_tokens=K,
+            draft_quant=draft_quant)
+        B = ids.shape[0]
+        buf = np.zeros((B, max_new), np.int32)
+        got = np.zeros((B,), np.int64)
+        while True:
+            toks, state = self.decode_chunk(
+                state, T, do_sample=do_sample, top_k=top_k, top_p=top_p)
+            toks_h, nv_h = np.asarray(toks), np.asarray(state.nv)
+            for b in range(B):
+                n = min(int(nv_h[b]), int(max_new - got[b]))
+                if n > 0:
+                    buf[b, got[b]:got[b] + n] = toks_h[b, :n]
+                    got[b] += n
+            if bool((got >= max_new).all()):
+                break
+            done_h = np.asarray(state.done)
+            if eos_norm is not None and bool(done_h.all()):
+                # like the fused path's buffer, post-eos columns hold
+                # the eos fill (the trim contract both paths share)
+                for b in range(B):
+                    buf[b, got[b]:] = int(eos_norm)
+                break
+            full = got >= max_new
+            if bool(full.any()):
+                # budget-filled rows freeze (like the engine retiring a
+                # slot): they stop accumulating stat counters while
+                # their batch neighbours finish
+                state = dataclasses.replace(
+                    state, done=jnp.logical_or(state.done,
+                                               jnp.asarray(full)))
+        self._record_spec_stats(
+            int(np.asarray(state.spec_rounds).sum()),
+            int(np.asarray(state.spec_accepted).sum()), K)
+        return buf
 
     # -- speculative decoding ---------------------------------------------
     def _spec_engine(self, draft_model, draft_quant: Optional[str] = None):
@@ -919,6 +1295,8 @@ class LlamaDecoder:
         eng = self._spec_engines.get(ekey)
         if eng is not None:
             return eng
+        shd = self.sharding if self.sharding is not None else False
+        srd, head_major = self.sharding, self._head_major
         if isinstance(draft_model, str):
             dcfg = dataclasses.replace(cfg, num_hidden_layers=n)
             dp = self.params
@@ -930,10 +1308,13 @@ class LlamaDecoder:
                     f"vocab_size {cfg.vocab_size}")
             dp = _build_params(draft_model, max_len,
                                "int8" if draft_quant else self.weight_dtype)
+            if srd is not None:
+                dp = srd.shard_params(dp)
 
         def draft_prefill(dp_, ids, dkc, dvc):
             self.trace_count += 1
-            return _forward_cached(dp_, dcfg, ids, dkc, dvc, 0, max_len)
+            return _forward_cached(dp_, dcfg, ids, dkc, dvc, 0, max_len,
+                                   sharded=shd)
 
         def spec_round(p, dp_, tok, pos, key, done, kc, vc, dkc, dvc,
                        eos_id, temperature, K: int, do_sample: bool,
@@ -942,7 +1323,7 @@ class LlamaDecoder:
             return _spec_round(p, dp_, cfg, dcfg, tok, pos, key, done, kc,
                                vc, dkc, dvc, eos_id, temperature, max_len,
                                K=K, do_sample=do_sample, use_eos=use_eos,
-                               top_k=top_k, top_p=top_p)
+                               top_k=top_k, top_p=top_p, sharded=shd)
 
         def spec_decode(p, dp_, logits0, kc, vc, dkc, dvc, pos0, key0,
                         done0, eos_id, temperature, max_new: int, K: int,
@@ -982,7 +1363,7 @@ class LlamaDecoder:
                                     done, kc, vc, dkc, dvc, eos_id,
                                     temperature, max_len, K=K,
                                     do_sample=do_sample, use_eos=use_eos,
-                                    top_k=top_k, top_p=top_p)
+                                    top_k=top_k, top_p=top_p, sharded=shd)
                 sr = sr + jnp.sum(live.astype(jnp.int32))
                 sa = sa + jnp.sum(jnp.where(live, a, 0).astype(jnp.int32))
                 idx = (pos - pos0 + 1)[:, None] + jidx
@@ -1002,8 +1383,166 @@ class LlamaDecoder:
                 (buf, pos, tok0, key0, done, kc, vc, dkc, dvc, z, z))
             return out[0], out[9], out[10]
 
+        def pin_spec_carry(logits, kc, vc, dkc, dvc, pos, keys, done,
+                           eos, temp, tok, sr, sa):
+            if srd is None:
+                return (logits, kc, vc, dkc, dvc, pos, keys, done, eos,
+                        temp, tok, sr, sa)
+            c = lambda x, f: srd.constrain(x, f, head_major)  # noqa: E731
+            return (c(logits, "logits"), c(kc, "kc"), c(vc, "vc"),
+                    c(dkc, "dkc"), c(dvc, "dvc"), c(pos, "pos"),
+                    c(keys, "keys"), c(done, "done"), c(eos, "eos"),
+                    c(temp, "temp"), c(tok, "tok"), c(sr, "spec_rounds"),
+                    c(sa, "spec_accepted"))
+
+        def spec_chunk(p, dp_, logits0, kc, vc, dkc, dvc, pos0, keys0,
+                       done0, eos0, temp0, tok0, sr0, sa0,
+                       ring_logits, ring_kc, ring_vc, ring_dkc, ring_dvc,
+                       ring_slot, ring_pos, ring_keys, ring_eos,
+                       ring_temp, steps: int, K: int, do_sample: bool,
+                       top_k, top_p):
+            """CHUNKED speculative decode: exactly ``steps=T``
+            draft/verify/accept rounds (``_spec_round_rows`` — per-row
+            keys/eos/temps, the serving carry contract) as one
+            re-enterable dispatch. A plain chunk buys T tokens per row
+            for T forwards; here the SAME T sequential rounds commit a
+            variable 1..K+1 tokens per row each — ~``T*(1+a)`` tokens
+            per dispatch at acceptance ``a``, which IS the K-fold
+            dispatch reduction, kept intact across chunk boundaries.
+            The output buffer is ``(B, T*(K+1)+1)`` (fresh-pick column
+            plus T rounds) with a per-row valid count ``nv`` in
+            ``[T, T*(K+1)+1]`` (harvest slices ``buf[i, :nv[i]]``;
+            nothing is thrown away). Chunk-slicing
+            invariance holds because the per-row ROUND sequence is
+            continuous across chunk boundaries — no round is re-run, no
+            committed token is dropped, so every T slicing replays the
+            fused path's exact stream (greedy AND per-row-keyed
+            sampled). The carry's pending token ``tok`` (-1 = pick
+            fresh from ``logits``, the state of an admitted row) is
+            what makes re-entry exact: unlike the plain chunk, the last
+            committed token of a round is not yet in the caches when
+            the chunk ends. Acceptance stats accumulate PER ROW in the
+            carry (``sr``/``sa``), reset by admission — chunk re-entry
+            can neither lose rounds nor double-report them. The ring
+            prologue is the same device-side slot refill as the plain
+            ring chunk (plus the draft caches and spec-field resets)."""
+            self.trace_count += 1
+            T = int(steps)
+            B = logits0.shape[0]
+            logits, pos, keys, done = logits0, pos0, keys0, done0
+            eos, temp, tok, sr, sa = eos0, temp0, tok0, sr0, sa0
+            if ring_slot is not None:
+                tgt = jnp.where(ring_slot >= 0, ring_slot, B)
+                logits = logits.at[tgt].set(ring_logits, mode="drop")
+                kc = _row_scatter(kc, ring_kc, tgt)
+                vc = _row_scatter(vc, ring_vc, tgt)
+                dkc = _row_scatter(dkc, ring_dkc, tgt)
+                dvc = _row_scatter(dvc, ring_dvc, tgt)
+                pos = pos.at[tgt].set(ring_pos, mode="drop")
+                keys = keys.at[tgt].set(ring_keys, mode="drop")
+                done = done.at[tgt].set(False, mode="drop")
+                eos = eos.at[tgt].set(ring_eos, mode="drop")
+                temp = temp.at[tgt].set(ring_temp, mode="drop")
+                tok = tok.at[tgt].set(-1, mode="drop")
+                sr = sr.at[tgt].set(0, mode="drop")
+                sa = sa.at[tgt].set(0, mode="drop")
+            fill = jnp.where(eos >= 0, eos, 0)
+            need = tok < 0           # no pending token: fresh pick
+            if do_sample:
+                kk = jax.vmap(jax.random.split)(keys)
+                flt = _filter_logits(logits, temp[:, None], top_k, top_p)
+                cand = jax.vmap(jax.random.categorical)(
+                    kk[:, 1], flt).astype(jnp.int32)
+                # only picked rows consume their key split
+                keys = jnp.where(need[:, None], kk[:, 0], keys)
+            else:
+                cand = jnp.argmax(logits, -1).astype(jnp.int32)
+            cand = jnp.where(done, fill, cand)
+            done = jnp.where(need, jnp.logical_or(done, cand == eos),
+                             done)
+            tok = jnp.where(need, cand, tok)
+            # fresh pick (1) + T rounds of at most K+1 commits each
+            W = T * (K + 1) + 1
+            buf = jnp.zeros((B, W), jnp.int32)
+            buf = buf.at[:, 0].set(jnp.where(need, tok, 0))
+            cnt = jnp.where(need, 1, 0).astype(jnp.int32)
+            rows = jnp.arange(B)[:, None]
+            jidx = jnp.arange(K + 1)[None, :]
+
+            def body(_, c):
+                (buf, cnt, logits, tok, pos, keys, done, kc, vc, dkc,
+                 dvc, sr, sa) = c
+                live = jnp.logical_not(done)
+                (emit, a, tok2, lg2, keys2, done2, kc, vc, dkc,
+                 dvc) = _spec_round_rows(
+                    p, dp_, cfg, dcfg, tok, pos, keys, done, kc, vc,
+                    dkc, dvc, eos, temp, max_len, K=K,
+                    do_sample=do_sample, top_k=top_k, top_p=top_p,
+                    sharded=shd)
+                idx = cnt[:, None] + jidx
+                valid = jidx <= a[:, None]
+                idx = jnp.where(valid, idx, W)         # OOB -> dropped
+                buf = buf.at[rows, idx].set(emit, mode="drop")
+                sr = sr + jnp.where(live, 1, 0).astype(jnp.int32)
+                sa = sa + jnp.where(live, a, 0).astype(jnp.int32)
+                cnt = cnt + a + 1
+                # rows past their budget keep their (discarded) writes
+                # clamped where a full round still fits the cache
+                pos = jnp.minimum(pos + a + 1, max_len - K - 1)
+                return (buf, cnt, lg2, tok2, pos, keys2, done2, kc, vc,
+                        dkc, dvc, sr, sa)
+
+            (buf, cnt, logits, tok, pos, keys, done, kc, vc, dkc, dvc,
+             sr, sa) = jax.lax.fori_loop(
+                0, T, body, (buf, cnt, logits, tok, pos, keys, done, kc,
+                             vc, dkc, dvc, sr, sa))
+            (logits, kc, vc, dkc, dvc, pos, keys, done, eos, temp, tok,
+             sr, sa) = pin_spec_carry(logits, kc, vc, dkc, dvc, pos,
+                                      keys, done, eos, temp, tok, sr, sa)
+            return (buf, cnt, logits, kc, vc, dkc, dvc, pos, keys, done,
+                    eos, temp, tok, sr, sa)
+
+        def spec_demote(p, logits0, kc, vc, tok, pos):
+            """One-time speculative->chunked demotion of a live carry:
+            the pending token (the one speculative re-entry would have
+            verified) is committed to the target caches with a single
+            masked forward, yielding PICK-READY logits and pos+1 — after
+            which the plain chunk program serves the state and the draft
+            caches are dropped. Rows with no pending token (tok < 0)
+            keep their logits; their placeholder write at ``pos`` is
+            overwritten by the next real write at the same offset before
+            attention could unmask it."""
+            self.trace_count += 1
+            need = tok >= 0
+            t = jnp.where(need, tok, 0)
+            lg, kc, vc = _forward_cached(p, cfg, t[:, None], kc, vc, pos,
+                                         max_len, sharded=shd)
+            logits = jnp.where(need[:, None], lg, logits0)
+            pos = jnp.where(need, jnp.minimum(pos + 1, max_len - 1), pos)
+            if srd is not None:
+                logits = srd.constrain(logits, "logits", head_major)
+                kc = srd.constrain(kc, "kc", head_major)
+                vc = srd.constrain(vc, "vc", head_major)
+                pos = srd.constrain(pos, "pos", head_major)
+            return logits, kc, vc, pos
+
+        def ring_draft_prefill(dp_, ids, dkc, dvc, ring_dkc, ring_dvc,
+                               ring_idx):
+            """Draft-side admission prefill, staged straight into the
+            ring's draft caches (one counted dispatch per admission
+            group — the speculative analog of ``ring_admit_prefill``)."""
+            self.trace_count += 1
+            _, dkc, dvc = _forward_cached(dp_, dcfg, ids, dkc, dvc, 0,
+                                          max_len, sharded=shd)
+            ring_dkc = _row_scatter(ring_dkc, dkc, ring_idx)
+            ring_dvc = _row_scatter(ring_dvc, dvc, ring_idx)
+            if srd is not None:
+                ring_dkc = srd.constrain(ring_dkc, "dkc", head_major)
+                ring_dvc = srd.constrain(ring_dvc, "dvc", head_major)
+            return ring_dkc, ring_dvc
+
         eng = {
-            "cfg": dcfg, "params": dp,
+            "cfg": dcfg, "params": dp, "ekey": ekey,
             "prefill": self._counted(jax.jit(draft_prefill),
                                      "spec.prefill"),
             "round": self._counted(jax.jit(spec_round, static_argnames=(
@@ -1012,6 +1551,21 @@ class LlamaDecoder:
             "decode": self._counted(jax.jit(spec_decode, static_argnames=(
                 "max_new", "K", "do_sample", "use_eos", "top_k",
                 "top_p")), "spec.decode"),
+            # chunked speculative decode dispatches under the SAME fault
+            # site as the plain chunk: to the serving ladder and fault
+            # plans there is one "the chunk dispatch" site, whatever
+            # program backs it
+            "chunk": self._counted(jax.jit(spec_chunk, static_argnames=(
+                "steps", "K", "do_sample", "top_k", "top_p")),
+                "decode.chunk"),
+            "chunk_step": self._counted(jax.jit(
+                spec_chunk, static_argnames=(
+                    "steps", "K", "do_sample", "top_k", "top_p")),
+                "decode.chunk_step"),
+            "demote": self._counted(jax.jit(spec_demote),
+                                    "decode.spec_demote"),
+            "ring_prefill": self._counted(jax.jit(ring_draft_prefill),
+                                          "spec.prefill"),
         }
         self._spec_engines[ekey] = eng
         return eng
@@ -1087,18 +1641,11 @@ class LlamaDecoder:
         fallback = decode_fallback_active()
         ladder = []
         if draft_model is not None:
-            if self.sharding is not None:
-                # typed refusal at generate() time: speculative decode on
-                # a mesh either works or is refused up front — never a
-                # mid-dispatch failure the ladder would misread as
-                # transient (SpeculativeMeshError classifies fatal)
-                from paddle_tpu.inference.sharding import \
-                    SpeculativeMeshError
-                raise SpeculativeMeshError(
-                    "speculative decode does not run on a mesh yet: the "
-                    "per-row uneven cache advance has no trusted sharded "
-                    "lowering; drop draft_model or build the decoder "
-                    "without mesh=")
+            # speculative decode runs on a mesh now: the per-row uneven
+            # cache advance lowers through shard_map (_cache_update) and
+            # is parity-tested bit-exact on the virtual CPU mesh — the
+            # former SpeculativeMeshError refusal survives only on the
+            # bundle-export surface
             from paddle_tpu.flags import flags
             K = int(num_speculative_tokens
                     if num_speculative_tokens is not None
@@ -1113,23 +1660,25 @@ class LlamaDecoder:
                     f"exceeds max_len {self.max_len}; build the decoder "
                     f"with more slack")
             eng = self._spec_engine(draft_model, draft_quant)
-            gen = (self._generate_speculative_fallback if fallback
-                   else self._generate_speculative)
-            ladder.append(("speculative", lambda: gen(
-                ids, max_new_tokens, eos_token_id, do_sample, temperature,
-                top_k, top_p, seed, eng, K)))
+            if chunk_size is not None and not fallback:
+                ladder.append(("speculative",
+                               lambda: self._generate_chunked_spec(
+                                   ids, max_new_tokens, eos_token_id,
+                                   do_sample, temperature, top_k, top_p,
+                                   seed, draft_model, draft_quant, K,
+                                   chunk_size)))
+            else:
+                gen = (self._generate_speculative_fallback if fallback
+                       else self._generate_speculative)
+                ladder.append(("speculative", lambda: gen(
+                    ids, max_new_tokens, eos_token_id, do_sample,
+                    temperature, top_k, top_p, seed, eng, K)))
         elif num_speculative_tokens is not None:
             raise ValueError("num_speculative_tokens requires a "
                              "draft_model")
         elif draft_quant is not None:
             raise ValueError("draft_quant requires a draft_model")
         if chunk_size is not None:
-            if draft_model is not None:
-                raise ValueError(
-                    "chunk_size does not compose with draft_model yet: "
-                    "speculative decode commits a variable token count "
-                    "per round (chunked speculative decode is a ROADMAP "
-                    "item)")
             if not fallback:
                 ladder.append(("chunked", lambda: self._generate_chunked(
                     ids, max_new_tokens, eos_token_id, do_sample,

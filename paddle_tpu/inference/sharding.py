@@ -56,12 +56,17 @@ class QuantizedKVMeshError(NotImplementedError):
 
 
 class SpeculativeMeshError(NotImplementedError):
-    """Speculative decoding is not supported on a mesh yet: the
-    draft/verify while-loop advances rows unevenly, and its per-row cache
-    scatter has no sharded lowering we trust for parity. Typed so
-    ``generate()`` refuses up front instead of failing mid-dispatch
-    (and so the resilience classifier treats it as fatal, never a
-    retry/degrade candidate)."""
+    """Historically: speculative decoding refused on a mesh. The live
+    decode path now RUNS speculation under dp/tp meshes — the per-row
+    uneven cache advance lowers through ``shard_map`` (dp splits the
+    batch, tp splits heads; the per-row dynamic-update-slice needs no
+    collectives, so the local-shard body is the single-device body) and
+    is parity-tested bit-exact on the virtual CPU mesh. The type remains
+    for the one surface that still refuses: exporting a SPECULATIVE AOT
+    bundle from a mesh-built decoder (``export_decoder_bundle``), where
+    the serialized entries would bake the mesh topology into the draft
+    programs. Typed so the refusal stays up-front and the resilience
+    classifier treats it as fatal, never a retry/degrade candidate."""
 
 
 # Megatron-parity rules over the DECODE param dict (_build_params names:
@@ -143,13 +148,21 @@ class DecodeSharding:
         return NamedSharding(self.jax_mesh,
                              guarded_spec(shape, entries, self.mesh))
 
+    def guarded(self, shape, entries):
+        """Guarded raw ``PartitionSpec`` for one array shape — what
+        ``shard_map`` in/out_specs take (``named`` wraps the same spec in
+        a NamedSharding for device_put/constraint use)."""
+        from paddle_tpu.parallel.placements import guarded_spec
+        return guarded_spec(shape, entries, self.mesh)
+
     def state_entries(self, field: str, ndim: int,
                       head_major: Optional[bool] = None) -> tuple:
         """Spec entries for one ``DecodeState`` field."""
         dp, tp = self.dp_axis, self.tp_axis
         if field == "logits":              # (B, V): vocab-sharded logits
             return (dp, tp)
-        if field in ("pos", "done", "eos", "temp"):
+        if field in ("pos", "done", "eos", "temp", "tok", "spec_rounds",
+                     "spec_accepted", "nv"):
             return (dp,)
         if field == "keys":                # (B, 2) raw uint32 keys
             return (dp, None)
@@ -193,8 +206,12 @@ class DecodeSharding:
         import dataclasses
         kw = {}
         for f in ("logits", "kc", "vc", "pos", "keys", "done", "eos",
-                  "temp"):
-            kw[f] = self.put_state_field(f, getattr(state, f), head_major)
+                  "temp", "dkc", "dvc", "tok", "spec_rounds",
+                  "spec_accepted", "nv"):
+            v = getattr(state, f, None)
+            if v is None:
+                continue                  # plain carries skip spec fields
+            kw[f] = self.put_state_field(f, v, head_major)
         return dataclasses.replace(state, **kw)
 
     def constrain(self, x, field: str, head_major: bool):

@@ -176,10 +176,14 @@ def _check_quant_ask(quant, have, what: str) -> None:
 
 class _DecoderBackend:
     """In-process backend: the jitted chunk/admission entries of a
-    ``LlamaDecoder``."""
+    ``LlamaDecoder``. The only backend with a device admission ring
+    (``has_ring``) and speculative chunk entries (``draft_model=``)."""
+
+    has_ring = True
 
     def __init__(self, dec, num_slots, chunk_size, do_sample, top_k, top_p,
-                 mesh=None, quant=None):
+                 mesh=None, quant=None, draft_model=None,
+                 num_speculative_tokens=None, draft_quant=None):
         from paddle_tpu.inference.sharding import MeshMismatchError
         _check_quant_ask(quant, getattr(dec, "quant", None),
                          "this LlamaDecoder")
@@ -200,10 +204,28 @@ class _DecoderBackend:
                 raise MeshMismatchError(
                     f"engine mesh {want.axes} does not match the "
                     f"decoder's {self.sharding.axes}")
+        self.spec_eng = None
+        self.K = 0
+        if draft_model is not None:
+            from paddle_tpu.flags import flags
+            K = int(num_speculative_tokens
+                    if num_speculative_tokens is not None
+                    else flags.decode_speculative_tokens)
+            if K < 1:
+                raise ValueError(
+                    f"num_speculative_tokens must be >= 1, got {K}")
+            self.spec_eng = dec._spec_engine(draft_model, draft_quant)
+            self.K = K
+        elif num_speculative_tokens is not None:
+            raise ValueError("num_speculative_tokens requires a "
+                             "draft_model")
+        elif draft_quant is not None:
+            raise ValueError("draft_quant requires a draft_model")
         self._kw = dict(
             do_sample=bool(do_sample),
             top_k=None if top_k is None else int(top_k),
             top_p=None if top_p is None else float(top_p))
+        self._ring_logits = None
 
     def event_count(self) -> int:
         return len(self.dec._events)
@@ -217,6 +239,18 @@ class _DecoderBackend:
         from paddle_tpu.inference.generate import DecodeState
         B = self.num_slots
         kc, vc = self.dec._empty_cache(B)   # born sharded under a mesh
+        kw = {}
+        if self.spec_eng is not None:
+            # speculative serving carry: empty draft caches (admission
+            # ring-prefills each row's), the pending-token sentinel and
+            # zeroed per-row cumulative acceptance stats
+            dkc, dvc = self.dec._empty_cache(B, self.spec_eng["cfg"])
+            kw = dict(dkc=dkc, dvc=dvc,
+                      tok=jnp.full((B,), -1, jnp.int32),
+                      spec_rounds=jnp.zeros((B,), jnp.int32),
+                      spec_accepted=jnp.zeros((B,), jnp.int32),
+                      nv=jnp.zeros((B,), jnp.int32),
+                      spec={"ekey": self.spec_eng["ekey"], "K": self.K})
         st = DecodeState(
             logits=jnp.zeros((B, self.dec.cfg.vocab_size), jnp.float32),
             kc=kc, vc=vc,
@@ -224,10 +258,113 @@ class _DecoderBackend:
             keys=jnp.zeros((B, 2), jnp.uint32),
             done=jnp.ones((B,), jnp.bool_),    # every slot starts free
             eos=jnp.full((B,), -1, jnp.int32),
-            temp=jnp.ones((B,), jnp.float32))
+            temp=jnp.ones((B,), jnp.float32), **kw)
         if self.sharding is not None:
             st = self.sharding.put_state(st, self.head_major)
         return st
+
+    # -- device admission ring ---------------------------------------------
+    def ring_init(self, R: int) -> None:
+        """Allocate the R-row device staging buffers the ring admission
+        prefill scatters into (plus the draft-cache ring under
+        speculation). Born under the carry's shardings on a mesh."""
+        import jax.numpy as jnp
+        self._ring_logits = jnp.zeros((R, self.dec.cfg.vocab_size),
+                                      jnp.float32)
+        self._ring_kc, self._ring_vc = self.dec._empty_cache(R)
+        self._ring_dkc = self._ring_dvc = None
+        if self.spec_eng is not None:
+            self._ring_dkc, self._ring_dvc = self.dec._empty_cache(
+                R, self.spec_eng["cfg"])
+
+    def ring_admit(self, ids, true_len, pos0, ring_idx):
+        """ONE counted admission-prefill dispatch whose results stage
+        straight into device ring rows ``ring_idx`` — no host round-trip
+        for the row state."""
+        import jax.numpy as jnp
+        ids = np.asarray(ids)
+        kc, vc = self.dec._empty_cache(int(ids.shape[0]))
+        self._ring_logits, self._ring_kc, self._ring_vc = \
+            self.dec._ring_admit_prefill(
+                self.dec.params, jnp.asarray(ids, jnp.int32), kc, vc,
+                jnp.asarray(np.asarray(true_len), jnp.int32),
+                jnp.asarray(np.asarray(pos0), jnp.int32),
+                self._ring_logits, self._ring_kc, self._ring_vc,
+                jnp.asarray(np.asarray(ring_idx), jnp.int32))
+
+    def ring_admit_draft(self, ids, ring_idx):
+        """The draft-model analog: one counted dispatch prefills the
+        admitted prompts through the draft and stages the caches into
+        the ring's draft buffers."""
+        import jax.numpy as jnp
+        eng = self.spec_eng
+        ids = np.asarray(ids)
+        dkc, dvc = self.dec._empty_cache(int(ids.shape[0]), eng["cfg"])
+        self._ring_dkc, self._ring_dvc = eng["ring_prefill"](
+            eng["params"], jnp.asarray(ids, jnp.int32), dkc, dvc,
+            self._ring_dkc, self._ring_dvc,
+            jnp.asarray(np.asarray(ring_idx), jnp.int32))
+
+    @staticmethod
+    def _ring_dev(ring):
+        import jax.numpy as jnp
+        slot, pos, keys, eos, temp = ring
+        return (jnp.asarray(slot, jnp.int32),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(keys, jnp.uint32),
+                jnp.asarray(eos, jnp.int32),
+                jnp.asarray(temp, jnp.float32))
+
+    def _run_ring(self, entry, st, steps, ring):
+        slot, pos, keys, eos, temp = self._ring_dev(ring)
+        (toks, logits, kc, vc, pos2, keys2, done, eos2, temp2) = entry(
+            self.dec.params, st.logits, st.kc, st.vc, st.pos, st.keys,
+            st.done, st.eos, st.temp, self._ring_logits, self._ring_kc,
+            self._ring_vc, slot, pos, keys, eos, temp,
+            steps=int(steps), **self._kw)
+        return toks, dataclasses.replace(
+            st, logits=logits, kc=kc, vc=vc, pos=pos2, keys=keys2,
+            done=done, eos=eos2, temp=temp2,
+            steps_done=st.steps_done + int(steps))
+
+    def decode_chunk_ring(self, st, chunk_size, ring):
+        return self._run_ring(self.dec._ring_chunk_decode, st,
+                              chunk_size, ring)
+
+    def decode_step_ring(self, st, ring):
+        return self._run_ring(self.dec._ring_chunk_step, st, 1, ring)
+
+    def decode_chunk_spec(self, st, chunk_size, ring):
+        """One chunked-speculative dispatch over the serving carry;
+        returns ``(buf (B, T+K), nv, new_state)`` — the overflow-buffer
+        contract the engine's harvest slices."""
+        eng = self.spec_eng
+        slot, pos, keys, eos, temp = self._ring_dev(ring)
+        (buf, nv, logits, kc, vc, dkc, dvc, pos2, keys2, done, eos2,
+         temp2, tok, sr, sa) = eng["chunk"](
+            self.dec.params, eng["params"], st.logits, st.kc, st.vc,
+            st.dkc, st.dvc, st.pos, st.keys, st.done, st.eos, st.temp,
+            st.tok, st.spec_rounds, st.spec_accepted, self._ring_logits,
+            self._ring_kc, self._ring_vc, self._ring_dkc,
+            self._ring_dvc, slot, pos, keys, eos, temp,
+            steps=int(chunk_size), K=self.K, **self._kw)
+        return buf, nv, dataclasses.replace(
+            st, logits=logits, kc=kc, vc=vc, dkc=dkc, dvc=dvc, pos=pos2,
+            keys=keys2, done=done, eos=eos2, temp=temp2, tok=tok,
+            spec_rounds=sr, spec_accepted=sa, nv=nv,
+            steps_done=st.steps_done + int(chunk_size))
+
+    def spec_demote(self, st):
+        """Speculative -> chunked demotion: one counted masked forward
+        commits each row's pending token, then the draft-side carry is
+        dropped — the plain (ring) chunk program serves the state from
+        here on."""
+        eng = self.spec_eng
+        logits, kc, vc, pos = eng["demote"](
+            self.dec.params, st.logits, st.kc, st.vc, st.tok, st.pos)
+        return dataclasses.replace(
+            st, logits=logits, kc=kc, vc=vc, pos=pos, dkc=None,
+            dvc=None, tok=None, nv=None, spec=None)
 
     # any admission batch size jits its own program; suffix prefills
     # (pos0 > 0) are native to the in-process entry
@@ -274,9 +411,25 @@ class _BundleBackend:
     StableHLO entries of a bundle exported with ``chunk_sizes=`` — the
     serving process runs no model Python (``decode_mode.chunked``)."""
 
+    has_ring = False       # bundles carry no ring-staging entries: the
+    #                        engine falls back to the host row-scatter
+    spec_eng = None
+    K = 0
+
     def __init__(self, pred, num_slots, chunk_size, do_sample, top_k,
-                 top_p, mesh=None, quant=None):
+                 top_p, mesh=None, quant=None, draft_model=None,
+                 num_speculative_tokens=None, draft_quant=None):
         from paddle_tpu.inference.sharding import MeshMismatchError
+        if draft_model is not None or num_speculative_tokens is not None \
+                or draft_quant is not None:
+            mode = (pred.meta.get("decode_mode") or {})
+            ch0 = mode.get("chunked") or {}
+            raise ValueError(
+                f"speculative serving needs the in-process LlamaDecoder "
+                f"backend: this bundle's chunked entries carry no "
+                f"speculative chunk program (decode_mode.chunked."
+                f"spec_chunk={bool(ch0.get('spec_chunk'))!r}); serve "
+                f"draft_model= over a LlamaDecoder instead")
         _check_quant_ask(quant, pred.quant_recipe, "this bundle")
         self.pred = pred
         self.quant = pred.quant_recipe
@@ -441,15 +594,19 @@ def derive_row_key(seed: int, request_id: int, tokens_emitted: int):
 
 
 def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p,
-                  mesh=None, quant=None):
+                  mesh=None, quant=None, draft_model=None,
+                  num_speculative_tokens=None, draft_quant=None):
     from paddle_tpu.inference.bundle import AotPredictor
     from paddle_tpu.inference.generate import LlamaDecoder
+    kw = dict(mesh=mesh, quant=quant, draft_model=draft_model,
+              num_speculative_tokens=num_speculative_tokens,
+              draft_quant=draft_quant)
     if isinstance(backend, LlamaDecoder):
         return _DecoderBackend(backend, num_slots, chunk_size, do_sample,
-                               top_k, top_p, mesh=mesh, quant=quant)
+                               top_k, top_p, **kw)
     if isinstance(backend, AotPredictor):
         return _BundleBackend(backend, num_slots, chunk_size, do_sample,
-                              top_k, top_p, mesh=mesh, quant=quant)
+                              top_k, top_p, **kw)
     raise TypeError(
         f"backend must be a LlamaDecoder or an AotPredictor, "
         f"got {type(backend).__name__}")
@@ -498,7 +655,11 @@ class ServingEngine:
                  snapshot_dir: Optional[str] = None,
                  snapshot_every_chunks: int = 0,
                  replica_tag: Optional[str] = None,
-                 request_keyed_rng: bool = False):
+                 request_keyed_rng: bool = False,
+                 draft_model=None,
+                 num_speculative_tokens: Optional[int] = None,
+                 draft_quant: Optional[str] = None,
+                 ring_slots: Optional[int] = None):
         """``prefix_cache``: ``None`` reads the
         ``FLAGS_serving_prefix_cache_bytes`` /
         ``PADDLE_TPU_PREFIX_CACHE_BYTES`` budget (0 = disabled, the
@@ -535,13 +696,36 @@ class ServingEngine:
         identical stream, so non-greedy requeue replay is bit-exact
         too. Off by default: the classic seed-only rule keeps
         engine-sampled outputs bit-exact with a solo
-        ``generate(do_sample=True)`` of the same seed."""
+        ``generate(do_sample=True)`` of the same seed.
+        ``draft_model``/``num_speculative_tokens``/``draft_quant``:
+        SPECULATIVE serving (LlamaDecoder backend only) — every chunk
+        dispatch runs draft/verify/accept rounds committing a per-row
+        variable ``[chunk_size, chunk_size+K]`` tokens, the K-fold
+        tokens-per-dispatch win of Leviathan et al. under continuous
+        batching; greedy tokens stay bit-exact with the plain engine.
+        ``ring_slots``: rows in the device admission ring (default
+        ``num_slots``; LlamaDecoder backend only) — admissions stage
+        prefill results device-side and the next chunk program splices
+        them in, so steady state is exactly one dispatch per chunk;
+        admissions beyond the ring's free rows re-queue at their tier's
+        head (``serving.admission.ring_full``)."""
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
         self.chunk_size = int(chunk_size)
         self._b = _make_backend(backend, num_slots, chunk_size, do_sample,
-                                top_k, top_p, mesh=mesh, quant=quant)
+                                top_k, top_p, mesh=mesh, quant=quant,
+                                draft_model=draft_model,
+                                num_speculative_tokens=num_speculative_tokens,
+                                draft_quant=draft_quant)
+        self._spec_configured = self._b.spec_eng is not None
+        self._spec_active = self._spec_configured
+        if self._spec_configured and (snapshot_dir or snapshot_every_chunks):
+            raise ValueError(
+                "speculative serving does not snapshot yet: the carry's "
+                "draft caches and pending-token fields are outside the "
+                "snapshot payload — drop snapshot_dir/"
+                "snapshot_every_chunks or serve without draft_model")
         # on a mesh the slot table maps onto the dp axis: contiguous
         # blocks of num_slots/dp rows are one data-parallel replica's
         # slots (jax shards a dim into contiguous blocks); the scheduler
@@ -562,6 +746,35 @@ class ServingEngine:
         self.batch_admission = bool(batch_admission)
         self.prefix_cache = self._resolve_prefix_cache(
             prefix_cache, prefix_cache_bytes, prefix_block_tokens)
+        if self._spec_configured and self.prefix_cache is not None:
+            raise ValueError(
+                "speculative serving does not compose with the prefix "
+                "cache yet: slab admission bypasses the ring's draft-"
+                "cache staging — disable prefix_cache or drop "
+                "draft_model")
+        # device admission ring: staged admissions splice into the carry
+        # inside the NEXT chunk dispatch (no host scatter, no extra
+        # dispatch boundary). Ring-capable backends only; the prefix-
+        # cache admission path needs the host scatter (slab loads), so
+        # the cache keeps the legacy route.
+        self._ring_slots = 0
+        self._ring_meta: List[Optional[dict]] = []
+        if self._b.has_ring and self.prefix_cache is None:
+            R = int(ring_slots if ring_slots is not None else num_slots)
+            if R < 1:
+                raise ValueError(f"ring_slots must be >= 1, got {R}")
+            self._ring_slots = R
+            self._ring_meta = [None] * R
+            self._b.ring_init(R)
+        elif ring_slots is not None:
+            raise ValueError(
+                "ring_slots needs the device admission ring: an "
+                "in-process LlamaDecoder backend without a prefix cache")
+        elif self._spec_configured:
+            raise ValueError(
+                "speculative serving needs the device admission ring "
+                "(in-process LlamaDecoder backend, no prefix cache)")
+        self._last_nv: Optional[np.ndarray] = None
         self._slab_ops = None
         if self.prefix_cache is not None:
             from paddle_tpu.serving.prefix_cache import SlabOps
@@ -707,6 +920,44 @@ class ServingEngine:
         self._c_migrated_in = r.counter(
             "serving.rows_migrated_in",
             "requests absorbed into this engine by a live migration")
+        # device admission ring: the dispatch-boundary win is visible as
+        # ring_scattered rows with ZERO host scatters — /metrics proof
+        # that steady state is one fused dispatch per chunk
+        self._c_ring_staged = r.counter(
+            "serving.admission.ring_staged",
+            "admitted rows staged into the device ring (their prefill "
+            "dispatch scattered the row state device-side)")
+        self._c_ring_scattered = r.counter(
+            "serving.admission.ring_scattered",
+            "staged rows spliced into the carry by a chunk program's "
+            "ring prologue (no host round-trip, no extra dispatch)")
+        self._c_ring_full = r.counter(
+            "serving.admission.ring_full",
+            "admissions deferred because the ring had no free row "
+            "(un-admitted and re-queued at their tier's head)")
+        self._c_host_scattered = r.counter(
+            "serving.admission.host_scattered",
+            "legacy host row-scatter admissions (prefix-cache/bundle "
+            "paths; 0 whenever the device ring serves admission)")
+        # speculative serving: cumulative verify-round economics (the
+        # acceptance_len_mean gauge is the live tokens/dispatch lever)
+        self._c_draft_prefill = r.counter(
+            "serving.draft_prefill_dispatches",
+            "draft-model admission prefills staged into the ring's "
+            "draft caches (one per admission group under speculation)")
+        self._c_spec_rounds = r.counter(
+            "serving.spec.rounds",
+            "draft/verify/accept rounds run for live rows")
+        self._c_spec_accept = r.counter(
+            "serving.spec.accepted_drafts",
+            "draft tokens accepted by verification")
+        self._c_spec_overflow = r.counter(
+            "serving.spec.overflow_tokens",
+            "tokens committed past the chunk boundary by a round that "
+            "straddled it (the (B, T+K) buffer tail the harvest kept)")
+        self._g_spec_accept_mean = r.gauge(
+            "serving.spec.acceptance_len_mean",
+            "cumulative accepted drafts per verify round")
         # crash recovery / replica identity
         self.replica_tag = None if replica_tag is None else str(replica_tag)
         self._snap_dir = snapshot_dir
@@ -802,11 +1053,17 @@ class ServingEngine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
         bucket = self.scheduler.bucket(len(prompt))
-        if max(bucket, len(prompt) + int(max_new_tokens)) > self._b.max_len:
+        # speculative rows need K extra cache rows of slack: a verify
+        # dispatch writes K+1 positions past the last committed token
+        slack = self._b.K if self._spec_configured else 0
+        if max(bucket,
+               len(prompt) + int(max_new_tokens) + slack) > self._b.max_len:
+            extra = (f" + {slack} speculative lookahead slack"
+                     if slack else "")
             raise ValueError(
                 f"prompt {len(prompt)} (bucket {bucket}) + "
-                f"{max_new_tokens} new tokens exceeds the backend's "
-                f"max_len {self._b.max_len}")
+                f"{max_new_tokens} new tokens{extra} exceeds the "
+                f"backend's max_len {self._b.max_len}")
         if deadline_s is not None:
             deadline_s = float(deadline_s)
             if deadline_s <= 0:
@@ -891,6 +1148,7 @@ class ServingEngine:
             return pre
         self._h_occ.observe(len(occupied) / self.num_slots)
         toks = self._dispatch_chunk(occupied)
+        nv = self._last_nv
         t_chunk_done = time.monotonic()
         # finite guard: one harvest-time check over the post-chunk
         # logits. A numerically poisoned row (NaN/Inf) is frozen ALONE
@@ -898,6 +1156,14 @@ class ServingEngine:
         # whole batch or, worse, migrate its poison into a peer's carry
         row_finite = np.isfinite(
             np.asarray(jax.device_get(self.state.logits))).all(axis=-1)
+        sr = sa = None
+        if self._spec_active and self.state.spec_rounds is not None:
+            # mirror the carry's per-row cumulative acceptance stats
+            # (reset by the ring prologue at admission, so each slot's
+            # values are exact per-request totals across chunk
+            # re-entries — never stale, never last-chunk-only)
+            sr = np.asarray(jax.device_get(self.state.spec_rounds))
+            sa = np.asarray(jax.device_get(self.state.spec_accepted))
         finished, freed = [], []
         for i, slot in occupied:
             slot.chunks += 1
@@ -927,7 +1193,26 @@ class ServingEngine:
                 self.scheduler.slots.release(i)
                 freed.append(i)
                 continue
-            slot.tokens.append(toks[i])
+            # speculative chunks run T verify rounds and return a wide
+            # buffer with a per-row valid count >= T: the acceptance
+            # overflow is kept, not re-generated, so the dispatch
+            # reduction survives chunk boundaries
+            slot.tokens.append(toks[i] if nv is None
+                               else toks[i][:int(nv[i])])
+            if sr is not None:
+                dr = int(sr[i]) - slot.spec_rounds
+                da = int(sa[i]) - slot.spec_accepted
+                if dr > 0:
+                    self._c_spec_rounds.inc(dr)
+                    slot.spec_rounds = int(sr[i])
+                if da > 0:
+                    self._c_spec_accept.inc(da)
+                    slot.spec_accepted = int(sa[i])
+                if nv is not None:
+                    ov = int(nv[i]) - self.chunk_size
+                    if ov > 0:
+                        slot.spec_overflow += ov
+                        self._c_spec_overflow.inc(ov)
             if slot.first_token_at is None:
                 # the slot's first tokens reached the host with THIS
                 # dispatch: admission -> here is the request's TTFT
@@ -956,6 +1241,11 @@ class ServingEngine:
                 slot.pinned_slab = None
             self.scheduler.slots.release(i)
             freed.append(i)
+        if sr is not None:
+            rt = int(self._c_spec_rounds.value)
+            if rt:
+                self._g_spec_accept_mean.set(
+                    int(self._c_spec_accept.value) / rt)
         if freed:
             self._freeze_rows(freed)
         if self._snap_every and (self.chunk_dispatches
@@ -1081,6 +1371,17 @@ class ServingEngine:
 
         from paddle_tpu.distributed.checkpoint import _np_storable
         from paddle_tpu.runtime.resilience import atomic_write_bytes
+        if self._spec_configured:
+            raise ValueError(
+                "speculative serving does not snapshot yet: the draft "
+                "cache / pending-token carry is not in the snapshot "
+                "payload; serve without draft_model= to snapshot")
+        if any(m is not None for m in self._ring_meta):
+            raise RuntimeError(
+                "snapshot() with staged-but-unscattered admission ring "
+                "rows: run one more step() so the pending ring splice "
+                "lands in the carry, then snapshot at the chunk "
+                "boundary")
         os.makedirs(path, exist_ok=True)
         st = self.state
         leaves, _ = jax.tree_util.tree_flatten(
@@ -1161,6 +1462,10 @@ class ServingEngine:
         from paddle_tpu.inference.sharding import MeshMismatchError
         from paddle_tpu.runtime.resilience import CorruptCheckpointError
         from paddle_tpu.serving.scheduler import Slot
+        if self._spec_configured:
+            raise ValueError(
+                "speculative serving does not snapshot yet: restore "
+                "into an engine built without draft_model=")
         if self._next_id or len(self.scheduler) \
                 or self.scheduler.slots.occupied():
             raise RuntimeError(
@@ -1380,6 +1685,17 @@ class ServingEngine:
         import jax
 
         from paddle_tpu.distributed.checkpoint import _np_storable
+        if self._spec_configured:
+            raise ValueError(
+                "speculative serving does not migrate rows yet: the "
+                "draft cache / pending-token carry is not in the "
+                "migration payload; serve without draft_model= to "
+                "migrate")
+        if any(m is not None for m in self._ring_meta):
+            raise RuntimeError(
+                "extract_rows() with staged-but-unscattered admission "
+                "ring rows: run one more step() so the pending ring "
+                "splice lands in the carry first")
         want = [int(i) for i in request_ids]
         by_slot = {int(s.request.id): (i, s)
                    for i, s in self.scheduler.slots.occupied()}
@@ -1485,6 +1801,10 @@ class ServingEngine:
         from paddle_tpu.distributed.checkpoint import _np_restore
         from paddle_tpu.inference.sharding import MeshMismatchError
         from paddle_tpu.runtime.resilience import SlabTransferError
+        if self._spec_configured:
+            raise ValueError(
+                "speculative serving does not migrate rows yet: absorb "
+                "into an engine built without draft_model=")
         if payload.get("kind") != "paddle_tpu.row_migration":
             raise ValueError(
                 f"absorb_rows: payload kind {payload.get('kind')!r} is "
@@ -1672,7 +1992,16 @@ class ServingEngine:
         Requests that do need a prefill are grouped by padded bucket
         width; with ``batch_admission`` each group runs as ONE batched
         dispatch (mixed cold/suffix rows — per-row pos0 keeps them
-        independent)."""
+        independent).
+
+        With the device admission ring active this whole round routes
+        through :meth:`_admit_all_ring` instead: prefills stage their
+        row state into device ring rows and the NEXT chunk program
+        splices them in — zero host scatters, zero extra dispatch
+        boundaries."""
+        if self._ring_slots:
+            self._admit_all_ring(admitted, now)
+            return
         cache = self.prefix_cache
         plans = []
         for slot_idx, req in admitted:
@@ -1706,6 +2035,119 @@ class ServingEngine:
                 for item in grp:
                     self._admit_group(w, [item], now)
         self._prefix_sync()
+
+    def _admit_all_ring(self, admitted, now: float) -> None:
+        """Ring admission round: pick a free device ring row per
+        admitted request, run one ring-staged prefill dispatch per
+        bucket group (one TOTAL per group with ``batch_admission``), and
+        record the per-row splice metadata (destination slot, resume
+        pos, row key, eos, temp) the next chunk's prologue consumes.
+        Admissions beyond the ring's free rows are UN-ADMITTED — slot
+        released, request re-queued at its tier's head with its original
+        submit_time (``ring_full`` backpressure) — and retry next step
+        once the chunk has drained the ring."""
+        import collections
+        free = collections.deque(
+            r for r, m in enumerate(self._ring_meta) if m is None)
+        if len(admitted) > len(free):
+            keep, spill = admitted[:len(free)], admitted[len(free):]
+            for slot_idx, req in reversed(spill):
+                self.scheduler.slots.release(slot_idx)
+                self.scheduler.push_front(req)
+                self._c_ring_full.inc()
+                obs.tracer.event("serving.admission.ring_full",
+                                 request=req.id,
+                                 ring_slots=self._ring_slots)
+            admitted = keep
+            self._g_qdepth.set(len(self.scheduler))
+        groups: Dict[int, list] = {}
+        for slot_idx, req in admitted:
+            w = self.scheduler.bucket(len(req.prompt))
+            groups.setdefault(w, []).append((slot_idx, req))
+        for w, grp in sorted(groups.items()):
+            if self.batch_admission and len(grp) > 1:
+                self._admit_group_ring(w, grp, free, now)
+            else:
+                for item in grp:
+                    self._admit_group_ring(w, [item], free, now)
+
+    def _admit_group_ring(self, w: int, grp, free, now: float) -> None:
+        """ONE ring-staged admission-prefill dispatch for the group
+        (plus one draft-cache staging dispatch under speculation): the
+        freshly prefilled rows land in device ring rows, never on the
+        host."""
+        import jax.random as jrandom
+        t0 = time.monotonic()
+        N = len(grp)
+        ids = np.zeros((N, w), np.int32)
+        true_len = np.zeros((N,), np.int32)
+        pos0 = np.zeros((N,), np.int32)
+        rows = [free.popleft() for _ in range(N)]
+        for j, (slot_idx, req) in enumerate(grp):
+            p = np.asarray(req.prompt)
+            ids[j, :len(p)] = p
+            true_len[j] = len(p)
+        ev0 = self._b.event_count()
+        self._b.ring_admit(ids, true_len, pos0, rows)
+        self._c_prefill.inc()
+        if self._spec_active:
+            self._b.ring_admit_draft(ids, rows)
+            self._c_draft_prefill.inc()
+        if N > 1:
+            self._c_batched_groups.inc()
+            self._c_disp_saved.inc(N - 1)
+        events = self._b.events_since(ev0)
+        for j, (slot_idx, req) in enumerate(grp):
+            if self.request_keyed_rng:
+                rng_id = (req.rng_request_id
+                          if req.rng_request_id is not None else req.id)
+                key1 = np.asarray(derive_row_key(
+                    req.seed, rng_id, req.rng_tokens_emitted))
+            else:
+                key1 = np.asarray(jrandom.split(
+                    jrandom.PRNGKey(req.seed), 1)[0])
+            self._ring_meta[rows[j]] = {
+                "slot": slot_idx, "pos": len(req.prompt),
+                "key": np.asarray(key1, np.uint32),
+                "eos": (-1 if req.eos_token_id is None
+                        else int(req.eos_token_id)),
+                "temp": float(req.temperature)}
+            self._c_ring_staged.inc()
+            self._note_admit(slot_idx, req, now, t0, "miss",
+                             tokens_saved=0,
+                             dispatches=1 if j == 0 else 0,
+                             slab=None, events=events)
+
+    def _ring_args(self) -> Tuple[tuple, int]:
+        """Host-side splice arrays for the chunk program's ring
+        prologue: per-ring-row destination slot (-1 = empty, dropped on
+        device), resume pos, row key, eos, temp. Returns ``(arrays,
+        staged_count)``."""
+        R = self._ring_slots
+        slot = np.full((R,), -1, np.int32)
+        pos = np.zeros((R,), np.int32)
+        keys = np.zeros((R, 2), np.uint32)
+        eos = np.full((R,), -1, np.int32)
+        temp = np.ones((R,), np.float32)
+        n = 0
+        for r, m in enumerate(self._ring_meta):
+            if m is None:
+                continue
+            slot[r] = m["slot"]
+            pos[r] = m["pos"]
+            keys[r] = m["key"]
+            eos[r] = m["eos"]
+            temp[r] = m["temp"]
+            n += 1
+        return (slot, pos, keys, eos, temp), n
+
+    def _ring_drained(self, n: Optional[int]) -> None:
+        """A chunk program's ring prologue ran: the staged rows are in
+        the carry now — clear the metadata and credit the scatter."""
+        if not n:
+            return
+        self._ring_meta = [None] * self._ring_slots
+        self._c_ring_scattered.inc(n)
 
     def _admit_group(self, w: int, grp, now: float) -> None:
         """ONE admission-prefill dispatch for the group: batch-N padded
@@ -1762,9 +2204,13 @@ class ServingEngine:
                  src: int, pos1: int) -> None:
         """The fused admission row-scatter: row ``src`` of the given
         row state lands in carry row ``slot_idx``. A full-prefix hit's
-        WHOLE admission is one of these."""
+        WHOLE admission is one of these. This is the LEGACY host-side
+        admission (prefix-cache and bundle backends); ring-served
+        engines never reach it (``admission.host_scattered`` stays 0)."""
         import jax.numpy as jnp
         import jax.random as jrandom
+
+        self._c_host_scattered.inc()
 
         if self.request_keyed_rng:
             # request-keyed stream: a requeued row that replays T
@@ -1839,7 +2285,53 @@ class ServingEngine:
             DecodeFailedError, DegradationEvent, classify_error,
             fault_injector, record_event)
 
+        self._last_nv = None
+        ring, n_staged = (self._ring_args() if self._ring_slots
+                          else (None, None))
+        degr: list = []
         ev0 = self._b.event_count()
+        if self._spec_active:
+            try:
+                if self.replica_tag:
+                    fault_injector.on_call(
+                        f"serving.{self.replica_tag}.chunk")
+                toks, nv, self.state = self._b.decode_chunk_spec(
+                    self.state, self.chunk_size, ring)
+                self._c_chunk.inc()
+                self._c_slot_steps.inc(self.num_slots * self.chunk_size)
+                self._ring_drained(n_staged)
+                self._last_nv = np.asarray(jax.device_get(nv))
+                self._note_events(occupied, ev0, [])
+                return np.asarray(toks)
+            except Exception as e:
+                if classify_error(e) != "transient":
+                    self._harvest_before_raise(e, "serving.chunk_fatal")
+                    raise
+                if not _flags.resilience_auto_degrade:
+                    err = DecodeFailedError(
+                        f"serving speculative chunk dispatch failed "
+                        f"with auto-degrade off: {str(e)[:300]}",
+                        events=self._b.events_since(ev0), last_error=e)
+                    self._harvest_before_raise(
+                        e, "serving.chunk_failed_no_rung")
+                    raise err from e
+                # speculative -> chunked demotion (one-way): one counted
+                # masked forward (decode.spec_demote) commits each row's
+                # pending token, the draft carry is dropped, and the
+                # plain ring chunk below serves the SAME state — no
+                # in-flight request is lost, the engine keeps serving at
+                # 1 token/step instead of dying. Admissions stop staging
+                # draft caches; per-slot acceptance stats freeze at the
+                # last successful speculative chunk.
+                ev = DegradationEvent(
+                    site="serve.chunk", from_level="speculative",
+                    to_level="chunked", error_class=type(e).__name__,
+                    error=str(e)[:300])
+                record_event(ev)
+                self._c_degr.inc()
+                degr.append(ev)
+                self.state = self._b.spec_demote(self.state)
+                self._spec_active = False
         try:
             if self.replica_tag:
                 # the per-replica fault site: a plan targeting
@@ -1847,11 +2339,16 @@ class ServingEngine:
                 # its ReplicaSet peers (different tags) keep serving
                 fault_injector.on_call(
                     f"serving.{self.replica_tag}.chunk")
-            toks, self.state = self._b.decode_chunk(self.state,
-                                                    self.chunk_size)
+            if ring is not None:
+                toks, self.state = self._b.decode_chunk_ring(
+                    self.state, self.chunk_size, ring)
+            else:
+                toks, self.state = self._b.decode_chunk(self.state,
+                                                        self.chunk_size)
             self._c_chunk.inc()
             self._c_slot_steps.inc(self.num_slots * self.chunk_size)
-            self._note_events(occupied, ev0, [])
+            self._ring_drained(n_staged)
+            self._note_events(occupied, ev0, degr)
             return np.asarray(toks)
         except Exception as e:
             if classify_error(e) != "transient":
@@ -1866,7 +2363,8 @@ class ServingEngine:
                 err = DecodeFailedError(
                     f"serving chunk dispatch failed with no per-token "
                     f"rung available: {str(e)[:300]}",
-                    events=self._b.events_since(ev0), last_error=e)
+                    events=self._b.events_since(ev0) + degr,
+                    last_error=e)
                 self._harvest_before_raise(
                     e, "serving.chunk_failed_no_rung")
                 raise err from e
@@ -1876,17 +2374,27 @@ class ServingEngine:
                 error=str(e)[:300])
             record_event(ev)
             self._c_degr.inc()
+            degr.append(ev)
         # per-token rung: T single-step dispatches on the SAME carry —
         # the failed chunk never consumed it (faults fire before
         # execution; the in-process chunk doesn't donate its inputs), so
-        # every admitted request rides through the degradation
+        # every admitted request rides through the degradation. The
+        # FIRST step carries the pending ring splice; later steps pass
+        # an empty ring (same compiled program, all rows dropped).
         parts = []
         try:
-            for _ in range(self.chunk_size):
+            for s in range(self.chunk_size):
                 if self.replica_tag:
                     fault_injector.on_call(
                         f"serving.{self.replica_tag}.step")
-                toks1, self.state = self._b.decode_step(self.state)
+                if ring is not None:
+                    toks1, self.state = self._b.decode_step_ring(
+                        self.state, ring)
+                    if s == 0:
+                        self._ring_drained(n_staged)
+                        ring, _ = self._ring_args()   # now empty
+                else:
+                    toks1, self.state = self._b.decode_step(self.state)
                 self._c_step.inc()
                 parts.append(np.asarray(toks1))
         except Exception as e2:
@@ -1903,11 +2411,11 @@ class ServingEngine:
             err = DecodeFailedError(
                 f"serving per-token rung failed after the chunk rung "
                 f"degraded: {str(e2)[:300]}",
-                events=self._b.events_since(ev0) + [ev], last_error=e2)
+                events=self._b.events_since(ev0) + degr, last_error=e2)
             self._harvest_before_raise(e2, "serving.ladder_exhausted")
             raise err from e2
         self._c_slot_steps.inc(self.num_slots * self.chunk_size)
-        self._note_events(occupied, ev0, [ev])
+        self._note_events(occupied, ev0, degr)
         return np.concatenate(parts, axis=1)
 
     def _harvest_before_raise(self, error: BaseException,
@@ -2022,6 +2530,20 @@ class ServingEngine:
                 # went NaN/Inf and the engine froze it alone, returning
                 # the pre-corruption prefix
                 "corrupt_row": bool(corrupt_row),
+                # cumulative speculative accounting for THIS request,
+                # summed across every chunk re-entry it rode through
+                # (None = engine not speculative). A request finished
+                # after a speculative->chunked demotion reports the
+                # stats frozen at the last speculative chunk.
+                "speculative": None if not self._spec_configured else {
+                    "rounds": int(slot.spec_rounds),
+                    "accepted_drafts": int(slot.spec_accepted),
+                    "acceptance_len_mean": (
+                        slot.spec_accepted / slot.spec_rounds
+                        if slot.spec_rounds else 0.0),
+                    "num_speculative_tokens": int(self._b.K),
+                    "overflow_tokens": int(slot.spec_overflow),
+                },
             },
         }
         # the request's lifetime span (submit -> finished) on the same
@@ -2143,6 +2665,32 @@ class ServingEngine:
             # slab table — also what a flight-recorder postmortem shows
             "prefix_cache": (None if self.prefix_cache is None
                              else self.prefix_cache.snapshot()),
+            # speculative rung (None = engine not speculative):
+            # ``active`` flips False after a speculative->chunked
+            # demotion, the cumulative counters keep their totals
+            "speculative": (None if not self._spec_configured else {
+                "active": bool(self._spec_active),
+                "num_speculative_tokens": int(self._b.K),
+                "rounds": int(self._c_spec_rounds.value),
+                "accepted_drafts": int(self._c_spec_accept.value),
+                "acceptance_len_mean": float(
+                    self._g_spec_accept_mean.value),
+                "overflow_tokens": int(self._c_spec_overflow.value),
+                "draft_prefill_dispatches": int(
+                    self._c_draft_prefill.value),
+            }),
+            # device admission ring (None = host-scatter admission):
+            # staged_now > 0 means prefill results are parked on device
+            # waiting for the next chunk's fused splice
+            "admission_ring": (None if not self._ring_slots else {
+                "slots": int(self._ring_slots),
+                "staged_now": sum(1 for m in self._ring_meta
+                                  if m is not None),
+                "staged": int(self._c_ring_staged.value),
+                "scattered": int(self._c_ring_scattered.value),
+                "full": int(self._c_ring_full.value),
+                "host_scattered": int(self._c_host_scattered.value),
+            }),
         }
 
     def _mesh_status(self) -> Optional[Dict[str, Any]]:
@@ -2270,5 +2818,25 @@ class ServingEngine:
                 "engine_hits_partial": int(
                     self._c_prefix["partial"].value),
                 "engine_misses": int(self._c_prefix["miss"].value),
+            }),
+            # dispatch accounting for the speculative rung: draft ring
+            # prefills are real dispatches, counted separately so
+            # tokens-per-dispatch stays honest
+            "draft_prefill_dispatches": int(self._c_draft_prefill.value),
+            "speculative": (None if not self._spec_configured else {
+                "active": bool(self._spec_active),
+                "num_speculative_tokens": int(self._b.K),
+                "rounds": int(self._c_spec_rounds.value),
+                "accepted_drafts": int(self._c_spec_accept.value),
+                "acceptance_len_mean": float(
+                    self._g_spec_accept_mean.value),
+                "overflow_tokens": int(self._c_spec_overflow.value),
+            }),
+            "admission_ring": (None if not self._ring_slots else {
+                "slots": int(self._ring_slots),
+                "staged": int(self._c_ring_staged.value),
+                "scattered": int(self._c_ring_scattered.value),
+                "full": int(self._c_ring_full.value),
+                "host_scattered": int(self._c_host_scattered.value),
             }),
         }

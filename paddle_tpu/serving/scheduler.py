@@ -119,6 +119,15 @@ class Slot:
     prefill_tokens_saved: int = 0
     admission_dispatches: int = 0
     pinned_slab: Any = None
+    # speculative serving record (engine ``draft_model=``): the row's
+    # CUMULATIVE verify rounds / accepted drafts mirrored off the carry
+    # after each chunk (the carry's per-row counters reset at admission,
+    # so these are exact per-request totals across chunk re-entries),
+    # plus the overflow tokens its chunks committed past the chunk
+    # boundary (the ``nv``-contract tail the harvest kept)
+    spec_rounds: int = 0
+    spec_accepted: int = 0
+    spec_overflow: int = 0
 
 
 class SlotTable:
@@ -210,6 +219,16 @@ class Scheduler:
             request.deadline_at = request.submit_time + request.deadline_s
         pr = request.priority if self.policy == "priority" else 0
         heapq.heappush(self._heap, (pr, next(self._seq), request))
+
+    def push_front(self, request: Request) -> None:
+        """Re-queue AHEAD of every same-priority peer — the admission
+        backpressure un-admit (engine ring full): the request keeps its
+        original ``submit_time`` (queue-delay accounting stays honest)
+        and retakes its tier's head via a negative sequence number. Call
+        in reverse admission order when re-queuing several, so the
+        earliest-admitted lands frontmost."""
+        pr = request.priority if self.policy == "priority" else 0
+        heapq.heappush(self._heap, (pr, -next(self._seq), request))
 
     def shed_expired(self, now: float) -> List[Request]:
         """Drop queued requests whose deadline already passed — checked

@@ -502,6 +502,76 @@ def test_speculative_validation_errors():
         dec.generate(prompt, max_new_tokens=4, draft_model=bad_vocab)
 
 
+def test_chunked_speculative_slicing_invariance_greedy():
+    """Tentpole: decode_chunk composes with speculation. Every
+    chunk_size slicing of a speculative generate emits the fused
+    one-dispatch speculative path's exact greedy stream (chunk
+    boundaries never re-run or drop a verify round), each chunk
+    dispatch commits at least chunk_size tokens (so the dispatch count
+    never exceeds the plain chunked path's), and ``last_spec_stats``
+    reports CUMULATIVE per-request totals across chunk re-entries."""
+    model = _model(12)
+    dec = LlamaDecoder(model, max_len=64)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 64, (3, 5))
+    kw = dict(draft_model="skip:1", num_speculative_tokens=2)
+    fused = np.asarray(dec.generate(prompt, max_new_tokens=12, **kw))
+    fstats = dec.last_spec_stats
+    assert fstats["rounds"] > 0
+    for T in (1, 2, 3, 5, 8, 12):
+        d0 = dec.dispatch_count
+        got = np.asarray(dec.generate(prompt, max_new_tokens=12,
+                                      chunk_size=T, **kw))
+        np.testing.assert_array_equal(got, fused, err_msg=f"T={T}")
+        # 2 prefills + at most ceil(max_new/T) chunks — acceptance can
+        # only SHRINK the chunk count, never grow it
+        assert dec.dispatch_count - d0 <= 2 + -(-12 // T), f"T={T}"
+        stats = dec.last_spec_stats
+        assert stats["num_speculative_tokens"] == 2
+        # cumulative across re-entries: never last-chunk-only (a single
+        # chunk can hold at most T rounds of the total)
+        assert stats["rounds"] >= fstats["rounds"], f"T={T}"
+        assert stats["accepted_drafts"] >= fstats["accepted_drafts"]
+
+
+def test_chunked_speculative_eos_mixed_rows():
+    """Chunk-slicing invariance under speculation with an eos that
+    fires EARLY in some rows and never in others: done rows hold the
+    fill while live neighbours keep verifying, for every slicing."""
+    model = _model(12)
+    dec = LlamaDecoder(model, max_len=64)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, 64, (3, 4))
+    plain = np.asarray(dec.generate(prompt, max_new_tokens=10))
+    eos = int(plain[0, 6])
+    kw = dict(draft_model="skip:1", num_speculative_tokens=2,
+              eos_token_id=eos)
+    fused = np.asarray(dec.generate(prompt, max_new_tokens=10, **kw))
+    for T in (1, 3, 7, 10):
+        got = np.asarray(dec.generate(prompt, max_new_tokens=10,
+                                      chunk_size=T, **kw))
+        np.testing.assert_array_equal(got, fused, err_msg=f"T={T}")
+
+
+def test_chunked_speculative_sampled_slicing_invariance():
+    """Sampled speculative chunking draws from PER-ROW key streams (the
+    admission contract): every chunk_size slicing draws the SAME
+    tokens — the per-row round sequence, and therefore the key stream,
+    is continuous across chunk boundaries."""
+    model = _model(12)
+    dec = LlamaDecoder(model, max_len=64)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 64, (2, 5))
+    kw = dict(draft_model="skip:1", num_speculative_tokens=2,
+              do_sample=True, top_k=8, temperature=0.8, seed=6)
+    ref = np.asarray(dec.generate(prompt, max_new_tokens=10,
+                                  chunk_size=1, **kw))
+    for T in (2, 4, 7, 10):
+        got = np.asarray(dec.generate(prompt, max_new_tokens=10,
+                                      chunk_size=T, **kw))
+        np.testing.assert_array_equal(got, ref, err_msg=f"T={T}")
+
+
 def test_trim_after_eos_edge_cases():
     """Satellite: first-emitted-token-is-eos and negative-eos ("none")
     conventions are uniform across LlamaDecoder.generate,
@@ -735,21 +805,28 @@ def test_sharded_head_axis_cache_on_2x2():
         np.concatenate([prompt, np.asarray(toks)], axis=1), want)
 
 
-def test_sharded_speculative_refused_typed(mesh_pair):
-    """Speculative decode on a mesh is refused with a typed error at
-    generate() time — never a mid-dispatch failure the resilience
-    ladder would chew on (SpeculativeMeshError classifies fatal)."""
-    from paddle_tpu.inference.sharding import SpeculativeMeshError
-    from paddle_tpu.runtime.resilience import classify_error
-    _, sh = mesh_pair
-    prompt = np.array([[1, 2, 3]])
-    with pytest.raises(SpeculativeMeshError, match="mesh"):
-        sh.generate(prompt, max_new_tokens=4, draft_model="skip:1",
-                    num_speculative_tokens=2)
-    try:
-        sh.generate(prompt, max_new_tokens=4, draft_model="skip:1")
-    except SpeculativeMeshError as e:
-        assert classify_error(e) != "transient"
+def test_sharded_speculative_parity(mesh_pair):
+    """Speculative decode on a mesh — the path that used to refuse with
+    SpeculativeMeshError — is a working path: the shard_map'd per-row
+    uneven cache advance makes fused AND chunked speculative decode
+    bit-exact vs the single-device decoder on the virtual CPU mesh,
+    greedy and per-row-keyed sampled alike."""
+    ref, sh = mesh_pair
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 64, (2, 5))
+    kw = dict(draft_model="skip:1", num_speculative_tokens=2)
+    want = np.asarray(ref.generate(prompt, max_new_tokens=10, **kw))
+    got = np.asarray(sh.generate(prompt, max_new_tokens=10, **kw))
+    np.testing.assert_array_equal(got, want)
+    # chunk re-entry on the mesh slices the same stream
+    gotc = np.asarray(sh.generate(prompt, max_new_tokens=10,
+                                  chunk_size=3, **kw))
+    np.testing.assert_array_equal(gotc, want)
+    # per-row-keyed sampling: mesh == host, chunked == fused
+    skw = dict(do_sample=True, top_k=8, temperature=0.8, seed=3, **kw)
+    a = np.asarray(ref.generate(prompt, 10, chunk_size=4, **skw))
+    b = np.asarray(sh.generate(prompt, 10, chunk_size=4, **skw))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_model_generate_mesh_surface(mesh_pair):

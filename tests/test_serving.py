@@ -107,8 +107,14 @@ def test_chunk_size_validation(dec):
     prompt = np.array([[1, 2, 3]])
     with pytest.raises(ValueError, match="chunk_size"):
         dec.generate(prompt, 4, chunk_size=0)
-    with pytest.raises(ValueError, match="draft_model"):
-        dec.generate(prompt, 4, chunk_size=4, draft_model="skip:1")
+    # chunked + draft_model is a WORKING path now (the chunked
+    # speculative program), not a refusal — and stats are reported
+    out = dec.generate(prompt, 4, chunk_size=4, draft_model="skip:1",
+                       num_speculative_tokens=2)
+    ref = dec.generate(prompt, 4, draft_model="skip:1",
+                       num_speculative_tokens=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert dec.last_spec_stats["num_speculative_tokens"] == 2
 
 
 # -- scheduler -------------------------------------------------------------
@@ -205,6 +211,103 @@ def test_engine_sampled_fixed_keys_row_independent(dec):
     g = np.asarray(dec.generate(p[None], n, do_sample=True, top_k=8,
                                 seed=s, temperature=t, chunk_size=4))
     np.testing.assert_array_equal(g, outs[0][0])
+
+
+def test_engine_speculative_parity_stats_and_accounting(dec):
+    """Tentpole: the engine over the chunked speculative program is
+    bit-exact vs the PLAIN engine on the same submissions, with the
+    speculative dispatch accounting (prefill + draft prefill per
+    request + chunk dispatches, zero per-token steps, zero host
+    scatters) and CUMULATIVE per-request acceptance stats on the
+    result record — never stale, never last-chunk-only."""
+    rng = np.random.default_rng(11)
+    reqs = _mixed_requests(rng, 6, eos_every=3, dec=dec)
+    outs, engines = [], []
+    for kw in (dict(), dict(draft_model="skip:1",
+                            num_speculative_tokens=2)):
+        eng = ServingEngine(dec, num_slots=3, chunk_size=4, **kw)
+        d0 = dec.dispatch_count
+        ids = [eng.submit(p, n, eos_token_id=e) for p, n, e in reqs]
+        res = eng.drain()
+        m = eng.metrics()
+        assert m["step_dispatches"] == 0
+        assert m["admission_ring"]["host_scattered"] == 0
+        assert m["admission_ring"]["staged"] == len(reqs)
+        assert m["admission_ring"]["scattered"] == len(reqs)
+        assert dec.dispatch_count - d0 == \
+            m["prefill_dispatches"] + m["draft_prefill_dispatches"] \
+            + m["chunk_dispatches"]
+        outs.append([np.asarray(res[r]) for r in ids])
+        engines.append((eng, res, ids))
+    for a, b in zip(*outs):
+        np.testing.assert_array_equal(a, b)
+    plain_m = engines[0][0].metrics()
+    eng, res, ids = engines[1]
+    m = eng.metrics()
+    assert plain_m["speculative"] is None
+    assert m["draft_prefill_dispatches"] == len(reqs)
+    sp = m["speculative"]
+    assert sp["active"] and sp["num_speculative_tokens"] == 2
+    assert sp["rounds"] > 0
+    assert sp["acceptance_len_mean"] == pytest.approx(
+        sp["accepted_drafts"] / sp["rounds"])
+    st = eng.status()["speculative"]
+    assert st["rounds"] == sp["rounds"]
+    # per-request record: cumulative totals, consistent mean
+    tot_rounds = 0
+    for rid in ids:
+        rec = res[rid].resilience["serving"]["speculative"]
+        assert rec["num_speculative_tokens"] == 2
+        assert rec["rounds"] > 0
+        assert rec["acceptance_len_mean"] == pytest.approx(
+            rec["accepted_drafts"] / rec["rounds"])
+        assert rec["overflow_tokens"] >= 0
+        tot_rounds += rec["rounds"]
+    assert tot_rounds == sp["rounds"]
+    plain_rec = engines[0][1][engines[0][2][0]].resilience["serving"]
+    assert plain_rec["speculative"] is None
+
+
+def test_engine_speculative_sampled_shape_invariance(dec):
+    """Sampled speculative serving draws from per-row key streams: a
+    3-slot T=3 engine and a 1-slot T=7 engine emit IDENTICAL tokens
+    for the same seeded submissions."""
+    rng = np.random.default_rng(12)
+    reqs = [(rng.integers(0, 64, (int(rng.integers(2, 8)),)),
+             int(rng.integers(3, 9)), i, 0.7 + 0.2 * (i % 3))
+            for i in range(5)]
+    outs = []
+    for slots, T in ((3, 3), (1, 7)):
+        eng = ServingEngine(dec, num_slots=slots, chunk_size=T,
+                            do_sample=True, top_k=8,
+                            draft_model="skip:1",
+                            num_speculative_tokens=2)
+        ids = [eng.submit(p, n, seed=s, temperature=t)
+               for p, n, s, t in reqs]
+        res = eng.drain()
+        outs.append([np.asarray(res[r]) for r in ids])
+    for a, b in zip(*outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_admission_ring_full_backpressure(dec):
+    """A ring smaller than the slot count: when a step frees more slots
+    than the ring holds, the spill is re-queued (FIFO order kept, not
+    dropped, not host-scattered) and the ``ring_full`` counter says so.
+    Parity is unaffected."""
+    rng = np.random.default_rng(13)
+    reqs = [(rng.integers(0, 64, (4,)), 4 + i % 3) for i in range(8)]
+    solo = [np.asarray(dec.generate(p[None], n)) for p, n in reqs]
+    eng = ServingEngine(dec, num_slots=4, chunk_size=4, ring_slots=2)
+    ids = [eng.submit(p, n) for p, n in reqs]
+    res = eng.drain()
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(np.asarray(res[rid]), solo[i])
+    ring = eng.metrics()["admission_ring"]
+    assert ring["slots"] == 2
+    assert ring["full"] > 0                  # backpressure actually hit
+    assert ring["host_scattered"] == 0
+    assert ring["staged"] == ring["scattered"] == len(reqs)
 
 
 def test_engine_occupancy_accounting(dec):

@@ -124,6 +124,21 @@ STEPS = [
     # the SAME --mesh flag against physical chips unchanged.
     ("serve_sharded", [sys.executable, "tools/roundtail_bench.py",
                       "--probe-serve-sharded"], None),
+    # speculative-serving gate: bench.py --serve --speculative — the
+    # chunked speculative engine (device-side slot refill + draft
+    # carry) vs the plain ring engine on the SAME request set.
+    # Hard-asserted inside the bench: per-request bit-exact parity,
+    # dispatches == prefills + draft_prefills + chunks (zero per-token
+    # steps, zero host scatters), chunk dispatches STRICTLY below the
+    # plain engine's (the K-fold reduction), and tokens/dispatch above
+    # the 1.8 floor. The --mesh leg re-runs the identical contract
+    # shard_map'd over a 2x2 {dp,tp} virtual CPU mesh — the path that
+    # used to refuse with SpeculativeMeshError.
+    ("serve_spec", [sys.executable, "bench.py", "--serve",
+                    "--speculative"], None),
+    ("serve_spec_sharded", [sys.executable, "bench.py", "--serve",
+                            "--speculative", "--mesh", "dp:2,tp:2"],
+     None),
     # prefix-cache serving gate: bench.py --serve --prefix-mix with obs
     # on — parity (vs solo generates, x2 runs) and zero-dispatch
     # full-prefix hits are hard-asserted INSIDE the bench; the probe
