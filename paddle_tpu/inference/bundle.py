@@ -365,7 +365,7 @@ def export_decoder_bundle(decoder, out_dir: str,
             def cdecode(logits, kc, vc, pos, keys, done, eos, temp,
                         T=int(T)):
                 return decoder._chunk_decode(
-                    p, logits, kc, vc, pos, keys, done, eos, temp,
+                    p, logits, kc, vc, pos, keys, done, eos, temp, None,
                     steps=T, do_sample=bool(do_sample),
                     top_k=None if top_k is None else int(top_k),
                     top_p=None if top_p is None else float(top_p))

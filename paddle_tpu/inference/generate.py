@@ -83,6 +83,14 @@ class DecodeState:
     spec_rounds: Any = None    # (B,) i32 — cumulative verify rounds
     spec_accepted: Any = None  # (B,) i32 — cumulative accepted drafts
     nv: Any = None        # (B,) i32 — valid tokens in the last chunk's buf
+    adapter_idx: Any = None    # (B,) i32 — per-row LoRA adapter index into
+    #                            the stacked (N+1, ...) delta arrays;
+    #                            0 = base-only (None = no adapters at all,
+    #                            keeping non-LoRA traces identical)
+    spec_on: Any = None   # (B,) bool — per-row speculative enable: False
+    #                       rows decode verify-free (a=0, target pick) in
+    #                       the SAME speculative chunk program (None = all
+    #                       rows speculate, the pre-multiplex behaviour)
     spec: Any = None      # host-side: {"ekey", "K"} engine routing meta
     steps_done: int = 0   # host-side: loop steps executed so far
 
@@ -110,7 +118,7 @@ def _rope_at(x, pos, cfg, p):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
 
 
-def _mm(x, p, name, sharded=False):
+def _mm(x, p, name, sharded=False, aidx=None):
     """x @ weight, transparently using the int8 weight-only path when the
     decoder quantized this matrix (weight stays int8 in HBM — half the
     weight bandwidth, which bounds small-batch decode; reference analog:
@@ -120,7 +128,16 @@ def _mm(x, p, name, sharded=False):
     bandwidth win (measured slower than bf16). Under a mesh (``sharded``)
     the Pallas tile is skipped: the hand-written kernel has no GSPMD
     partitioning rule, so the dequant-matmul falls back to the XLA form,
-    which shards like any dot."""
+    which shards like any dot.
+
+    ``aidx`` (B,) i32 multiplexes per-row LoRA deltas when the params
+    carry stacked ``lora.{name}.A`` (N+1, d_in, r) / ``.B`` (N+1, r,
+    d_out) arrays: each row gathers ITS adapter's pair and adds
+    ``(x @ A[idx]) @ B[idx]`` to the base product — row 0 is all-zero, so
+    base rows pay only the rank-r epsilon and every tenant mix stays one
+    dispatch. The delta applies identically over the int8 base (fp16/fp32
+    adapters over a quantized trunk: the AWQ observation that the weight
+    STREAM is the decode cost — rank-r stacks barely add to it)."""
     q = p.get(name + ":int8")
     if q is not None:
         scale = p[name + ":scale"]
@@ -132,8 +149,24 @@ def _mm(x, p, name, sharded=False):
             out = i8.int8_matmul(x2, q, scale)
         else:
             out = (x2 @ q.astype(x.dtype)) * scale.astype(x.dtype)
-        return out.reshape(lead + (q.shape[1],))
-    return x @ p[name]
+        out = out.reshape(lead + (q.shape[1],))
+    else:
+        out = x @ p[name]
+    if aidx is not None:
+        A = p.get("lora." + name + ".A")
+        if A is not None:
+            Bm = p["lora." + name + ".B"]
+            Ai = jnp.take(A, aidx, axis=0)          # (B, d_in, r)
+            Bi = jnp.take(Bm, aidx, axis=0)         # (B, r, d_out)
+            xa = x.astype(Ai.dtype)
+            if x.ndim == 3:                         # (B, S, d_in)
+                d = jnp.einsum("bsd,bdr->bsr", xa, Ai)
+                d = jnp.einsum("bsr,bro->bso", d, Bi)
+            else:                                   # (B, d_in)
+                d = jnp.einsum("bd,bdr->br", xa, Ai)
+                d = jnp.einsum("br,bro->bo", d, Bi)
+            out = out + d.astype(out.dtype)
+    return out
 
 
 def _cache_layer(kc, li):
@@ -232,14 +265,15 @@ def _row_scatter(dst, src, idx):
 
 
 def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
-                   sharded=False):
+                   sharded=False, aidx=None):
     """One decoder block over h (B, S, H) writing K/V into the cache at
     [pos, pos+S); attention reads the whole cache masked to < pos+S with
     causal alignment to the bottom-right (query i attends to <= pos+i).
     ``pos``: scalar or per-row (B,) vector. ``sharded`` (trace-time
     static): the decoder runs under a GSPMD mesh — hand-written Pallas
     kernels (no partitioning rules) give way to the XLA forms, which
-    shard via sharding propagation."""
+    shard via sharding propagation. ``aidx`` (B,) i32 routes per-row LoRA
+    deltas through every projection (see ``_mm``)."""
     B, S, _ = h.shape
     H, KV, D = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     pre = f"model.layers.{li}."
@@ -250,7 +284,7 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
             var + cfg.rms_norm_eps)).astype(x.dtype) * w
 
     x = rms(h, p[pre + "input_layernorm.weight"])
-    qkv = _mm(x, p, pre + "self_attn.qkv.weight", sharded)
+    qkv = _mm(x, p, pre + "self_attn.qkv.weight", sharded, aidx)
     q = qkv[..., :H * D].reshape(B, S, H, D)
     k = qkv[..., H * D:H * D + KV * D].reshape(B, S, KV, D)
     v = qkv[..., H * D + KV * D:].reshape(B, S, KV, D)
@@ -328,25 +362,29 @@ def _block_forward(p, cfg: LlamaConfig, li: int, h, kc, vc, pos, max_len,
         scores = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", attn, vv).reshape(B, S, H * D)
-    h = h + _mm(out, p, pre + "self_attn.o_proj.weight", sharded)
+    h = h + _mm(out, p, pre + "self_attn.o_proj.weight", sharded, aidx)
 
     x = rms(h, p[pre + "post_attention_layernorm.weight"])
-    gu = _mm(x, p, pre + "mlp.gate_up.weight", sharded)
+    gu = _mm(x, p, pre + "mlp.gate_up.weight", sharded, aidx)
     F_ = gu.shape[-1] // 2
     a = jax.nn.silu(gu[..., :F_]) * gu[..., F_:]
-    return h + _mm(a, p, pre + "mlp.down_proj.weight", sharded), kc, vc
+    return h + _mm(a, p, pre + "mlp.down_proj.weight", sharded, aidx), \
+        kc, vc
 
 
 def _forward_cached(p, cfg: LlamaConfig, ids, kc, vc, pos, max_len,
-                    return_all: bool = False, sharded: bool = False):
+                    return_all: bool = False, sharded: bool = False,
+                    aidx=None):
     """ids (B, S) -> logits (B, V) of the LAST position — or of ALL S
     positions (B, S, V) with ``return_all=True`` (speculative verify
     scores every drafted position in one batched forward) — plus the
-    updated caches. ``pos``: scalar or per-row (B,) vector."""
+    updated caches. ``pos``: scalar or per-row (B,) vector. ``aidx``
+    (B,) i32: per-row LoRA adapter index (projections only — the head
+    stays base)."""
     h = p["model.embed_tokens.weight"][ids]
     for li in range(cfg.num_hidden_layers):
         h, kc, vc = _block_forward(p, cfg, li, h, kc, vc, pos, max_len,
-                                   sharded)
+                                   sharded, aidx)
     var = jnp.mean(jnp.square(h.astype(jnp.float32)), -1, keepdims=True)
     h = (h.astype(jnp.float32) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
          ).astype(h.dtype) * p["model.norm.weight"]
@@ -509,7 +547,7 @@ def _spec_round(p, dp, cfg, dcfg, tok, pos, key, done, kc, vc, dkc, dvc,
 
 def _spec_round_rows(p, dp, cfg, dcfg, tok, pos, keys, done, kc, vc, dkc,
                      dvc, eos, temp, max_len, *, K: int, do_sample: bool,
-                     top_k, top_p, sharded=False):
+                     top_k, top_p, sharded=False, aidx=None, spec_on=None):
     """``_spec_round`` under the CHUNKED-SERVING carry contract: PER-ROW
     RNG keys (each row splits its OWN (2,) raw uint32 key per round, so
     its sample stream is invariant to batch neighbours — the admission
@@ -518,6 +556,17 @@ def _spec_round_rows(p, dp, cfg, dcfg, tok, pos, keys, done, kc, vc, dkc,
     and per-row temperatures. Same Leviathan accept/reject math as
     ``_spec_round`` — greedy rounds are bit-identical, which is what the
     chunk-slicing-invariance tests ride on.
+
+    ``aidx`` routes per-row LoRA deltas through the TARGET forwards only
+    (verify + the committed pick); the draft stays base — a mismatched
+    draft can only cost acceptance length, never correctness, because
+    every emitted token is accept/reject-verified against the adapter-
+    routed target. ``spec_on`` (B,) bool demotes False rows to verify-
+    free decode INSIDE the same program: their acceptance is forced to 0
+    BEFORE the correction draw and the correction distribution is the
+    target's own position-0 law (``pa``), so a sampled spec-off row draws
+    from exactly the filtered target distribution and a greedy spec-off
+    row emits exactly the plain-decode argmax.
 
     Returns ``(emit (B, K+1), a (B,), tok_next (B,), lg_a (B, V), keys,
     done, kc, vc, dkc, dvc)``; ``lg_a`` is the verify logits at each
@@ -555,7 +604,7 @@ def _spec_round_rows(p, dp, cfg, dcfg, tok, pos, keys, done, kc, vc, dkc,
     seq = jnp.concatenate([tok[:, None], props], axis=1)       # (B, K+1)
     all_lg, kc, vc = _forward_cached(p, cfg, seq, kc, vc, pos, max_len,
                                      return_all=True,
-                                     sharded=sharded)          # (B,K+1,V)
+                                     sharded=sharded, aidx=aidx)  # B,K+1,V
     if do_sample:
         pprob = jax.nn.softmax(
             _filter_logits(all_lg, temp[:, None, None], top_k, top_p),
@@ -566,6 +615,9 @@ def _spec_round_rows(p, dp, cfg, dcfg, tok, pos, keys, done, kc, vc, dkc,
         qd = jnp.take_along_axis(qprob, props[..., None], axis=-1)[..., 0]
         accept = u * qd < pd
         a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+        if spec_on is not None:
+            a = jnp.where(spec_on, a, 0)   # BEFORE the pa/qa gathers: the
+            #   spec-off correction must come from the position-0 law
         pa = jnp.take_along_axis(pprob, a[:, None, None], axis=1)[:, 0]
         qa = jnp.take_along_axis(
             qprob, jnp.minimum(a, K - 1)[:, None, None], axis=1)[:, 0]
@@ -573,12 +625,18 @@ def _spec_round_rows(p, dp, cfg, dcfg, tok, pos, keys, done, kc, vc, dkc,
         rs = jnp.sum(resid, axis=-1, keepdims=True)
         resid = jnp.where(rs > 0, resid / jnp.where(rs > 0, rs, 1.0), pa)
         dist = jnp.where((a == K)[:, None], pa, resid)
+        if spec_on is not None:
+            # spec-off rows sample the target distribution itself, not
+            # the rejection residual — the verify-free decode law
+            dist = jnp.where(spec_on[:, None], dist, pa)
         corr = jax.vmap(jax.random.categorical)(
             ckey, jnp.log(dist)).astype(jnp.int32)
     else:
         tgt = jnp.argmax(all_lg, -1).astype(jnp.int32)         # (B, K+1)
         match = props == tgt[:, :K]
         a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        if spec_on is not None:
+            a = jnp.where(spec_on, a, 0)
         corr = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
     jidx = jnp.arange(K + 1)[None, :]
     ext = jnp.concatenate([props, jnp.zeros((B, 1), jnp.int32)], axis=1)
@@ -785,7 +843,7 @@ class LlamaDecoder:
                                     last[:, None]], axis=1)
 
         def chunk_decode(p, logits0, kc, vc, pos0, keys0, done0, eos,
-                         temperature, steps: int, do_sample: bool,
+                         temperature, aidx, steps: int, do_sample: bool,
                          top_k, top_p):
             """T steps of the fused token loop as ONE re-enterable
             dispatch: the carry comes in and goes back out as plain
@@ -798,7 +856,10 @@ class LlamaDecoder:
             each row splits its OWN key per step, so a row's sample
             stream is invariant to its batch neighbours. Greedy chunks
             chained over N steps are bit-exact with the run-to-completion
-            fused path (same pick-then-forward stream)."""
+            fused path (same pick-then-forward stream). ``aidx`` (B,) i32
+            or None: per-row LoRA adapter routing — read-only here, like
+            eos/temperature (admission rewrites it via the ring/scatter
+            paths)."""
             self.trace_count += 1
 
             def pick(logits, keys, done):
@@ -820,7 +881,7 @@ class LlamaDecoder:
                 tok, keys, done = pick(logits, keys, done)
                 logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
                                                  vc, pos, max_len,
-                                                 sharded=shd)
+                                                 sharded=shd, aidx=aidx)
                 # rows past their budget keep stepping until the chunk
                 # boundary; clamping pins their (discarded) writes to the
                 # last cache slot instead of running off the buffer
@@ -838,7 +899,7 @@ class LlamaDecoder:
             return (jnp.moveaxis(toks, 0, 1), logits, kc, vc, pos, keys,
                     done)
 
-        def admit_prefill(p, ids, kc, vc, true_len, pos0):
+        def admit_prefill(p, ids, kc, vc, true_len, pos0, aidx=None):
             """Length-bucketed admission prefill: ``ids`` is a batch of
             requests right-padded to one prompt bucket (one compiled
             program per (batch, bucket), not per distinct prompt length).
@@ -853,18 +914,22 @@ class LlamaDecoder:
             arrive preloaded with the cached prefix's KV rows ``[0,
             pos0)`` and only the uncached suffix is computed; several
             same-bucket admissions batch into one dispatch (per-row
-            offsets keep their prefixes independent)."""
+            offsets keep their prefixes independent). ``aidx`` (B,) i32
+            or None: each admitted row's prompt prefills through ITS
+            adapter's deltas, so the cached prefix KV matches what a
+            dense per-tenant model would have produced."""
             self.trace_count += 1
             logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc,
                                                  pos0, max_len,
                                                  return_all=True,
-                                                 sharded=shd)
+                                                 sharded=shd, aidx=aidx)
             logits = jnp.take_along_axis(
                 logits_all, (true_len - 1)[:, None, None], axis=1)[:, 0]
             return pin_fwd(logits, kc, vc)
 
         def ring_admit_prefill(p, ids, kc, vc, true_len, pos0,
-                               ring_logits, ring_kc, ring_vc, ring_idx):
+                               ring_logits, ring_kc, ring_vc, ring_idx,
+                               aidx=None):
             """``admit_prefill`` that STAGES its results into the
             device-resident admission ring instead of returning them to
             host: the freshly prefilled rows scatter into ring rows
@@ -877,7 +942,7 @@ class LlamaDecoder:
             logits_all, kc, vc = _forward_cached(p, cfg, ids, kc, vc,
                                                  pos0, max_len,
                                                  return_all=True,
-                                                 sharded=shd)
+                                                 sharded=shd, aidx=aidx)
             logits = jnp.take_along_axis(
                 logits_all, (true_len - 1)[:, None, None], axis=1)[:, 0]
             ring_logits = ring_logits.at[ring_idx].set(logits,
@@ -887,9 +952,10 @@ class LlamaDecoder:
             return pin_fwd(ring_logits, ring_kc, ring_vc)
 
         def ring_chunk_decode(p, logits0, kc, vc, pos0, keys0, done0,
-                              eos0, temp0, ring_logits, ring_kc, ring_vc,
-                              ring_slot, ring_pos, ring_keys, ring_eos,
-                              ring_temp, steps: int, do_sample: bool,
+                              eos0, temp0, aidx0, ring_logits, ring_kc,
+                              ring_vc, ring_slot, ring_pos, ring_keys,
+                              ring_eos, ring_temp, ring_aidx,
+                              steps: int, do_sample: bool,
                               top_k, top_p):
             """``chunk_decode`` with a DEVICE-SIDE slot-refill prologue:
             before the T-step scan, ring rows staged by
@@ -902,11 +968,13 @@ class LlamaDecoder:
             prologue and is trace-identical to the plain chunk. Because
             admission can rewrite per-row eos/temp, BOTH are part of the
             returned carry here (the plain program treats them as
-            read-only inputs)."""
+            read-only inputs). ``aidx0``/``ring_aidx``: per-row LoRA
+            adapter indices — part of the returned carry for the same
+            reason (admission rewrites a freed slot's tenant)."""
             self.trace_count += 1
             B = logits0.shape[0]
             logits, pos, keys, done = logits0, pos0, keys0, done0
-            eos, temp = eos0, temp0
+            eos, temp, aidx = eos0, temp0, aidx0
             if ring_slot is not None:
                 tgt = jnp.where(ring_slot >= 0, ring_slot, B)
                 logits = logits.at[tgt].set(ring_logits, mode="drop")
@@ -917,6 +985,8 @@ class LlamaDecoder:
                 done = done.at[tgt].set(False, mode="drop")
                 eos = eos.at[tgt].set(ring_eos, mode="drop")
                 temp = temp.at[tgt].set(ring_temp, mode="drop")
+                if aidx is not None:
+                    aidx = aidx.at[tgt].set(ring_aidx, mode="drop")
 
             def pick(logits, keys, done):
                 if do_sample:
@@ -937,7 +1007,7 @@ class LlamaDecoder:
                 tok, keys, done = pick(logits, keys, done)
                 logits, kc, vc = _forward_cached(p, cfg, tok[:, None], kc,
                                                  vc, pos, max_len,
-                                                 sharded=shd)
+                                                 sharded=shd, aidx=aidx)
                 pos = jnp.minimum(pos + 1, max_len - 1)
                 return (logits, kc, vc, pos, keys, done), tok
 
@@ -949,8 +1019,10 @@ class LlamaDecoder:
             if shd:
                 eos = srd.constrain(eos, "eos", head_major)
                 temp = srd.constrain(temp, "temp", head_major)
+                if aidx is not None:
+                    aidx = srd.constrain(aidx, "adapter_idx", head_major)
             return (jnp.moveaxis(toks, 0, 1), logits, kc, vc, pos, keys,
-                    done, eos, temp)
+                    done, eos, temp, aidx)
 
         self._prefill = self._counted(jax.jit(prefill), "decode.prefill")
         self._step = self._counted(jax.jit(step), "decode.step")
@@ -1072,8 +1144,9 @@ class LlamaDecoder:
                           temperature: float = 1.0, seed: int = 0,
                           draft_model=None,
                           num_speculative_tokens: Optional[int] = None,
-                          draft_quant: Optional[str] = None
-                          ) -> DecodeState:
+                          draft_quant: Optional[str] = None,
+                          adapter_idx=None,
+                          speculative=None) -> DecodeState:
         """Prefill (one dispatch) and build the exportable loop carry for
         ``decode_chunk``. Whole-batch entry: every row starts from the
         same prompt tensor; the serving engine instead assembles mixed
@@ -1085,15 +1158,36 @@ class LlamaDecoder:
         holds the draft's prefilled caches (one extra counted dispatch),
         the per-row pending-token sentinel ``tok=-1`` and zeroed
         cumulative acceptance stats — ``decode_chunk`` then advances it
-        by draft/verify/accept rounds instead of single steps."""
+        by draft/verify/accept rounds instead of single steps.
+
+        ``adapter_idx`` (B,) ints: per-row LoRA adapter routing (the
+        params must carry ``lora.*`` stacks — see serving/lora); the
+        PREFILL runs adapter-routed too, so each row's cached prompt KV
+        matches its dense-merged tenant model. ``speculative`` (B,)
+        bools (speculative carries only): rows set False decode
+        verify-free inside the same speculative chunk program."""
         import jax.random as jrandom
 
         ids = jnp.asarray(np.asarray(input_ids))
         B, S = ids.shape
+        aidx = None
+        if adapter_idx is not None:
+            aidx = jnp.asarray(np.asarray(adapter_idx), jnp.int32)
         kc, vc = self._empty_cache(B)
-        logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        if aidx is None:
+            logits, kc, vc = self._prefill(self.params, ids, kc, vc)
+        else:
+            # adapter-routed prefill: the bucketed admission program with
+            # every row at its full length (per-row aidx is its contract)
+            logits, kc, vc = self._admit_prefill(
+                self.params, ids, kc, vc,
+                jnp.full((B,), S, jnp.int32), jnp.zeros((B,), jnp.int32),
+                aidx)
         eos_n = _normalize_eos(eos_token_id)
-        kw = {}
+        kw = {"adapter_idx": aidx}
+        if speculative is not None and draft_model is None:
+            raise ValueError("speculative=(B,) row mask requires a "
+                             "draft_model")
         if draft_model is not None:
             from paddle_tpu.flags import flags
             K = int(num_speculative_tokens
@@ -1105,11 +1199,14 @@ class LlamaDecoder:
             eng = self._spec_engine(draft_model, draft_quant)
             dkc, dvc = self._empty_cache(B, eng["cfg"])
             _, dkc, dvc = eng["prefill"](eng["params"], ids, dkc, dvc)
-            kw = dict(dkc=dkc, dvc=dvc,
+            kw.update(dkc=dkc, dvc=dvc,
                       tok=jnp.full((B,), -1, jnp.int32),
                       spec_rounds=jnp.zeros((B,), jnp.int32),
                       spec_accepted=jnp.zeros((B,), jnp.int32),
                       spec={"ekey": eng["ekey"], "K": K})
+            if speculative is not None:
+                kw["spec_on"] = jnp.asarray(np.asarray(speculative),
+                                            jnp.bool_)
         elif num_speculative_tokens is not None:
             raise ValueError("num_speculative_tokens requires a "
                              "draft_model")
@@ -1132,7 +1229,8 @@ class LlamaDecoder:
 
     def decode_chunk(self, state: DecodeState, num_tokens: int,
                      do_sample: bool = False, top_k: Optional[int] = None,
-                     top_p: Optional[float] = None):
+                     top_p: Optional[float] = None,
+                     K: Optional[int] = None):
         """Advance the loop carry by ``num_tokens`` steps in ONE device
         dispatch; returns ``(tokens (B, num_tokens), new_state)``.
         Chaining chunks totalling N steps emits the same greedy tokens,
@@ -1148,18 +1246,23 @@ class LlamaDecoder:
         each row's valid count, at least ``num_tokens`` (slice
         ``toks[i, :nv[i]]``; everything past ``num_tokens`` is
         acceptance overflow — the per-dispatch token yield that IS the
-        speculative dispatch reduction)."""
+        speculative dispatch reduction). ``K`` overrides the carry's
+        draft length for THIS chunk only (the adaptive-K serving hook:
+        K is a static, so each distinct value compiles once and the
+        engine steers between cached programs; greedy output stays
+        bit-exact for any K schedule)."""
         if state.dkc is not None:
             eng = self._spec_engines[state.spec["ekey"]]
-            K = int(state.spec["K"])
+            K = int(state.spec["K"]) if K is None else int(K)
             (toks, nv, logits, kc, vc, dkc, dvc, pos, keys, done, eos,
-             temp, tok, sr, sa) = eng["chunk"](
+             temp, tok, sr, sa, aidx, son) = eng["chunk"](
                 self.params, eng["params"], state.logits, state.kc,
                 state.vc, state.dkc, state.dvc, state.pos, state.keys,
                 state.done, state.eos, state.temp, state.tok,
                 state.spec_rounds, state.spec_accepted,
+                state.adapter_idx, state.spec_on,
                 None, None, None, None, None,      # no admission ring
-                None, None, None, None, None,
+                None, None, None, None, None, None, None,
                 steps=int(num_tokens), K=K, do_sample=bool(do_sample),
                 top_k=None if top_k is None else int(top_k),
                 top_p=None if top_p is None else float(top_p))
@@ -1167,10 +1270,12 @@ class LlamaDecoder:
                 state, logits=logits, kc=kc, vc=vc, dkc=dkc, dvc=dvc,
                 pos=pos, keys=keys, done=done, eos=eos, temp=temp,
                 tok=tok, spec_rounds=sr, spec_accepted=sa, nv=nv,
+                adapter_idx=aidx, spec_on=son,
                 steps_done=state.steps_done + int(num_tokens))
         toks, logits, kc, vc, pos, keys, done = self._chunk_decode(
             self.params, state.logits, state.kc, state.vc, state.pos,
             state.keys, state.done, state.eos, state.temp,
+            state.adapter_idx,
             steps=int(num_tokens), do_sample=bool(do_sample),
             top_k=None if top_k is None else int(top_k),
             top_p=None if top_p is None else float(top_p))
@@ -1384,22 +1489,25 @@ class LlamaDecoder:
             return out[0], out[9], out[10]
 
         def pin_spec_carry(logits, kc, vc, dkc, dvc, pos, keys, done,
-                           eos, temp, tok, sr, sa):
+                           eos, temp, tok, sr, sa, aidx=None, son=None):
             if srd is None:
                 return (logits, kc, vc, dkc, dvc, pos, keys, done, eos,
-                        temp, tok, sr, sa)
+                        temp, tok, sr, sa, aidx, son)
             c = lambda x, f: srd.constrain(x, f, head_major)  # noqa: E731
             return (c(logits, "logits"), c(kc, "kc"), c(vc, "vc"),
                     c(dkc, "dkc"), c(dvc, "dvc"), c(pos, "pos"),
                     c(keys, "keys"), c(done, "done"), c(eos, "eos"),
                     c(temp, "temp"), c(tok, "tok"), c(sr, "spec_rounds"),
-                    c(sa, "spec_accepted"))
+                    c(sa, "spec_accepted"),
+                    None if aidx is None else c(aidx, "adapter_idx"),
+                    None if son is None else c(son, "spec_on"))
 
         def spec_chunk(p, dp_, logits0, kc, vc, dkc, dvc, pos0, keys0,
-                       done0, eos0, temp0, tok0, sr0, sa0,
+                       done0, eos0, temp0, tok0, sr0, sa0, aidx0, son0,
                        ring_logits, ring_kc, ring_vc, ring_dkc, ring_dvc,
                        ring_slot, ring_pos, ring_keys, ring_eos,
-                       ring_temp, steps: int, K: int, do_sample: bool,
+                       ring_temp, ring_aidx, ring_son,
+                       steps: int, K: int, do_sample: bool,
                        top_k, top_p):
             """CHUNKED speculative decode: exactly ``steps=T``
             draft/verify/accept rounds (``_spec_round_rows`` — per-row
@@ -1425,12 +1533,17 @@ class LlamaDecoder:
             carry (``sr``/``sa``), reset by admission — chunk re-entry
             can neither lose rounds nor double-report them. The ring
             prologue is the same device-side slot refill as the plain
-            ring chunk (plus the draft caches and spec-field resets)."""
+            ring chunk (plus the draft caches and spec-field resets).
+            ``aidx0``/``son0`` (+ their ring columns): per-row LoRA
+            adapter routing and per-row speculative enable — both ride
+            the carry so admission can retarget a freed slot's tenant or
+            demote it to verify-free decode without a new program."""
             self.trace_count += 1
             T = int(steps)
             B = logits0.shape[0]
             logits, pos, keys, done = logits0, pos0, keys0, done0
             eos, temp, tok, sr, sa = eos0, temp0, tok0, sr0, sa0
+            aidx, son = aidx0, son0
             if ring_slot is not None:
                 tgt = jnp.where(ring_slot >= 0, ring_slot, B)
                 logits = logits.at[tgt].set(ring_logits, mode="drop")
@@ -1446,6 +1559,10 @@ class LlamaDecoder:
                 tok = tok.at[tgt].set(-1, mode="drop")
                 sr = sr.at[tgt].set(0, mode="drop")
                 sa = sa.at[tgt].set(0, mode="drop")
+                if aidx is not None:
+                    aidx = aidx.at[tgt].set(ring_aidx, mode="drop")
+                if son is not None:
+                    son = son.at[tgt].set(ring_son, mode="drop")
             fill = jnp.where(eos >= 0, eos, 0)
             need = tok < 0           # no pending token: fresh pick
             if do_sample:
@@ -1473,12 +1590,16 @@ class LlamaDecoder:
                 (buf, cnt, logits, tok, pos, keys, done, kc, vc, dkc,
                  dvc, sr, sa) = c
                 live = jnp.logical_not(done)
+                if son is not None:
+                    # spec-off rows advance 1/round verify-free: their
+                    # rounds never enter the acceptance stats
+                    live = jnp.logical_and(live, son)
                 (emit, a, tok2, lg2, keys2, done2, kc, vc, dkc,
                  dvc) = _spec_round_rows(
                     p, dp_, cfg, dcfg, tok, pos, keys, done, kc, vc,
                     dkc, dvc, eos, temp, max_len, K=K,
                     do_sample=do_sample, top_k=top_k, top_p=top_p,
-                    sharded=shd)
+                    sharded=shd, aidx=aidx, spec_on=son)
                 idx = cnt[:, None] + jidx
                 valid = jidx <= a[:, None]
                 idx = jnp.where(valid, idx, W)         # OOB -> dropped
@@ -1497,12 +1618,13 @@ class LlamaDecoder:
                 0, T, body, (buf, cnt, logits, tok, pos, keys, done, kc,
                              vc, dkc, dvc, sr, sa))
             (logits, kc, vc, dkc, dvc, pos, keys, done, eos, temp, tok,
-             sr, sa) = pin_spec_carry(logits, kc, vc, dkc, dvc, pos,
-                                      keys, done, eos, temp, tok, sr, sa)
+             sr, sa, aidx, son) = pin_spec_carry(
+                logits, kc, vc, dkc, dvc, pos, keys, done, eos, temp,
+                tok, sr, sa, aidx, son)
             return (buf, cnt, logits, kc, vc, dkc, dvc, pos, keys, done,
-                    eos, temp, tok, sr, sa)
+                    eos, temp, tok, sr, sa, aidx, son)
 
-        def spec_demote(p, logits0, kc, vc, tok, pos):
+        def spec_demote(p, logits0, kc, vc, tok, pos, aidx=None):
             """One-time speculative->chunked demotion of a live carry:
             the pending token (the one speculative re-entry would have
             verified) is committed to the target caches with a single
@@ -1516,7 +1638,7 @@ class LlamaDecoder:
             need = tok >= 0
             t = jnp.where(need, tok, 0)
             lg, kc, vc = _forward_cached(p, cfg, t[:, None], kc, vc, pos,
-                                         max_len, sharded=shd)
+                                         max_len, sharded=shd, aidx=aidx)
             logits = jnp.where(need[:, None], lg, logits0)
             pos = jnp.where(need, jnp.minimum(pos + 1, max_len - 1), pos)
             if srd is not None:

@@ -77,6 +77,14 @@ class SpeculativeMeshError(NotImplementedError):
 # vocab-sharded and argmax/sampling reduce across the axis in-program
 # (XLA inserts the gather; "sharded sampling" rather than a host trip).
 DEFAULT_DECODE_RULES: Tuple[Tuple[str, tuple], ...] = (
+    # stacked LoRA delta pairs (serving/lora): FIRST — their names embed
+    # the host matrix names, and first-match would otherwise hand a 3-D
+    # stack a 2-D host rule. Replicated: rank-r stacks are tiny next to
+    # their host matrices and replication keeps the per-row gather
+    # collective-free on any mesh (sharding B's d_out on tp like the
+    # host column-parallel matrices is a valid refinement — measure
+    # before switching).
+    (r"^lora\.", ()),
     (r"self_attn\.qkv\.weight:scale", ("tp",)),
     (r"mlp\.gate_up\.weight:scale", ("tp",)),
     (r"(o_proj|down_proj)\.weight:scale", ()),
@@ -162,7 +170,7 @@ class DecodeSharding:
         if field == "logits":              # (B, V): vocab-sharded logits
             return (dp, tp)
         if field in ("pos", "done", "eos", "temp", "tok", "spec_rounds",
-                     "spec_accepted", "nv"):
+                     "spec_accepted", "nv", "adapter_idx", "spec_on"):
             return (dp,)
         if field == "keys":                # (B, 2) raw uint32 keys
             return (dp, None)
@@ -207,7 +215,7 @@ class DecodeSharding:
         kw = {}
         for f in ("logits", "kc", "vc", "pos", "keys", "done", "eos",
                   "temp", "dkc", "dvc", "tok", "spec_rounds",
-                  "spec_accepted", "nv"):
+                  "spec_accepted", "nv", "adapter_idx", "spec_on"):
             v = getattr(state, f, None)
             if v is None:
                 continue                  # plain carries skip spec fields

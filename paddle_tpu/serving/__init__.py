@@ -18,6 +18,15 @@ from paddle_tpu.serving.cluster import (  # noqa: F401
     parse_cluster_spec,
 )
 from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
+from paddle_tpu.serving.http import (  # noqa: F401
+    DrainingError,
+    HttpFrontend,
+)
+from paddle_tpu.serving.lora import (  # noqa: F401
+    AdapterStore,
+    AdapterVersionError,
+    UnknownAdapterError,
+)
 from paddle_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixCache,
     PrefixLookup,
@@ -41,4 +50,6 @@ __all__ = ["ServingEngine", "PrefixCache", "PrefixLookup", "PrefixSlab",
            "prefix_digests", "Replica", "ReplicaSet", "Router",
            "Request", "Scheduler", "Slot", "SlotTable",
            "bucket_length", "Cluster", "ClusterRouter", "WorkerHandle",
-           "launch_cluster", "parse_cluster_spec"]
+           "launch_cluster", "parse_cluster_spec",
+           "AdapterStore", "AdapterVersionError", "UnknownAdapterError",
+           "HttpFrontend", "DrainingError"]

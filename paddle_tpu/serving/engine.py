@@ -93,8 +93,9 @@ from paddle_tpu.serving.scheduler import Request, Scheduler
 __all__ = ["ServingEngine"]
 
 
-def _admit_row(logits, kc, vc, pos, keys, done, eos, temp,
-               logits1, kc1, vc1, slot, src, pos1, key1, eos1, temp1):
+def _admit_row(logits, kc, vc, pos, keys, done, eos, temp, aidx,
+               logits1, kc1, vc1, slot, src, pos1, key1, eos1, temp1,
+               aidx1):
     """Scatter one freshly prefilled request's row state into the batch
     carry at ``slot``. ``slot`` and ``src`` are traced scalars — one
     compiled program serves every slot index and every source row
@@ -125,7 +126,9 @@ def _admit_row(logits, kc, vc, pos, keys, done, eos, temp,
     done = done.at[slot].set(False)
     eos = eos.at[slot].set(eos1)
     temp = temp.at[slot].set(temp1)
-    return logits, kc, vc, pos, keys, done, eos, temp
+    if aidx is not None:
+        aidx = aidx.at[slot].set(aidx1)
+    return logits, kc, vc, pos, keys, done, eos, temp, aidx
 
 
 _admit_row_jit = jax.jit(_admit_row)
@@ -147,12 +150,15 @@ def _make_admit_fn(sharding, head_major):
 
     @jax.jit
     def admit(*args):
-        logits, kc, vc, pos, keys, done, eos, temp = _admit_row(*args)
+        logits, kc, vc, pos, keys, done, eos, temp, aidx = \
+            _admit_row(*args)
         logits, kc, vc, pos, keys, done = sharding.constrain_carry(
             logits, kc, vc, pos, keys, done, head_major)
         eos = sharding.constrain(eos, "eos", head_major)
         temp = sharding.constrain(temp, "temp", head_major)
-        return logits, kc, vc, pos, keys, done, eos, temp
+        if aidx is not None:
+            aidx = sharding.constrain(aidx, "adapter_idx", head_major)
+        return logits, kc, vc, pos, keys, done, eos, temp, aidx
 
     return admit
 
@@ -183,11 +189,14 @@ class _DecoderBackend:
 
     def __init__(self, dec, num_slots, chunk_size, do_sample, top_k, top_p,
                  mesh=None, quant=None, draft_model=None,
-                 num_speculative_tokens=None, draft_quant=None):
+                 num_speculative_tokens=None, draft_quant=None,
+                 adapter_store=None):
         from paddle_tpu.inference.sharding import MeshMismatchError
         _check_quant_ask(quant, getattr(dec, "quant", None),
                          "this LlamaDecoder")
         self.dec = dec
+        self.lora = adapter_store
+        self.lora_version = -1
         self.quant = getattr(dec, "quant", None)
         self.num_slots = int(num_slots)
         self.max_len = dec.max_len
@@ -204,6 +213,8 @@ class _DecoderBackend:
                 raise MeshMismatchError(
                     f"engine mesh {want.axes} does not match the "
                     f"decoder's {self.sharding.axes}")
+        if adapter_store is not None:
+            self.refresh_adapters()
         self.spec_eng = None
         self.K = 0
         if draft_model is not None:
@@ -226,6 +237,43 @@ class _DecoderBackend:
             top_k=None if top_k is None else int(top_k),
             top_p=None if top_p is None else float(top_p))
         self._ring_logits = None
+
+    def refresh_adapters(self) -> bool:
+        """(Re)merge the adapter store's stacked ``lora.*`` arrays into
+        the decoder params. Shapes validate against the live param dict
+        (the int8 base keeps its matrix geometry in the ``:int8``
+        buffer). Returns True when device stacks actually moved. The
+        param-dict TREEDEF changes the first time (new leaves), which
+        retriggers the chunk traces — exactly the versioned-weights
+        staging discipline: a swap is a new program-visible params
+        value, never an in-place mutation under a running trace."""
+        import jax.numpy as jnp
+        store = self.lora
+        if store is None or store.version == self.lora_version:
+            return False
+        p = self.dec.params
+        shapes = {}
+        for pn in store.param_names():
+            w = p.get(pn)
+            if w is None:
+                w = p.get(pn + ":int8")
+            if w is None:
+                raise ValueError(
+                    f"adapter store targets decoder param {pn!r} which "
+                    f"this model does not have")
+            shapes[pn] = tuple(int(s) for s in w.shape[-2:])
+        stacks = store.stacks(param_shapes=shapes)
+        dev = {k: jnp.asarray(v) for k, v in stacks.items()}
+        if self.sharding is not None:
+            from paddle_tpu.inference.sharding import DEFAULT_DECODE_RULES
+            from paddle_tpu.parallel.placements import \
+                match_partition_rules
+            specs = match_partition_rules(DEFAULT_DECODE_RULES, dev)
+            dev = {k: self.sharding.put(v, specs[k])
+                   for k, v in dev.items()}
+        self.dec.params.update(dev)
+        self.lora_version = store.version
+        return True
 
     def event_count(self) -> int:
         return len(self.dec._events)
@@ -250,7 +298,10 @@ class _DecoderBackend:
                       spec_rounds=jnp.zeros((B,), jnp.int32),
                       spec_accepted=jnp.zeros((B,), jnp.int32),
                       nv=jnp.zeros((B,), jnp.int32),
+                      spec_on=jnp.ones((B,), jnp.bool_),
                       spec={"ekey": self.spec_eng["ekey"], "K": self.K})
+        if self.lora is not None:
+            kw["adapter_idx"] = jnp.zeros((B,), jnp.int32)
         st = DecodeState(
             logits=jnp.zeros((B, self.dec.cfg.vocab_size), jnp.float32),
             kc=kc, vc=vc,
@@ -277,10 +328,11 @@ class _DecoderBackend:
             self._ring_dkc, self._ring_dvc = self.dec._empty_cache(
                 R, self.spec_eng["cfg"])
 
-    def ring_admit(self, ids, true_len, pos0, ring_idx):
+    def ring_admit(self, ids, true_len, pos0, ring_idx, aidx=None):
         """ONE counted admission-prefill dispatch whose results stage
         straight into device ring rows ``ring_idx`` — no host round-trip
-        for the row state."""
+        for the row state. ``aidx`` prefills each admitted row through
+        its adapter's deltas (None = base for all rows)."""
         import jax.numpy as jnp
         ids = np.asarray(ids)
         kc, vc = self.dec._empty_cache(int(ids.shape[0]))
@@ -290,7 +342,9 @@ class _DecoderBackend:
                 jnp.asarray(np.asarray(true_len), jnp.int32),
                 jnp.asarray(np.asarray(pos0), jnp.int32),
                 self._ring_logits, self._ring_kc, self._ring_vc,
-                jnp.asarray(np.asarray(ring_idx), jnp.int32))
+                jnp.asarray(np.asarray(ring_idx), jnp.int32),
+                None if aidx is None
+                else jnp.asarray(np.asarray(aidx), jnp.int32))
 
     def ring_admit_draft(self, ids, ring_idx):
         """The draft-model analog: one counted dispatch prefills the
@@ -308,23 +362,26 @@ class _DecoderBackend:
     @staticmethod
     def _ring_dev(ring):
         import jax.numpy as jnp
-        slot, pos, keys, eos, temp = ring
+        slot, pos, keys, eos, temp, aidx, son = ring
         return (jnp.asarray(slot, jnp.int32),
                 jnp.asarray(pos, jnp.int32),
                 jnp.asarray(keys, jnp.uint32),
                 jnp.asarray(eos, jnp.int32),
-                jnp.asarray(temp, jnp.float32))
+                jnp.asarray(temp, jnp.float32),
+                None if aidx is None else jnp.asarray(aidx, jnp.int32),
+                None if son is None else jnp.asarray(son, jnp.bool_))
 
     def _run_ring(self, entry, st, steps, ring):
-        slot, pos, keys, eos, temp = self._ring_dev(ring)
-        (toks, logits, kc, vc, pos2, keys2, done, eos2, temp2) = entry(
+        slot, pos, keys, eos, temp, aidx, _son = self._ring_dev(ring)
+        (toks, logits, kc, vc, pos2, keys2, done, eos2, temp2,
+         aidx2) = entry(
             self.dec.params, st.logits, st.kc, st.vc, st.pos, st.keys,
-            st.done, st.eos, st.temp, self._ring_logits, self._ring_kc,
-            self._ring_vc, slot, pos, keys, eos, temp,
-            steps=int(steps), **self._kw)
+            st.done, st.eos, st.temp, st.adapter_idx, self._ring_logits,
+            self._ring_kc, self._ring_vc, slot, pos, keys, eos, temp,
+            aidx, steps=int(steps), **self._kw)
         return toks, dataclasses.replace(
             st, logits=logits, kc=kc, vc=vc, pos=pos2, keys=keys2,
-            done=done, eos=eos2, temp=temp2,
+            done=done, eos=eos2, temp=temp2, adapter_idx=aidx2,
             steps_done=st.steps_done + int(steps))
 
     def decode_chunk_ring(self, st, chunk_size, ring):
@@ -334,25 +391,29 @@ class _DecoderBackend:
     def decode_step_ring(self, st, ring):
         return self._run_ring(self.dec._ring_chunk_step, st, 1, ring)
 
-    def decode_chunk_spec(self, st, chunk_size, ring):
+    def decode_chunk_spec(self, st, chunk_size, ring, K=None):
         """One chunked-speculative dispatch over the serving carry;
         returns ``(buf (B, T+K), nv, new_state)`` — the overflow-buffer
-        contract the engine's harvest slices."""
+        contract the engine's harvest slices. ``K=`` overrides the
+        per-chunk draft depth (adaptive K clamps it from the live
+        acceptance mean; each distinct K compiles once, like every
+        other static)."""
         eng = self.spec_eng
-        slot, pos, keys, eos, temp = self._ring_dev(ring)
+        slot, pos, keys, eos, temp, aidx, son = self._ring_dev(ring)
         (buf, nv, logits, kc, vc, dkc, dvc, pos2, keys2, done, eos2,
-         temp2, tok, sr, sa) = eng["chunk"](
+         temp2, tok, sr, sa, aidx2, son2) = eng["chunk"](
             self.dec.params, eng["params"], st.logits, st.kc, st.vc,
             st.dkc, st.dvc, st.pos, st.keys, st.done, st.eos, st.temp,
-            st.tok, st.spec_rounds, st.spec_accepted, self._ring_logits,
-            self._ring_kc, self._ring_vc, self._ring_dkc,
-            self._ring_dvc, slot, pos, keys, eos, temp,
-            steps=int(chunk_size), K=self.K, **self._kw)
+            st.tok, st.spec_rounds, st.spec_accepted, st.adapter_idx,
+            st.spec_on, self._ring_logits, self._ring_kc, self._ring_vc,
+            self._ring_dkc, self._ring_dvc, slot, pos, keys, eos, temp,
+            aidx, son, steps=int(chunk_size),
+            K=self.K if K is None else int(K), **self._kw)
         return buf, nv, dataclasses.replace(
             st, logits=logits, kc=kc, vc=vc, dkc=dkc, dvc=dvc, pos=pos2,
             keys=keys2, done=done, eos=eos2, temp=temp2, tok=tok,
-            spec_rounds=sr, spec_accepted=sa, nv=nv,
-            steps_done=st.steps_done + int(chunk_size))
+            spec_rounds=sr, spec_accepted=sa, nv=nv, adapter_idx=aidx2,
+            spec_on=son2, steps_done=st.steps_done + int(chunk_size))
 
     def spec_demote(self, st):
         """Speculative -> chunked demotion: one counted masked forward
@@ -361,10 +422,11 @@ class _DecoderBackend:
         here on."""
         eng = self.spec_eng
         logits, kc, vc, pos = eng["demote"](
-            self.dec.params, st.logits, st.kc, st.vc, st.tok, st.pos)
+            self.dec.params, st.logits, st.kc, st.vc, st.tok, st.pos,
+            st.adapter_idx)
         return dataclasses.replace(
             st, logits=logits, kc=kc, vc=vc, pos=pos, dkc=None,
-            dvc=None, tok=None, nv=None, spec=None)
+            dvc=None, tok=None, nv=None, spec=None, spec_on=None)
 
     # any admission batch size jits its own program; suffix prefills
     # (pos0 > 0) are native to the in-process entry
@@ -374,11 +436,13 @@ class _DecoderBackend:
     def empty_cache(self, B: int):
         return self.dec._empty_cache(int(B))
 
-    def admit_prefill(self, ids, true_len, pos0, kc=None, vc=None):
+    def admit_prefill(self, ids, true_len, pos0, kc=None, vc=None,
+                      aidx=None):
         """One (possibly batched) admission-prefill dispatch: ``ids``
         (N, bucket) right-padded rows, per-row ``true_len``/``pos0``.
         ``kc``/``vc`` default to fresh batch-N caches; the prefix-cache
-        path passes caches preloaded with each row's slab."""
+        path passes caches preloaded with each row's slab. ``aidx``
+        routes each row's prefill through its adapter's deltas."""
         import jax.numpy as jnp
         ids = np.asarray(ids)
         if kc is None:
@@ -386,12 +450,15 @@ class _DecoderBackend:
         return self.dec._admit_prefill(
             self.dec.params, jnp.asarray(ids, jnp.int32), kc, vc,
             jnp.asarray(np.asarray(true_len), jnp.int32),
-            jnp.asarray(np.asarray(pos0), jnp.int32))
+            jnp.asarray(np.asarray(pos0), jnp.int32),
+            None if aidx is None
+            else jnp.asarray(np.asarray(aidx), jnp.int32))
 
     def _run(self, entry, st, steps):
         toks, logits, kc, vc, pos, keys, done = entry(
             self.dec.params, st.logits, st.kc, st.vc, st.pos, st.keys,
-            st.done, st.eos, st.temp, steps=int(steps), **self._kw)
+            st.done, st.eos, st.temp, st.adapter_idx, steps=int(steps),
+            **self._kw)
         return toks, dataclasses.replace(
             st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
             done=done, steps_done=st.steps_done + int(steps))
@@ -415,10 +482,12 @@ class _BundleBackend:
     #                        engine falls back to the host row-scatter
     spec_eng = None
     K = 0
+    lora = None            # typed refusal in __init__: no adapter stacks
 
     def __init__(self, pred, num_slots, chunk_size, do_sample, top_k,
                  top_p, mesh=None, quant=None, draft_model=None,
-                 num_speculative_tokens=None, draft_quant=None):
+                 num_speculative_tokens=None, draft_quant=None,
+                 adapter_store=None):
         from paddle_tpu.inference.sharding import MeshMismatchError
         if draft_model is not None or num_speculative_tokens is not None \
                 or draft_quant is not None:
@@ -430,6 +499,12 @@ class _BundleBackend:
                 f"speculative chunk program (decode_mode.chunked."
                 f"spec_chunk={bool(ch0.get('spec_chunk'))!r}); serve "
                 f"draft_model= over a LlamaDecoder instead")
+        if adapter_store is not None:
+            raise ValueError(
+                "LoRA adapter serving needs the in-process LlamaDecoder "
+                "backend: this bundle's StableHLO entries were exported "
+                "without the stacked lora.* params or the adapter_idx "
+                "carry; serve adapter_store= over a LlamaDecoder instead")
         _check_quant_ask(quant, pred.quant_recipe, "this bundle")
         self.pred = pred
         self.quant = pred.quant_recipe
@@ -521,8 +596,15 @@ class _BundleBackend:
     def empty_cache(self, B: int):
         return self.pred._make_cache(int(B))
 
-    def admit_prefill(self, ids, true_len, pos0, kc=None, vc=None):
+    def admit_prefill(self, ids, true_len, pos0, kc=None, vc=None,
+                      aidx=None):
         import jax.numpy as jnp
+        if aidx is not None:
+            # unreachable today: __init__ refuses adapter_store=, so the
+            # engine never computes row indices for a bundle backend
+            raise ValueError(
+                "bundle admit entries carry no adapter_idx input; serve "
+                "adapter_store= over a LlamaDecoder instead")
         ids = np.asarray(ids)
         if ids.shape[0] != 1:
             raise ValueError(
@@ -595,12 +677,13 @@ def derive_row_key(seed: int, request_id: int, tokens_emitted: int):
 
 def _make_backend(backend, num_slots, chunk_size, do_sample, top_k, top_p,
                   mesh=None, quant=None, draft_model=None,
-                  num_speculative_tokens=None, draft_quant=None):
+                  num_speculative_tokens=None, draft_quant=None,
+                  adapter_store=None):
     from paddle_tpu.inference.bundle import AotPredictor
     from paddle_tpu.inference.generate import LlamaDecoder
     kw = dict(mesh=mesh, quant=quant, draft_model=draft_model,
               num_speculative_tokens=num_speculative_tokens,
-              draft_quant=draft_quant)
+              draft_quant=draft_quant, adapter_store=adapter_store)
     if isinstance(backend, LlamaDecoder):
         return _DecoderBackend(backend, num_slots, chunk_size, do_sample,
                                top_k, top_p, **kw)
@@ -659,7 +742,9 @@ class ServingEngine:
                  draft_model=None,
                  num_speculative_tokens: Optional[int] = None,
                  draft_quant: Optional[str] = None,
-                 ring_slots: Optional[int] = None):
+                 ring_slots: Optional[int] = None,
+                 adapter_store=None,
+                 adaptive_k: bool = False):
         """``prefix_cache``: ``None`` reads the
         ``FLAGS_serving_prefix_cache_bytes`` /
         ``PADDLE_TPU_PREFIX_CACHE_BYTES`` budget (0 = disabled, the
@@ -708,7 +793,18 @@ class ServingEngine:
         prefill results device-side and the next chunk program splices
         them in, so steady state is exactly one dispatch per chunk;
         admissions beyond the ring's free rows re-queue at their tier's
-        head (``serving.admission.ring_full``)."""
+        head (``serving.admission.ring_full``).
+        ``adapter_store``: multi-tenant LoRA serving (LlamaDecoder
+        backend only) — requests name a registered adapter and the
+        chunk program gathers each row's stacked low-rank deltas inside
+        the ONE fused dispatch (serving/lora); base rows ride along
+        bit-exact. Hot-swapped revisions apply between chunks once no
+        in-flight row pins the old one (``AdapterVersionError`` names
+        the blocking rows otherwise).
+        ``adaptive_k``: clamp each speculative chunk's draft depth K
+        from the live cumulative acceptance mean (K stays in ``[1,
+        num_speculative_tokens]``; each distinct K compiles once) — the
+        verify-compute knob tracks the workload instead of the flag."""
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self.num_slots = int(num_slots)
@@ -717,9 +813,23 @@ class ServingEngine:
                                 top_k, top_p, mesh=mesh, quant=quant,
                                 draft_model=draft_model,
                                 num_speculative_tokens=num_speculative_tokens,
-                                draft_quant=draft_quant)
+                                draft_quant=draft_quant,
+                                adapter_store=adapter_store)
         self._spec_configured = self._b.spec_eng is not None
         self._spec_active = self._spec_configured
+        if adaptive_k and not self._spec_configured:
+            raise ValueError("adaptive_k requires a draft_model")
+        self.adaptive_k = bool(adaptive_k)
+        self._k_now = self._b.K
+        self._accept_ewma: Optional[float] = None
+        self.adapter_store = adapter_store
+        # the revisions the DEVICE stacks actually serve (mirrors the
+        # store at every applied swap; the skew window is the staged-
+        # but-refused hot-swap)
+        self._served_rev: Dict[str, int] = (
+            {} if adapter_store is None
+            else {n: adapter_store.revision(n)
+                  for n in adapter_store.names()})
         if self._spec_configured and (snapshot_dir or snapshot_every_chunks):
             raise ValueError(
                 "speculative serving does not snapshot yet: the carry's "
@@ -958,6 +1068,31 @@ class ServingEngine:
         self._g_spec_accept_mean = r.gauge(
             "serving.spec.acceptance_len_mean",
             "cumulative accepted drafts per verify round")
+        self._g_k_now = r.gauge(
+            "serving.spec.k_now",
+            "the draft depth K the next speculative chunk dispatches "
+            "with (== num_speculative_tokens unless adaptive_k clamps "
+            "it from the live acceptance mean)")
+        if self._spec_configured:
+            self._g_k_now.set(self._b.K)
+        # multi-tenant LoRA serving: per-adapter row admissions, live
+        # registry size and hot-swap applications — the /metrics proof
+        # that mixed-tenant batches share the fused dispatch
+        self._g_adapters_active = r.gauge(
+            "serving.adapter.active",
+            "adapters registered in this engine's AdapterStore")
+        self._c_adapter_swaps = r.counter(
+            "serving.adapter.swaps",
+            "adapter hot-swaps applied between chunks (stacks re-merged "
+            "after an update() once no in-flight row pinned the old "
+            "revision)")
+        self._c_adapter_rows: Dict[str, Any] = {}
+        if adapter_store is not None:
+            self._g_adapters_active.set(len(adapter_store))
+        # per-latency-class streaming TTFT (histograms created on first
+        # use; the HTTP front-end's flush cadence rides chunk harvests)
+        self._h_stream_ttft: Dict[str, Any] = {}
+        self._stream_cb: Dict[int, Any] = {}
         # crash recovery / replica identity
         self.replica_tag = None if replica_tag is None else str(replica_tag)
         self._snap_dir = snapshot_dir
@@ -1016,6 +1151,74 @@ class ServingEngine:
         return int(self._c_step.value)
 
     # -- submission --------------------------------------------------------
+    # -- multi-tenant LoRA helpers -----------------------------------------
+    def _adapter_tag(self, name: Optional[str]) -> Optional[str]:
+        """The prefix-cache content tag for a request's adapter:
+        ``"name@rev"`` (adapter KV is revision-specific content) or
+        ``None`` for base rows — base digests stay byte-identical to a
+        cache that never heard of adapters."""
+        if name is None or self.adapter_store is None:
+            return None
+        return self.adapter_store.tag(name)
+
+    def _adapter_row_counter(self, name: str):
+        ctr = self._c_adapter_rows.get(name)
+        if ctr is None:
+            ctr = self.registry.counter(
+                f"serving.adapter.rows.{name}",
+                f"rows admitted for adapter {name!r} ('base' = no "
+                f"adapter) — mixed names across one chunk ARE the "
+                f"shared fused dispatch")
+            self._c_adapter_rows[name] = ctr
+        return ctr
+
+    def _stream_ttft_hist(self, cls: str):
+        h = self._h_stream_ttft.get(cls)
+        if h is None:
+            h = self.registry.histogram(
+                f"serving.stream.ttft_s.{cls}",
+                f"admission -> first streamed flush, latency class "
+                f"{cls!r} (streaming submits only)")
+            self._h_stream_ttft[cls] = h
+        return h
+
+    def apply_adapter_swap(self) -> bool:
+        """Apply pending AdapterStore registrations/updates to the
+        device stacks. Refused TYPED (:class:`AdapterVersionError`)
+        while any in-flight row still decodes through a revision the
+        swap would change — a KV cache computed under rev N continued
+        under rev N+1 is neither tenant's output (the
+        ``WeightVersionError`` argument, per adapter). ``step()``
+        retries automatically each iteration; requests naming the
+        pending revision queue until it lands. Returns True when the
+        stacks moved."""
+        store = self.adapter_store
+        if store is None or store.version == self._b.lora_version:
+            return False
+        from paddle_tpu.serving.lora import AdapterVersionError
+        for i, slot in self.scheduler.slots.occupied():
+            ad = slot.request.adapter
+            if ad is None or slot.adapter_rev is None:
+                continue
+            cur = store.revision(ad)
+            if cur != slot.adapter_rev:
+                raise AdapterVersionError(
+                    f"adapter {ad!r} staged rev {cur} but request "
+                    f"{slot.request.id} (slot {i}) still decodes "
+                    f"through rev {slot.adapter_rev}; the swap applies "
+                    f"once those rows drain",
+                    adapter=ad, pinned_rev=slot.adapter_rev,
+                    store_rev=cur)
+        if self._b.refresh_adapters():
+            self._c_adapter_swaps.inc()
+            self._g_adapters_active.set(len(store))
+            self._served_rev = {n: store.revision(n)
+                                for n in store.names()}
+            obs.tracer.event("serving.adapter.swap",
+                             version=store.version)
+            return True
+        return False
+
     def submit(self, prompt, max_new_tokens: int,
                eos_token_id: Optional[int] = None,
                temperature: float = 1.0, seed: int = 0,
@@ -1024,7 +1227,10 @@ class ServingEngine:
                slo_latency_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
                rng_request_id: Optional[int] = None,
-               rng_tokens_emitted: int = 0) -> int:
+               rng_tokens_emitted: int = 0,
+               adapter: Optional[str] = None,
+               speculative: Optional[bool] = None,
+               on_tokens=None) -> int:
         """Queue one request; returns its id (results key).
         ``latency_class`` + optional per-request SLO targets feed the
         per-class TTFT/latency violation counters. ``deadline_s`` is a
@@ -1037,9 +1243,31 @@ class ServingEngine:
         ``rng_tokens_emitted`` feed the ``request_keyed_rng`` stream
         derivation (a router passes its stable request id and, on a
         replay, how many generated tokens the prompt already carries);
-        ignored under the default seed-only rule."""
+        ignored under the default seed-only rule.
+        ``adapter``: serve this request through a registered LoRA
+        adapter's deltas (``adapter_store=``); unknown names are a typed
+        :class:`~paddle_tpu.serving.lora.UnknownAdapterError` here,
+        before any slot work. ``None`` = the base model.
+        ``speculative=False`` opts this request OUT of speculative
+        decoding on a draft-equipped engine (its row runs plain
+        verify-free decode inside the same fused dispatch); ``None`` =
+        the engine default. ``on_tokens``: per-token streaming callback
+        ``(request_id, np.ndarray new_tokens, final: bool)`` fired at
+        every chunk harvest with the tokens the row gained since the
+        last call, then once with ``final=True`` at finish."""
         from paddle_tpu.inference.generate import _normalize_eos
         from paddle_tpu.runtime.resilience import DeadlineExceededError
+        if adapter is not None:
+            from paddle_tpu.serving.lora import UnknownAdapterError
+            if self.adapter_store is None:
+                raise UnknownAdapterError(
+                    f"request names adapter {adapter!r} but this engine "
+                    f"serves no AdapterStore (pass adapter_store=)")
+            self.adapter_store.index(adapter)   # typed unknown-name check
+        if speculative and not self._spec_configured:
+            raise ValueError(
+                "submit(speculative=True) needs a draft_model-equipped "
+                "engine")
         prompt = np.asarray(prompt)
         if prompt.ndim == 2:
             if prompt.shape[0] != 1:
@@ -1099,14 +1327,23 @@ class ServingEngine:
             deadline_s=deadline_s,
             rng_request_id=(None if rng_request_id is None
                             else int(rng_request_id)),
-            rng_tokens_emitted=int(rng_tokens_emitted))
+            rng_tokens_emitted=int(rng_tokens_emitted),
+            adapter=adapter,
+            speculative=(None if speculative is None
+                         else bool(speculative)))
         if self.scheduler.cache_aware:
             # the cache-aware ordering's grouping key: the prompt's
             # FIRST block-boundary digest (the shortest ladder entry) —
-            # requests sharing >= one hash block group together
+            # requests sharing >= one hash block group together.
+            # Adapter KV is adapter-specific content, so the tag seeds
+            # the digest chain: same prompt, different tenant -> a
+            # DIFFERENT group (and a guaranteed cache miss).
             from paddle_tpu.serving.prefix_cache import prefix_digests
             req.prefix_group = prefix_digests(
-                prompt, self.prefix_cache.block_tokens)[-1][1]
+                prompt, self.prefix_cache.block_tokens,
+                adapter=self._adapter_tag(adapter))[-1][1]
+        if on_tokens is not None:
+            self._stream_cb[rid] = on_tokens
         self.scheduler.push(req)
         self._g_qdepth.set(len(self.scheduler))
         obs.tracer.event("serving.request.queued", request=rid,
@@ -1134,6 +1371,16 @@ class ServingEngine:
         the list (and in ``result(id)``) — accepted work always resolves
         to tokens or a typed error."""
         now = time.monotonic()
+        if self.adapter_store is not None and \
+                self.adapter_store.version != self._b.lora_version:
+            from paddle_tpu.serving.lora import AdapterVersionError
+            try:
+                # staged hot-swap: applies the moment no in-flight row
+                # pins a changed revision (callers wanting the typed
+                # refusal call apply_adapter_swap() directly)
+                self.apply_adapter_swap()
+            except AdapterVersionError:
+                pass
         pre = self._enforce_deadlines(now)
         self._h_qdepth.observe(len(self.scheduler))
         admitted = self.scheduler.admissions()
@@ -1230,6 +1477,17 @@ class ServingEngine:
                 seq = seq[:req.max_new_tokens]
                 fin = True
             if not fin:
+                cb = self._stream_cb.get(req.id)
+                if cb is not None and len(seq) > slot.streamed:
+                    # per-token streaming: flush the tokens this chunk
+                    # harvest added (the flush cadence IS the chunk
+                    # boundary; _finish fires the final flush)
+                    if slot.streamed == 0:
+                        self._stream_ttft_hist(req.latency_class)\
+                            .observe(t_chunk_done - slot.admitted_at)
+                    new = seq[slot.streamed:]
+                    slot.streamed = int(len(seq))
+                    cb(req.id, np.asarray(new), False)
                 continue
             res = self._finish(slot, seq, i)
             self._results[req.id] = res
@@ -1244,8 +1502,25 @@ class ServingEngine:
         if sr is not None:
             rt = int(self._c_spec_rounds.value)
             if rt:
-                self._g_spec_accept_mean.set(
-                    int(self._c_spec_accept.value) / rt)
+                mean = int(self._c_spec_accept.value) / rt
+                self._g_spec_accept_mean.set(mean)
+                if self.adaptive_k:
+                    # clamp the NEXT chunk's draft depth from the live
+                    # acceptance mean: drafting far past what verify
+                    # accepts is pure wasted draft+verify compute, while
+                    # high acceptance earns the full K. EWMA smooths the
+                    # chunk-to-chunk noise; each distinct K compiles
+                    # once (it's a static), so k_now moving is a cache
+                    # hit after the first visit.
+                    e = self._accept_ewma
+                    self._accept_ewma = (mean if e is None
+                                         else 0.8 * e + 0.2 * mean)
+                    knew = max(1, min(self._b.K,
+                                      int(np.ceil(self._accept_ewma))
+                                      + 1))
+                    if knew != self._k_now:
+                        self._k_now = knew
+                        self._g_k_now.set(knew)
         if freed:
             self._freeze_rows(freed)
         if self._snap_every and (self.chunk_dispatches
@@ -1288,6 +1563,10 @@ class ServingEngine:
                 request_id=req.id)
             self._results[req.id] = err
             out.append((req.id, err))
+            cb = self._stream_cb.pop(req.id, None)
+            if cb is not None:
+                # a shed streaming request still terminates its stream
+                cb(req.id, np.zeros((0,), np.int64), True)
             obs.tracer.event("serving.request.shed", request=req.id,
                              reason="queue_deadline")
         frozen = []
@@ -1570,6 +1849,25 @@ class ServingEngine:
                 request=req, admitted_at=now, chunks=int(sm["chunks"]),
                 tokens=[np.asarray(npz[f"slot{i}_piece{j}"])
                         for j in range(int(sm["pieces"]))])
+        if st.adapter_idx is not None:
+            # the adapter routing is bookkeeping, not carry payload:
+            # rebuild each restored row's index from its request's
+            # adapter name (unknown names refuse typed — the store must
+            # know every adapter the snapshot's rows decode through)
+            ai = np.zeros((self.num_slots,), np.int32)
+            for sm in meta["slots"]:
+                ad = sm["request"].get("adapter")
+                if ad is not None:
+                    ai[int(sm["slot"])] = self.adapter_store.index(ad)
+                    self.scheduler.slots.entries[
+                        int(sm["slot"])].adapter_rev = \
+                        self.adapter_store.revision(ad)
+            aidx = jnp.asarray(ai)
+            if self._b.sharding is not None:
+                aidx = self._b.sharding.put_state_field(
+                    "adapter_idx", aidx, self._b.head_major)
+            self.state = dataclasses.replace(self.state,
+                                             adapter_idx=aidx)
         for j, qm in enumerate(meta["queue"]):
             self.scheduler.push(
                 self._req_from_meta(qm, npz[f"queue{j}_prompt"], now))
@@ -1602,6 +1900,8 @@ class ServingEngine:
                 else req.deadline_at - now),
             "rng_request_id": req.rng_request_id,
             "rng_tokens_emitted": req.rng_tokens_emitted,
+            "adapter": req.adapter,
+            "speculative": req.speculative,
         }
 
     @staticmethod
@@ -1622,7 +1922,9 @@ class ServingEngine:
             deadline_s=rem,
             deadline_at=None if rem is None else now + rem,
             rng_request_id=m.get("rng_request_id"),
-            rng_tokens_emitted=int(m.get("rng_tokens_emitted") or 0))
+            rng_tokens_emitted=int(m.get("rng_tokens_emitted") or 0),
+            adapter=m.get("adapter"),
+            speculative=m.get("speculative"))
 
     # -- replica plumbing (serving/router.py reads these) ------------------
     def export_inflight(self) -> List[Tuple[Request, np.ndarray, int]]:
@@ -1877,21 +2179,33 @@ class ServingEngine:
                 # raw-key scatter: bypass _scatter's key derivation —
                 # the shipped key IS the row's live stream state
                 st = self.state
-                (logits, kc, vc, pos, keys, done, eos, temp) = \
+                aidx1 = None
+                if st.adapter_idx is not None:
+                    aidx1 = jnp.asarray(
+                        0 if (req.adapter is None
+                              or self.adapter_store is None)
+                        else self.adapter_store.index(req.adapter),
+                        jnp.int32)
+                (logits, kc, vc, pos, keys, done, eos, temp, aidx) = \
                     self._admit_fn(
                         st.logits, st.kc, st.vc, st.pos, st.keys,
-                        st.done, st.eos, st.temp, logits1, kc1, vc1,
+                        st.done, st.eos, st.temp, st.adapter_idx,
+                        logits1, kc1, vc1,
                         jnp.asarray(slot_idx, jnp.int32),
                         jnp.asarray(j, jnp.int32),
                         jnp.asarray(pos1[j], jnp.int32),
                         jnp.asarray(keys1[j], jnp.uint32),
                         jnp.asarray(eos1[j], jnp.int32),
-                        jnp.asarray(temp1[j], jnp.float32))
+                        jnp.asarray(temp1[j], jnp.float32), aidx1)
                 self.state = dataclasses.replace(
                     st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
-                    done=done, eos=eos, temp=temp)
+                    done=done, eos=eos, temp=temp, adapter_idx=aidx)
                 slot = self.scheduler.slots.entries[slot_idx]
                 slot.admitted_at = now
+                if req.adapter is not None \
+                        and self.adapter_store is not None:
+                    slot.adapter_rev = \
+                        self.adapter_store.revision(req.adapter)
                 slot.chunks = int(sm["chunks"])
                 slot.tokens = [np.asarray(npz[f"row{j}_piece{p}"])
                                for p in range(int(sm["pieces"]))]
@@ -1999,6 +2313,24 @@ class ServingEngine:
         row state into device ring rows and the NEXT chunk program
         splices them in — zero host scatters, zero extra dispatch
         boundaries."""
+        store = self.adapter_store
+        if store is not None and store.version != self._b.lora_version:
+            # a staged hot-swap hasn't applied yet (in-flight rows pin
+            # the old revision): requests naming a PENDING adapter
+            # revision wait at their tier's head rather than decode
+            # through stacks that aren't theirs
+            keep = []
+            for slot_idx, req in admitted:
+                if req.adapter is not None and \
+                        self._served_rev.get(req.adapter) \
+                        != store.revision(req.adapter):
+                    self.scheduler.slots.release(slot_idx)
+                    self.scheduler.push_front(req)
+                else:
+                    keep.append((slot_idx, req))
+            admitted = keep
+            if not admitted:
+                return
         if self._ring_slots:
             self._admit_all_ring(admitted, now)
             return
@@ -2010,7 +2342,8 @@ class ServingEngine:
             hit = None
             if cache is not None:
                 hit = cache.lookup(req.prompt,
-                                   allow_partial=self._b.admit_pos0)
+                                   allow_partial=self._b.admit_pos0,
+                                   adapter=self._adapter_tag(req.adapter))
             if hit is not None and hit.kind == "full":
                 cache.pin(hit.slab)
                 self._scatter(slot_idx, req, hit.slab.logits,
@@ -2087,8 +2420,12 @@ class ServingEngine:
             p = np.asarray(req.prompt)
             ids[j, :len(p)] = p
             true_len[j] = len(p)
+        aidxN = None
+        if self.adapter_store is not None:
+            aidxN = np.asarray([self.adapter_store.index(req.adapter)
+                                for _, req in grp], np.int32)
         ev0 = self._b.event_count()
-        self._b.ring_admit(ids, true_len, pos0, rows)
+        self._b.ring_admit(ids, true_len, pos0, rows, aidx=aidxN)
         self._c_prefill.inc()
         if self._spec_active:
             self._b.ring_admit_draft(ids, rows)
@@ -2111,7 +2448,10 @@ class ServingEngine:
                 "key": np.asarray(key1, np.uint32),
                 "eos": (-1 if req.eos_token_id is None
                         else int(req.eos_token_id)),
-                "temp": float(req.temperature)}
+                "temp": float(req.temperature),
+                "aidx": (0 if aidxN is None else int(aidxN[j])),
+                "spec_on": (req.speculative
+                            if req.speculative is not None else True)}
             self._c_ring_staged.inc()
             self._note_admit(slot_idx, req, now, t0, "miss",
                              tokens_saved=0,
@@ -2129,6 +2469,10 @@ class ServingEngine:
         keys = np.zeros((R, 2), np.uint32)
         eos = np.full((R,), -1, np.int32)
         temp = np.ones((R,), np.float32)
+        aidx = (np.zeros((R,), np.int32)
+                if self.adapter_store is not None else None)
+        son = (np.ones((R,), np.bool_)
+               if self._spec_configured else None)
         n = 0
         for r, m in enumerate(self._ring_meta):
             if m is None:
@@ -2138,8 +2482,12 @@ class ServingEngine:
             keys[r] = m["key"]
             eos[r] = m["eos"]
             temp[r] = m["temp"]
+            if aidx is not None:
+                aidx[r] = m.get("aidx", 0)
+            if son is not None:
+                son[r] = m.get("spec_on", True)
             n += 1
-        return (slot, pos, keys, eos, temp), n
+        return (slot, pos, keys, eos, temp, aidx, son), n
 
     def _ring_drained(self, n: Optional[int]) -> None:
         """A chunk program's ring prologue ran: the staged rows are in
@@ -2173,9 +2521,13 @@ class ServingEngine:
                     kcN, vcN = self._b.empty_cache(N)
                 kcN, vcN = ops.load(kcN, vcN, hit.slab.kc, hit.slab.vc,
                                     j)
+        aidxN = None
+        if self.adapter_store is not None:
+            aidxN = np.asarray([self.adapter_store.index(req.adapter)
+                                for _, req, _, _ in grp], np.int32)
         ev0 = self._b.event_count()
         logitsN, kcN, vcN = self._b.admit_prefill(ids, true_len, pos0,
-                                                  kcN, vcN)
+                                                  kcN, vcN, aidx=aidxN)
         self._c_prefill.inc()
         if N > 1:
             self._c_batched_groups.inc()
@@ -2192,7 +2544,8 @@ class ServingEngine:
                     skc, svc, slg = ops.extract(kcN, vcN, logitsN, j,
                                                 bucket)
                     cache.insert(req.prompt, skc, svc, slg, bucket,
-                                 digests=digests)
+                                 digests=digests,
+                                 adapter=self._adapter_tag(req.adapter))
             cls = "partial" if cached else "miss"
             self._note_admit(slot_idx, req, now, t0, cls,
                              tokens_saved=cached,
@@ -2227,17 +2580,23 @@ class ServingEngine:
             key1 = jnp.asarray(
                 jrandom.split(jrandom.PRNGKey(req.seed), 1)[0], jnp.uint32)
         st = self.state
-        (logits, kc, vc, pos, keys, done, eos, temp) = self._admit_fn(
+        aidx1 = None
+        if st.adapter_idx is not None:
+            aidx1 = jnp.asarray(
+                0 if self.adapter_store is None
+                else self.adapter_store.index(req.adapter), jnp.int32)
+        (logits, kc, vc, pos, keys, done, eos, temp,
+         aidx) = self._admit_fn(
             st.logits, st.kc, st.vc, st.pos, st.keys, st.done, st.eos,
-            st.temp, logits1, kc1, vc1,
+            st.temp, st.adapter_idx, logits1, kc1, vc1,
             jnp.asarray(slot_idx, jnp.int32), jnp.asarray(src, jnp.int32),
             jnp.asarray(pos1, jnp.int32), key1,
             jnp.asarray(-1 if req.eos_token_id is None
                         else int(req.eos_token_id), jnp.int32),
-            jnp.asarray(req.temperature, jnp.float32))
+            jnp.asarray(req.temperature, jnp.float32), aidx1)
         self.state = dataclasses.replace(
             st, logits=logits, kc=kc, vc=vc, pos=pos, keys=keys,
-            done=done, eos=eos, temp=temp)
+            done=done, eos=eos, temp=temp, adapter_idx=aidx)
 
     def _note_admit(self, slot_idx: int, req: Request, now: float,
                     t0: float, cls: str, tokens_saved: int,
@@ -2245,6 +2604,12 @@ class ServingEngine:
         slot = self.scheduler.slots.entries[slot_idx]
         slot.admitted_at = now
         slot.events.extend(events)
+        slot.streamed = 0
+        if self.adapter_store is not None:
+            slot.adapter_rev = (
+                None if req.adapter is None
+                else self.adapter_store.revision(req.adapter))
+            self._adapter_row_counter(req.adapter or "base").inc()
         enabled = self.prefix_cache is not None
         slot.prefix_hit = cls if enabled else None
         slot.prefill_tokens_saved = int(tokens_saved)
@@ -2296,7 +2661,7 @@ class ServingEngine:
                     fault_injector.on_call(
                         f"serving.{self.replica_tag}.chunk")
                 toks, nv, self.state = self._b.decode_chunk_spec(
-                    self.state, self.chunk_size, ring)
+                    self.state, self.chunk_size, ring, K=self._k_now)
                 self._c_chunk.inc()
                 self._c_slot_steps.inc(self.num_slots * self.chunk_size)
                 self._ring_drained(n_staged)
@@ -2556,6 +2921,20 @@ class ServingEngine:
             level=record["level"])
         obs.tracer.event("serving.request.finished", request=req.id,
                          latency_s=round(latency, 6))
+        if req.adapter is not None:
+            record["serving"]["adapter"] = req.adapter
+            record["serving"]["adapter_rev"] = slot.adapter_rev
+        cb = self._stream_cb.pop(req.id, None)
+        if cb is not None:
+            # the FINAL flush: whatever the finish-side trims left
+            # beyond the last chunk flush, with the final=True marker
+            # every streaming consumer keys its terminator on
+            new = seq[slot.streamed:]
+            if slot.streamed == 0 and len(new):
+                self._stream_ttft_hist(req.latency_class).observe(
+                    fin - slot.admitted_at)
+            slot.streamed = int(len(seq))
+            cb(req.id, np.asarray(new), True)
         out = np.concatenate([req.prompt,
                               seq.astype(req.prompt.dtype)])[None]
         return GenerateResult.wrap(out, record)
@@ -2671,6 +3050,8 @@ class ServingEngine:
             "speculative": (None if not self._spec_configured else {
                 "active": bool(self._spec_active),
                 "num_speculative_tokens": int(self._b.K),
+                "k_now": int(self._k_now),
+                "adaptive_k": bool(self.adaptive_k),
                 "rounds": int(self._c_spec_rounds.value),
                 "accepted_drafts": int(self._c_spec_accept.value),
                 "acceptance_len_mean": float(
@@ -2678,6 +3059,18 @@ class ServingEngine:
                 "overflow_tokens": int(self._c_spec_overflow.value),
                 "draft_prefill_dispatches": int(
                     self._c_draft_prefill.value),
+            }),
+            # multi-tenant LoRA serving (None = no AdapterStore): the
+            # store's registry + what the device stacks currently serve
+            "adapters": (None if self.adapter_store is None else {
+                **self.adapter_store.describe(),
+                "served_version": int(self._b.lora_version),
+                "swap_pending": bool(self.adapter_store.version
+                                     != self._b.lora_version),
+                "rows_by_adapter": {
+                    name: int(c.value)
+                    for name, c in sorted(
+                        self._c_adapter_rows.items())},
             }),
             # device admission ring (None = host-scatter admission):
             # staged_now > 0 means prefill results are parked on device
@@ -2826,6 +3219,8 @@ class ServingEngine:
             "speculative": (None if not self._spec_configured else {
                 "active": bool(self._spec_active),
                 "num_speculative_tokens": int(self._b.K),
+                "k_now": int(self._k_now),
+                "adaptive_k": bool(self.adaptive_k),
                 "rounds": int(self._c_spec_rounds.value),
                 "accepted_drafts": int(self._c_spec_accept.value),
                 "acceptance_len_mean": float(
@@ -2839,4 +3234,19 @@ class ServingEngine:
                 "full": int(self._c_ring_full.value),
                 "host_scattered": int(self._c_host_scattered.value),
             }),
+            # multi-tenant LoRA serving (None = no AdapterStore): the
+            # per-adapter row counts are the /metrics proof a mixed
+            # batch shared the fused dispatch
+            "adapters": (None if self.adapter_store is None else {
+                "active": int(self._g_adapters_active.value),
+                "swaps": int(self._c_adapter_swaps.value),
+                "store_version": int(self.adapter_store.version),
+                "rows_by_adapter": {
+                    name: int(c.value)
+                    for name, c in sorted(
+                        self._c_adapter_rows.items())},
+            }),
+            "stream_ttft_p50_s": {
+                cls: h.percentile(50)
+                for cls, h in sorted(self._h_stream_ttft.items())},
         }

@@ -1,0 +1,16 @@
+"""paddle_tpu.serving.lora — batched multi-tenant LoRA adapters.
+
+``AdapterStore`` registers named low-rank (A, B) delta pairs and stacks
+them into ``(N+1, ...)`` device arrays the fused decode gathers per
+batch row (``adapter_idx`` carry leaf) — mixed-tenant batches decode in
+ONE fused dispatch, bit-exact per row vs each tenant's dense-merged
+model. See store.py for the hot-swap/versioning contract.
+"""
+
+from paddle_tpu.serving.lora.store import (  # noqa: F401
+    AdapterStore,
+    AdapterVersionError,
+    UnknownAdapterError,
+)
+
+__all__ = ["AdapterStore", "AdapterVersionError", "UnknownAdapterError"]

@@ -68,13 +68,21 @@ def resolve_prefix_cache_bytes() -> int:
     return int(flags.serving_prefix_cache_bytes)
 
 
-def prefix_digests(tokens, block_tokens: int) -> List[Tuple[int, str]]:
+def prefix_digests(tokens, block_tokens: int,
+                   adapter: Optional[str] = None) -> List[Tuple[int, str]]:
     """Chained content hashes of a token-id prefix at every
     ``block_tokens`` boundary plus the full length, longest first.
     Chaining (``h_i = H(h_{i-1} || block_i)``) makes the whole ladder
     one O(S) pass, and a one-token divergence anywhere in a block
     changes every digest at and past that block — the property the
-    block-boundary miss tests pin down."""
+    block-boundary miss tests pin down.
+
+    ``adapter`` (a LoRA adapter tag, ``"name@rev"``) seeds the chain
+    BEFORE the first block: a tenant's slab KV was computed through its
+    adapter's deltas, so the same token ids under a different adapter
+    (or under a bumped revision of the same one) are DIFFERENT content
+    and must never hit each other's slabs. ``None`` (base model) leaves
+    every digest byte-for-byte what it was before adapters existed."""
     ids = np.ascontiguousarray(np.asarray(tokens).reshape(-1), np.int64)
     S = int(ids.shape[0])
     if S < 1:
@@ -84,6 +92,8 @@ def prefix_digests(tokens, block_tokens: int) -> List[Tuple[int, str]]:
         raise ValueError(f"block_tokens must be >= 1, got {block}")
     out: List[Tuple[int, str]] = []
     h = hashlib.blake2b(digest_size=16)
+    if adapter is not None:
+        h.update(b"adapter:" + str(adapter).encode("utf-8") + b"\x00")
     done = 0
     for end in range(block, S + 1, block):
         h.update(ids[done:end].tobytes())
@@ -285,7 +295,8 @@ class PrefixCache:
                     f"cache only between same-topology engines")
 
     # -- lookup / insert ----------------------------------------------------
-    def lookup(self, tokens, allow_partial: bool = True) -> PrefixLookup:
+    def lookup(self, tokens, allow_partial: bool = True,
+               adapter: Optional[str] = None) -> PrefixLookup:
         """Longest-prefix match over the prompt's digest ladder. A full
         hit needs the exact full-length entry WITH resume logits; the
         longest boundary entry otherwise serves as a partial base,
@@ -293,8 +304,12 @@ class PrefixCache:
         suffix token to recompute the resume logits from.
         ``allow_partial=False`` (a backend without suffix-prefill
         entries — a pre-prefix AOT bundle) demotes partial matches to
-        misses up front, keeping the accounting honest."""
-        digests = prefix_digests(tokens, self.block_tokens)
+        misses up front, keeping the accounting honest. ``adapter``
+        (LoRA tag ``"name@rev"``) seeds the digest chain — a tenant can
+        only ever hit slabs prefilled through ITS adapter revision, and
+        base requests (None) keep their pre-adapter digests."""
+        digests = prefix_digests(tokens, self.block_tokens,
+                                 adapter=adapter)
         S = digests[0][0]
         with self._lock:
             for L, d in digests:
@@ -336,16 +351,20 @@ class PrefixCache:
             return ent is not None and ent[1] == ent[0].length
 
     def insert(self, tokens, kc, vc, logits, bucket: int,
-               digests: Optional[List[Tuple[int, str]]] = None
-               ) -> Optional[PrefixSlab]:
+               digests: Optional[List[Tuple[int, str]]] = None,
+               adapter: Optional[str] = None) -> Optional[PrefixSlab]:
         """Register one prefilled prompt's sliced row state under its
         full-length digest and every block-boundary digest (first
         writer wins — content-equal prefixes produce identical KV).
         Returns the slab (the existing one when the full entry is
         already present), or None when the cache chose not to keep it.
-        Evicts LRU unpinned slabs past the byte budget."""
+        Evicts LRU unpinned slabs past the byte budget. ``adapter``
+        (used only when ``digests`` is None) keys the slab under the
+        tenant's adapter-seeded ladder — KV computed through an
+        adapter's deltas must never answer another tenant's lookup."""
         if digests is None:
-            digests = prefix_digests(tokens, self.block_tokens)
+            digests = prefix_digests(tokens, self.block_tokens,
+                                     adapter=adapter)
         S = digests[0][0]
         with self._lock:
             have = self._index.get(digests[0][1])

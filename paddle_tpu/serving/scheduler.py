@@ -93,6 +93,16 @@ class Request:
     # that many steps so a sampled replay resumes the identical stream
     rng_request_id: Optional[int] = None
     rng_tokens_emitted: int = 0
+    # multi-tenant LoRA routing (serving/lora): the adapter NAME this
+    # request decodes through (None = base model). The engine resolves
+    # it to a row index into the stacked delta arrays at admission and
+    # pins the resolved (name, revision) so a hot-swap mid-flight is a
+    # typed refusal, never a silent tenant mix
+    adapter: Optional[str] = None
+    # per-request speculative toggle (speculative engines only): False
+    # demotes THIS row to plain verify-free decode inside the same
+    # speculative chunk program; None = engine default (on)
+    speculative: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -128,6 +138,14 @@ class Slot:
     spec_rounds: int = 0
     spec_accepted: int = 0
     spec_overflow: int = 0
+    # streaming flush cursor (serving/http): how many of this request's
+    # reassembled tokens have already been pushed to its stream callback
+    # — chunk-boundary harvests emit ``seq[streamed:]`` and advance it
+    streamed: int = 0
+    # the adapter revision pinned at admission (None = base): hot-swap
+    # of THIS adapter while the row is in flight raises the typed
+    # AdapterVersionError instead of silently switching tenants mid-seq
+    adapter_rev: Optional[int] = None
 
 
 class SlotTable:

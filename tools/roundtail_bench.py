@@ -199,6 +199,19 @@ STEPS = [
     ("serve_frontend_failover", [sys.executable, "bench.py", "--serve",
                                  "--cluster", "prefill:1,decode:2",
                                  "--kill-frontend"], None),
+    # multi-tenant HTTP gate: bench.py --serve --http --adapters 3 — an
+    # HttpFrontend over a LoRA-multiplexed engine driven by REAL
+    # concurrent HTTP round-trips (half unary, half chunk-streamed)
+    # spread over the base model + 3 adapters. Bit-exact token parity
+    # vs the direct in-process engine (streamed concatenation
+    # included), dispatch accounting (admission prefills + ONE fused
+    # chunk shared by all in-flight tenants, zero per-token steps /
+    # host scatters), per-adapter row counters in the live /metrics
+    # scrape and the graceful-drain contract (healthz 503 + typed
+    # shed) are ALL hard-asserted inside the bench (rc != 0 on any
+    # violation)
+    ("serve_http", [sys.executable, "bench.py", "--serve", "--http",
+                    "--adapters", "3"], None),
 ]
 
 
